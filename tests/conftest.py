@@ -1,0 +1,37 @@
+"""Test harness: an 8-device virtual CPU mesh — the "fake cluster".
+
+SURVEY.md §7 test strategy: distributed behavior is tested with forced host
+devices so no TPU is needed in CI.  The sandbox's sitecustomize imports jax
+and pins the TPU backend before pytest starts, so redirecting via env vars
+alone is too late — we also flip ``jax.config`` here, which is honored because
+no backend has been initialized yet at collection time.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from tpuframe.parallel import mesh as mesh_lib
+
+    assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
+    return mesh_lib.make_mesh(mesh_lib.MeshSpec(data=8))
+
+
+@pytest.fixture(scope="session")
+def mesh42():
+    """2-D mesh: 4-way data x 2-way model — exercises non-trivial axes."""
+    from tpuframe.parallel import mesh as mesh_lib
+
+    return mesh_lib.make_mesh(mesh_lib.MeshSpec(data=4, model=2))

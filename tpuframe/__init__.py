@@ -1,0 +1,25 @@
+"""tpuframe — a TPU-native distributed training framework.
+
+A from-scratch JAX/XLA rebuild of the capabilities of the reference repo
+``onesamblack/distributed-torch-horovod-gcp`` (a PyTorch + Horovod + NCCL
+data-parallel harness on GCP GPU VMs — see SURVEY.md §1).  The Horovod C++
+collective runtime (background coordinator, tensor-fusion buffer, NCCL/MPI/Gloo
+backends — SURVEY.md §3b) is replaced by XLA SPMD compilation: collectives are
+emitted by the compiler inside a jitted step function and ride the TPU ICI
+torus (intra-slice) / DCN (cross-slice).
+
+Layering (SURVEY.md §2):
+  - ``tpuframe.parallel`` — L0–L2: process bootstrap, device mesh, collective
+    helpers, and a Horovod-compatible facade (``tpuframe.parallel.hvd``).
+  - ``tpuframe.data``     — L3: host-sharded input pipeline, GCS-backed readers.
+  - ``tpuframe.ckpt``     — L3: sharded checkpoint save/restore with resharding.
+  - ``tpuframe.models``   — model zoo: MNIST ConvNet, ResNet-18/50, BERT-base.
+  - ``tpuframe.train``    — L4: config-driven training harness (5 workloads).
+  - ``tpuframe.launch``   — L5/L6: TPU-VM provisioning + SSH fan-out launcher.
+  - ``tpuframe.obs``      — tracing, metrics, heartbeat/stall detection.
+  - ``tpuframe.ops``      — pallas TPU kernels + native C++ host runtime.
+"""
+
+__version__ = "0.1.0"
+
+from tpuframe.parallel import mesh as mesh  # noqa: F401

@@ -1,0 +1,29 @@
+"""Distributed core: bootstrap, mesh, collectives, Horovod-compatible facade.
+
+TPU-native replacement for the reference's L0–L2 stack (SURVEY.md §2):
+Horovod's C++ op queue / coordinator / fusion buffer and its NCCL/MPI/Gloo
+transports become (a) a one-call process bootstrap (``initialize``), (b) a
+named device mesh (``make_mesh``), and (c) XLA collectives emitted inside
+compiled SPMD programs (``collectives``, ``step``).
+"""
+
+from tpuframe.parallel.bootstrap import (  # noqa: F401
+    initialize,
+    is_initialized,
+    process_count,
+    process_index,
+    shutdown,
+)
+from tpuframe.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    best_effort_mesh,
+    make_mesh,
+)
+from tpuframe.parallel.collectives import (  # noqa: F401
+    allgather,
+    allreduce,
+    alltoall,
+    broadcast,
+    cross_replica_mean,
+    ring_permute,
+)

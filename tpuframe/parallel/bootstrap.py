@@ -1,0 +1,113 @@
+"""Process bootstrap — the TPU-native replacement for ``hvd.init()``.
+
+Reference capability (SURVEY.md §4.3): ``hvd.init()`` starts Horovod's C++
+background thread, exchanges rank/size/local_rank over MPI or Gloo, and lazily
+creates NCCL communicators.  On TPU none of that machinery exists as user-level
+runtime: ``jax.distributed.initialize()`` performs a GRPC-coordinator
+rendezvous, after which ``jax.devices()`` sees every chip in the slice and the
+XLA runtime owns communicator setup.  This module wraps that in a single
+idempotent call that is a no-op for single-process runs, so the same
+``train.py`` works from a laptop CPU to a multi-host pod (the Horovod property
+the reference leans on).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_STATE = {"initialized": False, "multi_process": False}
+
+
+@dataclass(frozen=True)
+class DistConfig:
+    """Explicit multi-process wiring; every field defaults from the standard
+    env vars the launcher (tpuframe.launch) exports on each worker."""
+
+    coordinator_address: str | None = None  # host:port of process 0
+    num_processes: int | None = None
+    process_id: int | None = None
+    local_device_ids: tuple[int, ...] | None = None
+
+    @classmethod
+    def from_env(cls) -> "DistConfig":
+        def _int(name: str) -> int | None:
+            v = os.environ.get(name)
+            return int(v) if v is not None else None
+
+        return cls(
+            coordinator_address=os.environ.get("TPUFRAME_COORDINATOR"),
+            num_processes=_int("TPUFRAME_NUM_PROCESSES"),
+            process_id=_int("TPUFRAME_PROCESS_ID"),
+        )
+
+
+def initialize(config: DistConfig | None = None) -> None:
+    """Idempotent distributed bootstrap.
+
+    Single-process (no coordinator configured, not on a multi-host TPU): no-op.
+    Multi-process: calls ``jax.distributed.initialize`` so all hosts join one
+    XLA runtime; afterwards ``jax.devices()`` is global and meshes can span
+    the full slice.
+    """
+    if _STATE["initialized"]:
+        return
+    cfg = config or DistConfig.from_env()
+    explicit = cfg.coordinator_address is not None
+    # On Cloud TPU VMs jax.distributed.initialize() can autodetect everything
+    # from the metadata server; TPUFRAME_MULTIHOST=1 opts in to that path.
+    autodetect = os.environ.get("TPUFRAME_MULTIHOST") == "1"
+    if explicit or autodetect:
+        kwargs = {}
+        if explicit:
+            kwargs = dict(
+                coordinator_address=cfg.coordinator_address,
+                num_processes=cfg.num_processes,
+                process_id=cfg.process_id,
+            )
+            if cfg.local_device_ids is not None:
+                kwargs["local_device_ids"] = list(cfg.local_device_ids)
+        jax.distributed.initialize(**kwargs)
+        _STATE["multi_process"] = True
+        logger.info(
+            "distributed initialized: process %d/%d, %d local / %d global devices",
+            jax.process_index(),
+            jax.process_count(),
+            jax.local_device_count(),
+            jax.device_count(),
+        )
+    _STATE["initialized"] = True
+
+
+def is_initialized() -> bool:
+    return _STATE["initialized"]
+
+
+def shutdown() -> None:
+    """Tear down the coordinator channel (used by launcher on clean exit)."""
+    if _STATE["multi_process"]:
+        jax.distributed.shutdown()
+        _STATE["multi_process"] = False
+    _STATE["initialized"] = False
+
+
+def process_index() -> int:
+    """This host's index (== Horovod's node-level rank for the harness's
+    rank-0-gated logging; per-chip rank lives inside compiled programs as
+    ``lax.axis_index``)."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_primary() -> bool:
+    """True on the host that should own logging/eval-summary duties
+    (reference: ``if hvd.rank() == 0`` gates, SURVEY.md §4.4/§5.5)."""
+    return jax.process_index() == 0

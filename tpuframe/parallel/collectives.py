@@ -1,0 +1,259 @@
+"""Collective primitives — XLA replacements for Horovod's op set.
+
+Reference capability (SURVEY.md §3b): Horovod exposes allreduce / allgather /
+broadcast / alltoall, executed by a C++ background runtime over NCCL rings
+with tensor fusion.  Under XLA SPMD none of that is runtime code: these
+helpers trace to ``lax`` collective HLOs inside a compiled program, XLA's
+combiner pass does the fusion (see ``tpuframe.parallel.tuning``), and the TPU
+ICI torus provides bandwidth-optimal routing in hardware.
+
+Two usage modes, mirroring how the reference uses Horovod:
+  - inside a ``shard_map``-ed step function (per-grad allreduce, metric
+    averaging) — call these directly with an axis name;
+  - at the harness level on host values (eval metric averaging, parameter
+    broadcast at init) — use ``cross_replica_mean`` / ``host_broadcast`` which
+    jit a tiny collective program over a mesh.
+
+Axis names may be a single name or a tuple (e.g. ``("data", "fsdp")``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuframe.parallel import mesh as mesh_lib
+
+AxisName = str | Sequence[str]
+PyTree = Any
+
+
+def _bound_axes(axis: AxisName) -> tuple[str, ...]:
+    """The subset of ``axis`` names bound by an enclosing shard_map/pmap trace.
+
+    Collectives here reduce over whichever requested axes exist, so the same
+    step function runs under a full mesh, a pmap with only ``data`` bound, or
+    completely unmapped (single-process config 1) — the laptop-to-pod property
+    the reference gets from Horovod's size()==1 no-op mode.
+    """
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    bound = []
+    for n in names:
+        try:
+            lax.axis_size(n)
+        except NameError:
+            continue
+        bound.append(n)
+    return tuple(bound)
+
+
+def _in_mapped_context(axis: AxisName) -> bool:
+    """True when every name in ``axis`` is bound by an enclosing trace."""
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    return len(_bound_axes(names)) == len(names)
+
+
+def allreduce(x: PyTree, axis: AxisName = "data", *, average: bool = True) -> PyTree:
+    """Sum (or mean) a pytree across the mapped axis.
+
+    Reference parity: ``hvd.allreduce(tensor, average=True)`` (SURVEY.md §3a
+    "Distributed glue").  Degrades to identity when the axis is not bound —
+    so the same step function runs unmapped in config 1's single-process mode
+    (SURVEY.md §7 build order step 1).
+    """
+    bound = _bound_axes(axis)
+    if not bound:
+        return x
+    op = lax.pmean if average else lax.psum
+    return jax.tree.map(lambda t: op(t, bound), x)
+
+
+def average_gradients(grads: PyTree, axis: AxisName = "data") -> PyTree:
+    """Make ``grads`` the cross-replica *average* regardless of how they were
+    produced.
+
+    Two arrival states inside a shard_map trace (jax's vma semantics):
+      - varying leaves (grad of a per-shard loss w.r.t. ``pvary``-ed params,
+        or hand-built values): need an explicit ``pmean``;
+      - unvarying leaves (grad w.r.t. replicated params — autodiff's transpose
+        of the implicit pbroadcast already inserted the ``psum``): the sum is
+        done; divide by the world size.
+
+    This is the exact semantic of Horovod's averaged grad allreduce, which is
+    why ``hvd.DistributedOptimizer`` routes through here (SURVEY.md §4.1).
+    """
+    names = _bound_axes(axis)
+    if not names:
+        return grads
+
+    def _avg(g):
+        vma = jax.typeof(g).vma
+        varying = [a for a in names if a in vma]
+        presummed = [a for a in names if a not in vma]
+        out = lax.pmean(g, varying) if varying else g
+        size_presummed = 1
+        for name in presummed:
+            size_presummed *= lax.axis_size(name)
+        return out / size_presummed if size_presummed > 1 else out
+
+    return jax.tree.map(_avg, grads)
+
+
+def sum_gradients(grads: PyTree, axis: AxisName = "data") -> PyTree:
+    """Cross-replica *sum* with the same vma-awareness as
+    ``average_gradients``: pre-summed (unvarying) leaves pass through instead
+    of being double-counted by another psum."""
+    names = _bound_axes(axis)
+    if not names:
+        return grads
+
+    def _sum(g):
+        vma = jax.typeof(g).vma
+        varying = [a for a in names if a in vma]
+        return lax.psum(g, varying) if varying else g
+
+    return jax.tree.map(_sum, grads)
+
+
+def allgather(x: jax.Array, axis: AxisName = "data", *, tiled: bool = True) -> jax.Array:
+    """Concatenate each shard's value along dim 0 (Horovod allgather).
+    Unmapped (world of 1): identity, matching the other collectives'
+    single-process no-op contract."""
+    bound = _bound_axes(axis)
+    if not bound:
+        return x
+    return lax.all_gather(x, bound, axis=0, tiled=tiled)
+
+
+def broadcast(x: PyTree, axis: AxisName = "data", *, root: int = 0) -> PyTree:
+    """Every member takes root's value (Horovod broadcast).
+
+    Implemented as select+psum rather than a dedicated HLO: XLA pattern-matches
+    this to a broadcast-like collective, and it stays differentiable.
+    """
+    bound = _bound_axes(axis)
+    if not bound:
+        return x
+    if len(bound) == 1:
+        idx = lax.axis_index(bound[0])
+    else:
+        # Linearized index over the bound axes, row-major.
+        idx = jnp.zeros((), jnp.int32)
+        for name in bound:
+            idx = idx * lax.axis_size(name) + lax.axis_index(name)
+
+    def _bcast(t):
+        masked = jnp.where(idx == root, t, jnp.zeros_like(t))
+        return lax.psum(masked, bound)
+
+    return jax.tree.map(_bcast, x)
+
+
+def alltoall(x: jax.Array, axis: AxisName = "data", *, split_axis: int = 0,
+             concat_axis: int = 0) -> jax.Array:
+    """Horovod alltoall: scatter dim ``split_axis``, gather along ``concat_axis``.
+
+    On TPU this lowers to the ICI AllToAll used by sequence/expert parallelism
+    (kept first-class so a seq/expert axis can ride it later, SURVEY.md §5.7).
+    Unmapped: identity (a 1-member alltoall is a copy).
+    """
+    bound = _bound_axes(axis)
+    if not bound:
+        return x
+    return lax.all_to_all(x, bound, split_axis=split_axis, concat_axis=concat_axis,
+                          tiled=True)
+
+
+def ring_permute(x: jax.Array, axis: AxisName = "data", *, shift: int = 1) -> jax.Array:
+    """Send each shard to its ring neighbor (basis of ring-attention-style
+    pipelining; maps to CollectivePermute on neighbor ICI links).
+    Unmapped: identity (a 1-ring permute is a self-send)."""
+    bound = _bound_axes(axis)
+    if not bound:
+        return x
+    if len(bound) != 1:
+        raise ValueError(f"ring_permute needs exactly one axis, got {bound}")
+    n = lax.axis_size(bound[0])
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, bound[0], perm=perm)
+
+
+def reduce_scatter(x: jax.Array, axis: AxisName = "data", *, scatter_axis: int = 0,
+                   average: bool = False) -> jax.Array:
+    """psum_scatter — the building block of sharded-optimizer updates
+    (cross-replica weight-update sharding, PAPERS.md:5).
+    Unmapped: identity (reduce over a world of 1)."""
+    bound = _bound_axes(axis)
+    if not bound:
+        return x
+    out = lax.psum_scatter(x, bound, scatter_dimension=scatter_axis, tiled=True)
+    if average:
+        n = 1
+        for name in bound:
+            n *= lax.axis_size(name)
+        out = out / n
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host-level (outside shard_map) collectives over a mesh
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _stacked_mean(x: PyTree) -> PyTree:
+    return jax.tree.map(lambda t: jnp.mean(t, axis=0), x)
+
+
+def cross_replica_mean(tree: PyTree, mesh: Mesh) -> PyTree:
+    """Average per-host scalar metrics across the data axis of ``mesh``.
+
+    Reference parity: the eval-loop ``hvd.allreduce(metric_tensor)`` one-shot
+    collective (SURVEY.md §4.5).  Values are placed sharded over the batch
+    axes and mean-reduced inside a tiny jitted program.
+    """
+    axes = mesh_lib.BATCH_AXES
+    dp = mesh_lib.data_parallel_size(mesh)
+    sharding = NamedSharding(mesh, P(axes))
+
+    def _stack(leaf):
+        leaf = jnp.asarray(leaf)
+        stacked = jnp.broadcast_to(leaf[None], (dp, *leaf.shape))
+        return jax.device_put(stacked, sharding)
+
+    # NOTE: each host contributes identical replicas here; for genuinely
+    # per-host values use `multihost_utils` style gather (launch layer).
+    return _stacked_mean(jax.tree.map(_stack, tree))
+
+
+def host_broadcast(tree: PyTree, mesh: Mesh) -> PyTree:
+    """Replicate host-0-computed values onto every device of the mesh
+    (reference parity: ``hvd.broadcast_parameters`` from rank 0 at start,
+    SURVEY.md §4.1).  Under SPMD every process must call this with the same
+    structure; data content is taken from the fully-replicated device copy."""
+    sharding = mesh_lib.replicated_sharding(mesh)
+    return jax.tree.map(lambda t: jax.device_put(t, sharding), tree)
+
+
+def device_count(axis_env_size: int | None = None) -> int:
+    return axis_env_size or jax.device_count()
+
+
+def psum_scalar(value: float | jax.Array, axis: AxisName = "data") -> jax.Array:
+    """Scalar psum usable in metric dicts inside step functions."""
+    if not _in_mapped_context(axis):
+        return jnp.asarray(value)
+    return lax.psum(jnp.asarray(value), axis)
+
+
+def global_norm(tree: PyTree, axis: AxisName | None = None) -> jax.Array:
+    """L2 norm of a pytree; if ``axis`` given, the norm of the *global*
+    (allreduced) gradient — used by grad-clipping parity with the reference's
+    pre-allreduce clipping semantics."""
+    sq = sum(jnp.sum(jnp.square(t)) for t in jax.tree.leaves(tree))
+    if axis is not None and _in_mapped_context(axis):
+        sq = lax.psum(sq, axis)
+    return jnp.sqrt(sq)

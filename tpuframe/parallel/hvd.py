@@ -1,0 +1,160 @@
+"""``tpuframe.parallel.hvd`` — a Horovod-compatible facade.
+
+The reference's entire distributed API surface is the handful of
+``horovod.torch`` calls named in SURVEY.md §3a "Distributed glue":
+
+    hvd.init(); hvd.size(); hvd.rank(); hvd.local_rank()
+    hvd.allreduce(t, average=True)
+    hvd.broadcast_parameters(state_dict, root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    opt = hvd.DistributedOptimizer(opt, named_parameters=...)
+
+This module provides the same verbs with TPU-native semantics so a reference
+user can port ``train.py`` mechanically.  The key semantic shift: Horovod has
+one rank space (one process per GPU); SPMD JAX has two. ``size()`` is the
+GLOBAL CHIP COUNT — the LR-scaling denominator, Horovod's ``hvd.size()``
+equivalent. ``rank()`` is the HOST/process index — use it only for
+rank-0-gated logging and per-host data sharding (pair it with
+``jax.process_count()``, not ``size()``). The per-chip rank inside a step
+function is the mesh position bound by ``shard_map`` (``lax.axis_index``).
+
+``DistributedOptimizer`` wraps an optax GradientTransformation and performs
+the gradient averaging Horovod did in its C++ runtime — but as a traced
+``pmean`` that XLA fuses/overlaps (SURVEY.md §2 L1 mapping).  When the step is
+not mapped (config 1, single process), it is the identity wrapper, matching
+``hvd``'s behavior with size()==1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import optax
+
+from tpuframe.parallel import bootstrap, collectives
+from tpuframe.parallel import mesh as mesh_lib
+
+PyTree = Any
+
+_DEFAULT_AXIS = mesh_lib.BATCH_AXES  # grads reduce over all batch-like axes
+
+
+def init(config: bootstrap.DistConfig | None = None) -> None:
+    """Reference parity: ``hvd.init()`` (SURVEY.md §4.3)."""
+    bootstrap.initialize(config)
+
+
+def size() -> int:
+    """Global device count — the LR-scaling denominator the reference uses
+    (``scale LR by hvd.size()``, SURVEY.md §3a)."""
+    return jax.device_count()
+
+
+def rank() -> int:
+    """Host/process index — NOT the chip index; pair with
+    ``jax.process_count()`` for host-level sharding. Per-chip rank inside a
+    step fn is ``lax.axis_index``."""
+    return jax.process_index()
+
+
+def local_rank() -> int:
+    """Reference used this to pin a GPU; on TPU device pinning is automatic,
+    kept for port compatibility (always 0 within a host's first device)."""
+    return 0
+
+
+def local_size() -> int:
+    return jax.local_device_count()
+
+
+def is_primary() -> bool:
+    return bootstrap.is_primary()
+
+
+def allreduce(tensor: PyTree, average: bool = True, name: str | None = None,
+              axis=_DEFAULT_AXIS) -> PyTree:
+    """``hvd.allreduce`` — inside a mapped step fn this is a traced collective;
+    outside, identity (single-host value already global under SPMD)."""
+    del name  # Horovod used names for its fusion table; XLA needs none.
+    return collectives.allreduce(tensor, axis=axis, average=average)
+
+
+def broadcast_parameters(params: PyTree, root_rank: int = 0, axis=_DEFAULT_AXIS) -> PyTree:
+    """``hvd.broadcast_parameters`` — under SPMD initialization, parameters are
+    created identically on every chip from a shared PRNG key, so the broadcast
+    is only needed when a caller deliberately diverged state; we honor the
+    call inside mapped contexts and no-op otherwise."""
+    return collectives.broadcast(params, axis=axis, root=root_rank)
+
+
+def broadcast_optimizer_state(opt_state: PyTree, root_rank: int = 0,
+                              axis=_DEFAULT_AXIS) -> PyTree:
+    return collectives.broadcast(opt_state, axis=axis, root=root_rank)
+
+
+class _DistState(NamedTuple):
+    inner: Any
+
+
+def DistributedOptimizer(
+    tx: optax.GradientTransformation,
+    *,
+    axis=_DEFAULT_AXIS,
+    average: bool = True,
+    compression: str | None = None,
+) -> optax.GradientTransformation:
+    """Wrap ``tx`` so updates see cross-replica-averaged gradients.
+
+    Reference parity: ``hvd.DistributedOptimizer`` hooks ``loss.backward()``'s
+    per-grad callbacks to enqueue async fused NCCL allreduces and waits in
+    ``opt.step()`` (SURVEY.md §4.1 hot loop).  Under XLA the entire step is one
+    program: the ``pmean`` below is scheduled/overlapped with backward compute
+    by the compiler, which is the same overlap Horovod implements by hand.
+
+    ``compression``: None or "bf16", mirroring Horovod's fp16 gradient
+    compression option — gradients are cast down for the wire and restored
+    after reduction (EQuARX-style quantized allreduce is the further step,
+    PAPERS.md:7).
+    """
+
+    def init_fn(params):
+        return _DistState(inner=tx.init(params))
+
+    def update_fn(grads, state, params=None, **extra):
+        grads, orig_dtypes = _maybe_compress(grads, compression)
+        # vma-aware: reduces varying leaves, passes through already-psum'd
+        # ones (gradients of replicated params arrive pre-summed under jax's
+        # shard_map autodiff) — see collectives.average_gradients.
+        if average:
+            grads = collectives.average_gradients(grads, axis=axis)
+        else:
+            grads = collectives.sum_gradients(grads, axis=axis)
+        grads = _maybe_decompress(grads, orig_dtypes)
+        updates, inner = tx.update(grads, state.inner, params, **extra)
+        return updates, _DistState(inner=inner)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _maybe_compress(grads: PyTree, compression: str | None):
+    """Cast float32 leaves down for the reduction; returns the original
+    dtypes so decompression restores exactly what arrived (bf16-native
+    gradients stay bf16 throughout)."""
+    if compression is None:
+        return grads, None
+    if compression == "bf16":
+        import jax.numpy as jnp
+
+        orig_dtypes = jax.tree.map(lambda g: g.dtype, grads)
+        compressed = jax.tree.map(
+            lambda g: g.astype(jnp.bfloat16) if g.dtype == jnp.float32 else g, grads
+        )
+        return compressed, orig_dtypes
+    raise ValueError(f"unknown compression {compression!r}")
+
+
+def _maybe_decompress(grads: PyTree, orig_dtypes: PyTree | None) -> PyTree:
+    if orig_dtypes is None:
+        return grads
+    return jax.tree.map(lambda g, dt: g.astype(dt), grads, orig_dtypes)

@@ -1,0 +1,187 @@
+"""Compiled SPMD train/eval steps — the TPU-native hot loop.
+
+Reference hot loop (SURVEY.md §4.1): forward → backward with per-grad hooks
+enqueueing async NCCL allreduces into Horovod's C++ op queue → fusion →
+``opt.step()`` waits on handles.  On TPU the whole step is ONE XLA program:
+grads are ``pmean``-ed inside the traced function, and the compiler does the
+ordering, fusion (all-reduce combining) and compute/communication overlap
+that Horovod's runtime did by hand.  The only per-step host work left is
+feeding the next sharded batch (``tpuframe.data``) and reading back metrics —
+exactly the mapping called out in SURVEY.md §2 (L1 row).
+
+Two step-construction modes:
+  - ``shard_map`` (default): explicit per-shard code + explicit ``pmean`` —
+    the closest analog of Horovod's explicit allreduce, with no surprises.
+  - ``jit`` (auto-SPMD): sharding propagation inserts the collectives; same
+    semantics, exercised in tests to cross-check the explicit path.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuframe.parallel import mesh as mesh_lib
+
+PyTree = Any
+
+# loss_fn(params, model_state, batch, rng) -> (loss, (new_model_state, metrics))
+LossFn = Callable[[PyTree, PyTree, PyTree, jax.Array], tuple[jax.Array, tuple[PyTree, dict]]]
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    """Replicated training state. ``model_state`` carries mutable collections
+    (BatchNorm statistics for the ResNets); empty dict for stateless models."""
+
+    step: jax.Array
+    params: PyTree
+    opt_state: PyTree
+    model_state: PyTree
+    rng: jax.Array
+
+    @classmethod
+    def create(cls, params: PyTree, tx: optax.GradientTransformation,
+               model_state: PyTree | None = None, rng: jax.Array | None = None):
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            model_state={} if model_state is None else model_state,
+            rng=jax.random.key(0) if rng is None else rng,
+        )
+
+
+def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
+               axes: tuple[str, ...] | None, state: TrainState, batch: PyTree):
+    """Shared body for both modes. ``axes`` bound ⇒ explicit collectives."""
+    step_rng = jax.random.fold_in(state.rng, state.step)
+    if axes:
+        # Decorrelate per-replica dropout while keeping params in lockstep.
+        for ax in axes:
+            step_rng = jax.random.fold_in(step_rng, lax.axis_index(ax))
+
+    # The reference's raison d'être: synchronous gradient averaging.
+    # Horovod: per-tensor async NCCL ring-allreduce with fusion buffer.
+    # Here: the *global* (pmean-ed) loss is what gets differentiated, so the
+    # autodiff transpose emits the cross-replica reduction of the gradients
+    # (params are replicated/unvarying, so d(pmean ℓ)/dθ = psum(∂ℓᵢ/∂θ)/N —
+    # exactly Horovod's averaged allreduce).  XLA's all-reduce combiner fuses
+    # the per-leaf reductions and the scheduler overlaps them with remaining
+    # backward compute (SURVEY.md §3b).
+    def global_loss(params, model_state, batch, rng):
+        loss, aux = loss_fn(params, model_state, batch, rng)
+        if axes:
+            loss = lax.pmean(loss, axes)
+        return loss, aux
+
+    (loss, (model_state, metrics)), grads = jax.value_and_grad(
+        global_loss, has_aux=True)(state.params, state.model_state, batch, step_rng)
+
+    if axes:
+        metrics = jax.tree.map(lambda m: lax.pmean(m, axes), metrics)
+        # BatchNorm running stats: cross-replica averaged so the replicated
+        # state stays single-valued (reference kept per-GPU local stats and
+        # checkpointed rank 0's — averaging is the SPMD-correct equivalent).
+        model_state = jax.tree.map(lambda s: lax.pmean(s, axes), model_state)
+
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    metrics = dict(metrics)
+    metrics["loss"] = loss
+    metrics["grad_norm"] = optax.global_norm(grads)
+    new_state = TrainState(step=state.step + 1, params=params,
+                           opt_state=opt_state, model_state=model_state,
+                           rng=state.rng)
+    return new_state, metrics
+
+
+def make_train_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    mesh: Mesh | None = None,
+    *,
+    mode: str = "shard_map",
+    donate: bool = True,
+):
+    """Build the compiled train step.
+
+    ``mesh=None`` → single-device jit (config 1, SURVEY.md §7 step 1): same
+    body, no collectives — the property the reference gets from Horovod's
+    size()==1 no-op mode.
+    """
+    if mesh is None:
+        body = functools.partial(_grad_step, loss_fn, tx, None)
+        return jax.jit(body, donate_argnums=(0,) if donate else ())
+
+    # Reduce over every batch-like axis, including size-1 ones: a size-1 pmean
+    # is free after compilation but tells shard_map's replication checker the
+    # outputs are single-valued across those axes.
+    axes = mesh_lib.BATCH_AXES
+    repl = NamedSharding(mesh, P())
+    batch_sh = mesh_lib.batch_sharding(mesh)
+
+    if mode == "jit":
+        # Auto-SPMD: annotate shardings, let the partitioner insert collectives.
+        body = functools.partial(_grad_step, loss_fn, tx, None)
+        return jax.jit(
+            body,
+            in_shardings=(repl, batch_sh),
+            out_shardings=(repl, repl),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    if mode != "shard_map":
+        raise ValueError(f"unknown step mode {mode!r}")
+
+    body = functools.partial(_grad_step, loss_fn, tx, axes)
+    batch_spec = mesh_lib.batch_spec()
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), batch_spec),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(
+    metric_fn: Callable[[PyTree, PyTree, PyTree], dict],
+    mesh: Mesh | None = None,
+):
+    """Forward-only step with cross-replica metric averaging.
+
+    Reference parity: eval loop + one small ``hvd.allreduce`` per metric
+    (SURVEY.md §4.5).  ``metric_fn(params, model_state, batch) -> dict`` must
+    return *mean-able* values (sums should be divided locally; weights equal).
+    """
+    if mesh is None:
+        return jax.jit(lambda s, b: metric_fn(s.params, s.model_state, b))
+
+    axes = mesh_lib.BATCH_AXES
+
+    def body(state: TrainState, batch: PyTree) -> dict:
+        metrics = metric_fn(state.params, state.model_state, batch)
+        return jax.tree.map(lambda m: lax.pmean(m, axes), metrics)
+
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), mesh_lib.batch_spec()),
+        out_specs=P(),
+    )
+    return jax.jit(mapped)
+
+
+def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place state replicated on the mesh (reference parity with the rank-0
+    ``broadcast_parameters`` at startup, SURVEY.md §4.1 — under SPMD this is a
+    device_put with a replicated sharding, no network broadcast needed)."""
+    repl = mesh_lib.replicated_sharding(mesh)
+    return jax.tree.map(lambda t: jax.device_put(t, repl), state)
