@@ -1,0 +1,16 @@
+"""Checkpoint-to-bucket save/restore (L3) — SURVEY.md §4.4.
+
+Reference flow: rank 0 ``torch.save``s to local disk, uploads to GCS, and on
+resume broadcasts restored state to all ranks.  TPU-native flow implemented
+here: every host writes exactly the array shards it owns straight to the
+(bucket) path in parallel — no rank-0 bottleneck — and restore reassembles
+with *resharding*, so an 8-chip checkpoint restores onto 32 chips and back
+(SURVEY.md §7 hard part 3).
+"""
+
+from tpuframe.ckpt.checkpoint import (  # noqa: F401
+    CheckpointManager,
+    latest_step,
+    restore,
+    save,
+)

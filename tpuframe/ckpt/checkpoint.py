@@ -1,0 +1,333 @@
+"""Sharded, reshardable checkpointing with integrity checks.
+
+Layout of one checkpoint (all paths may be ``gs://`` URIs):
+
+    <dir>/step_00000100/
+        manifest.json           # treedef, per-leaf shape/dtype/partition-spec,
+                                # shard table, CRC32 per file, framework version
+        <leaf>.shard_<i>.npy    # raw shard bytes (np.save format)
+        COMMIT                  # written last; a checkpoint without it is torn
+
+Save: each host serializes only the addressable shards it owns (one writer
+per distinct shard — the process holding the shard's first replica), so pod
+saves parallelize across hosts with no cross-host traffic (reference contrast:
+rank-0 torch.save + upload, SURVEY.md §4.4).
+
+Restore: shards are read and placed per-device for the *target* sharding.
+The source mesh size does not need to match — restoring an 8-chip checkpoint
+onto a 32-chip mesh reassembles from the shard table (SURVEY.md §7 hard
+part 3: "restore 8-chip ckpt on 32 chips").
+
+Integrity: CRC32 of every shard file is recorded in the manifest and verified
+on restore (tpuframe.ops.native provides a C++ CRC32 for large files; zlib is
+the fallback).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import re
+import zlib
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuframe.data import gcs
+
+PyTree = Any
+
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+_MANIFEST = "manifest.json"
+_COMMIT = "COMMIT"
+
+
+def _crc32(data: bytes) -> int:
+    try:
+        from tpuframe.ops import native
+
+        return native.crc32(data)
+    except Exception:
+        return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _flatten_with_paths(tree: PyTree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    names = ["/".join(_path_str(k) for k in path) for path, _ in flat]
+    leaves = [leaf for _, leaf in flat]
+    return names, leaves, treedef
+
+
+def _path_str(key) -> str:
+    if hasattr(key, "key"):
+        return str(key.key)
+    if hasattr(key, "idx"):
+        return str(key.idx)
+    if hasattr(key, "name"):
+        return str(key.name)
+    return str(key)
+
+
+def _spec_of(leaf) -> list:
+    sharding = getattr(leaf, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        out = []
+        for entry in sharding.spec:
+            if entry is None:
+                out.append(None)
+            elif isinstance(entry, (tuple, list)):
+                out.append(list(entry))
+            else:
+                out.append([entry])
+        return out
+    return []
+
+
+def save(directory: str, step: int, tree: PyTree) -> str:
+    """Write one checkpoint; returns its path. Collective: every process must
+    call it (each writes the shards it owns)."""
+    path = gcs.join(directory, f"step_{step:08d}")
+    gcs.makedirs(path)
+    names, leaves, treedef = _flatten_with_paths(tree)
+
+    del treedef  # structure is recorded as the ordered leaf-name list; restore
+    # rebuilds via the caller's target tree (exact classes) or a nested dict.
+    manifest: dict = {
+        "version": 1,
+        "step": step,
+        "leaf_order": names,
+        "leaves": {},
+        "crc": {},
+    }
+
+    crc_local: dict[str, int] = {}
+    for name, leaf in zip(names, leaves):
+        arr = leaf if isinstance(leaf, jax.Array) else jnp_asarray(leaf)
+        prng_impl = None
+        if jax.dtypes.issubdtype(arr.dtype, jax.dtypes.prng_key):
+            prng_impl = str(jax.random.key_impl(arr))
+            arr = jax.random.key_data(arr)
+        # Every host computes the same global shard table; each host writes
+        # only the files whose shard it owns (lowest-device-id replica).
+        table, owned = _shard_table(arr, _sanitize(name))
+        entry = {
+            "shape": list(arr.shape),
+            "dtype": _dtype_str(arr),
+            "spec": _spec_of(arr),
+            "shards": table,
+        }
+        if prng_impl is not None:
+            entry["prng_impl"] = prng_impl
+        for fname, data in owned:
+            buf = io.BytesIO()
+            np.save(buf, data)
+            raw = buf.getvalue()
+            gcs.write_bytes(gcs.join(path, fname), raw)
+            crc_local[fname] = _crc32(raw)
+        manifest["leaves"][name] = entry
+
+    # CRCs are per-file and known only to the writer; persist per-host CRC
+    # sidecars, merged into the manifest by process 0 after the barrier.
+    gcs.write_bytes(gcs.join(path, f"crc_{jax.process_index()}.json"),
+                    json.dumps(crc_local).encode())
+    _barrier()
+    if jax.process_index() == 0:
+        crc: dict[str, int] = {}
+        for i in range(jax.process_count()):
+            crc.update(json.loads(
+                gcs.read_bytes(gcs.join(path, f"crc_{i}.json"))))
+        manifest["crc"] = crc
+        gcs.write_bytes(gcs.join(path, _MANIFEST),
+                        json.dumps(manifest, indent=1).encode())
+        gcs.write_bytes(gcs.join(path, _COMMIT), b"ok")
+    return path
+
+
+def jnp_asarray(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)
+
+
+def _dtype_str(arr) -> str:
+    return str(np.dtype(arr.dtype))
+
+
+def _sanitize(name: str) -> str:
+    return name.replace("/", ".")
+
+
+def _shard_table(arr, base: str):
+    """(manifest shard table, [(fname, np data) this process writes]).
+
+    The table is identical on every host (deterministic ordering by index);
+    ownership = the shard replica on the lowest device id, so exactly one
+    host writes each file.
+    """
+    if not isinstance(arr, jax.Array) or not hasattr(arr, "global_shards"):
+        data = np.asarray(arr)
+        fname = f"{base}.shard_0.npy"
+        return ([{"id": 0, "index": None, "file": fname}],
+                [(fname, data)] if jax.process_index() == 0 else [])
+    by_index: dict = {}
+    for shard in arr.global_shards:
+        key = _index_key(shard.index, arr.shape)
+        owner = by_index.get(key)
+        if owner is None or shard.device.id < owner.device.id:
+            by_index[key] = shard
+    table, owned = [], []
+    for shard_id, (key, shard) in enumerate(sorted(by_index.items())):
+        fname = f"{base}.shard_{shard_id}.npy"
+        table.append({"id": shard_id, "index": key, "file": fname})
+        if shard.device.process_index == jax.process_index():
+            local = next(s for s in arr.addressable_shards
+                         if _index_key(s.index, arr.shape) == key)
+            owned.append((fname, np.asarray(local.data)))
+    return table, owned
+
+
+def _index_key(index, shape) -> tuple:
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else sl.start
+        stop = dim if sl.stop is None else sl.stop
+        out.append((int(start), int(stop)))
+    return tuple(out)
+
+
+def restore(directory: str, step: int, *, mesh: Mesh | None = None,
+            target: PyTree | None = None, verify_crc: bool = True) -> PyTree:
+    """Load a checkpoint, placing leaves per ``target``'s shardings (or
+    replicated on ``mesh``; or as host numpy when both are None)."""
+    path = gcs.join(directory, f"step_{step:08d}")
+    if not gcs.exists(gcs.join(path, _COMMIT)):
+        raise FileNotFoundError(f"no committed checkpoint at {path}")
+    manifest = json.loads(gcs.read_bytes(gcs.join(path, _MANIFEST)))
+    saved_names = manifest["leaf_order"]
+
+    def _placed(name: str, tgt) -> Any:
+        entry = manifest["leaves"][name]
+        arr = _assemble(path, entry, manifest["crc"], verify_crc)
+        arr = arr.astype(np.dtype(entry["dtype"]), copy=False)
+        if "prng_impl" in entry:
+            key = jax.random.wrap_key_data(jnp_asarray(arr),
+                                           impl=entry["prng_impl"])
+            if tgt is not None and hasattr(tgt, "sharding"):
+                key = jax.device_put(key, tgt.sharding)
+            return key
+        if tgt is not None and hasattr(tgt, "sharding"):
+            # Reshard onto the target's (possibly different-size) mesh.
+            return jax.device_put(arr, tgt.sharding)
+        if mesh is not None:
+            spec = P(*[tuple(e) if e else None for e in entry["spec"]]) \
+                if entry["spec"] else P()
+            return jax.device_put(arr, NamedSharding(mesh, spec))
+        return arr
+
+    if target is not None:
+        # Exact structure (incl. registered dataclasses like TrainState)
+        # comes from the caller's abstract/concrete target tree.
+        tgt_names, tgt_leaves, treedef = _flatten_with_paths(target)
+        if set(tgt_names) != set(saved_names):
+            missing = set(tgt_names) - set(saved_names)
+            extra = set(saved_names) - set(tgt_names)
+            raise ValueError(
+                f"checkpoint/target structure mismatch; missing={sorted(missing)} "
+                f"extra={sorted(extra)}")
+        leaves = [_placed(name, tgt) for name, tgt in zip(tgt_names, tgt_leaves)]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    # No target: rebuild a nested dict from the saved leaf paths.
+    out: dict = {}
+    for name in saved_names:
+        node = out
+        parts = name.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = _placed(name, None)
+    return out
+
+
+def _assemble(path: str, entry: dict, crcs: dict, verify_crc: bool) -> np.ndarray:
+    shape = tuple(entry["shape"])
+    dtype = np.dtype(entry["dtype"])
+    shards = entry["shards"] if entry["shards"] else []
+    if not shards:
+        raise FileNotFoundError(f"manifest entry has no shard files: {entry}")
+    first = _load_shard(path, shards[0]["file"], crcs, verify_crc)
+    if shards[0]["index"] is None or first.shape == shape:
+        return first
+    out = np.empty(shape, dtype)
+    for sh in shards:
+        data = _load_shard(path, sh["file"], crcs, verify_crc)
+        slices = tuple(slice(lo, hi) for lo, hi in sh["index"])
+        out[slices] = data
+    return out
+
+
+def _load_shard(path: str, fname: str, crcs: dict, verify_crc: bool) -> np.ndarray:
+    raw = gcs.read_bytes(gcs.join(path, fname))
+    if verify_crc and fname in crcs and _crc32(raw) != crcs[fname]:
+        raise IOError(f"CRC mismatch in checkpoint shard {fname} — corrupt file")
+    return np.load(io.BytesIO(raw), allow_pickle=False)
+
+
+def _barrier() -> None:
+    """Cross-host sync so COMMIT is written only after every host's shards."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("tpuframe_ckpt_commit")
+
+
+def latest_step(directory: str) -> int | None:
+    steps = []
+    for name in gcs.listdir(directory):
+        m = _STEP_RE.match(name)
+        if m and gcs.exists(gcs.join(directory, name, _COMMIT)):
+            steps.append(int(m.group(1)))
+    return max(steps) if steps else None
+
+
+class CheckpointManager:
+    """Periodic save + retention + resume-latest (reference parity: the
+    checkpoint hooks + resume-from-bucket path, SURVEY.md §3a/§4.4)."""
+
+    def __init__(self, directory: str, *, every_steps: int = 1000,
+                 keep: int = 3):
+        self.directory = directory
+        self.every_steps = every_steps
+        self.keep = keep
+        gcs.makedirs(directory)
+
+    def should_save(self, step: int) -> bool:
+        return step > 0 and step % self.every_steps == 0
+
+    def save(self, step: int, tree: PyTree) -> str:
+        path = save(self.directory, step, tree)
+        self._gc()
+        return path
+
+    def maybe_save(self, step: int, tree: PyTree) -> str | None:
+        return self.save(step, tree) if self.should_save(step) else None
+
+    def restore_latest(self, *, mesh: Mesh | None = None,
+                       target: PyTree | None = None):
+        """(step, tree) of the newest committed checkpoint, or None — the
+        automatic resume path for slice-restart recovery (SURVEY.md §5.3)."""
+        step = latest_step(self.directory)
+        if step is None:
+            return None
+        return step, restore(self.directory, step, mesh=mesh, target=target)
+
+    def _gc(self) -> None:
+        if jax.process_index() != 0:
+            return
+        steps = sorted(
+            int(m.group(1))
+            for m in (_STEP_RE.match(n) for n in gcs.listdir(self.directory))
+            if m)
+        for old in steps[:-self.keep] if self.keep > 0 else []:
+            gcs.delete_tree(gcs.join(self.directory, f"step_{old:08d}"))

@@ -1,0 +1,17 @@
+"""Input pipeline (L3) — TPU-native replacement for the reference's GCS data
+loader + DistributedSampler sharding (SURVEY.md §3a).
+
+Per-host dataset sharding (``num_shards=process_count, shard=process_index``)
+replaces the reference's per-rank ``DistributedSampler``; batches land on
+device pre-sharded over the mesh's batch axes via ``ShardedLoader``.
+"""
+
+from tpuframe.data.datasets import (  # noqa: F401
+    ArrayDataset,
+    cifar10,
+    glue_sst2,
+    imagenet,
+    mnist,
+)
+from tpuframe.data.pipeline import ShardedLoader  # noqa: F401
+from tpuframe.data import gcs  # noqa: F401

@@ -1,0 +1,236 @@
+"""Dataset builders for the five reference workloads (SURVEY.md §1, [B:6–12]).
+
+Each builder returns train/eval ``ArrayDataset``s.  Real on-disk formats are
+read when a data directory is provided (MNIST idx files, CIFAR-10 python
+pickles — the formats the reference's torchvision loaders consume); otherwise
+deterministic synthetic data with the same shapes/dtypes is generated, so
+every config runs end-to-end in the zero-egress sandbox and in CI.
+
+Data may live under ``gs://`` paths (read via tpuframe.data.gcs), matching
+the reference's GCS-bucket input pipeline [B:5].
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import pickle
+import struct
+import zlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from tpuframe.data import gcs
+
+
+@dataclass
+class ArrayDataset:
+    """In-memory columnar dataset: dict of equal-length arrays."""
+
+    columns: dict[str, np.ndarray]
+
+    def __post_init__(self):
+        lens = {k: len(v) for k, v in self.columns.items()}
+        if len(set(lens.values())) > 1:
+            raise ValueError(f"ragged columns: {lens}")
+
+    def __len__(self) -> int:
+        return len(next(iter(self.columns.values())))
+
+    def __getitem__(self, idx) -> dict[str, np.ndarray]:
+        return {k: v[idx] for k, v in self.columns.items()}
+
+    def shard(self, num_shards: int, index: int) -> "ArrayDataset":
+        """Contiguous per-host shard (the reference's DistributedSampler
+        ``num_replicas/rank`` split, SURVEY.md §3a)."""
+        if not (0 <= index < num_shards):
+            raise ValueError(f"shard index {index} out of range {num_shards}")
+        n = len(self) // num_shards  # drop remainder: equal shards, SPMD-safe
+        lo = index * n
+        return ArrayDataset({k: v[lo:lo + n] for k, v in self.columns.items()})
+
+
+# ---------------------------------------------------------------------------
+# MNIST — config 1 [B:7]
+# ---------------------------------------------------------------------------
+
+def _read_idx(data: bytes) -> np.ndarray:
+    magic, = struct.unpack(">I", data[:4])
+    ndim = magic & 0xFF
+    dims = struct.unpack(f">{ndim}I", data[4:4 + 4 * ndim])
+    return np.frombuffer(data, np.uint8, offset=4 + 4 * ndim).reshape(dims)
+
+
+def _maybe_gunzip(raw: bytes) -> bytes:
+    return gzip.decompress(raw) if raw[:2] == b"\x1f\x8b" else raw
+
+
+def mnist(data_dir: str | None = None, *, synthetic_size: int = 2048):
+    """[B, 28, 28, 1] float32 in [0,1), int32 labels."""
+    if data_dir is not None:
+        def load(img_name, lbl_name):
+            imgs = _read_idx(_maybe_gunzip(gcs.read_bytes(gcs.join(data_dir, img_name))))
+            lbls = _read_idx(_maybe_gunzip(gcs.read_bytes(gcs.join(data_dir, lbl_name))))
+            x = (imgs.astype(np.float32) / 255.0)[..., None]
+            return ArrayDataset({"image": x, "label": lbls.astype(np.int32)})
+
+        train = load("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz")
+        test = load("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")
+        return train, test
+    return (_synthetic_images(synthetic_size, (28, 28, 1), 10, seed=0),
+            _synthetic_images(max(synthetic_size // 8, 64), (28, 28, 1), 10,
+                              seed=1, template_seed=0))
+
+
+# ---------------------------------------------------------------------------
+# CIFAR-10 — config 2 [B:8]
+# ---------------------------------------------------------------------------
+
+CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+
+def cifar10(data_dir: str | None = None, *, synthetic_size: int = 2048):
+    """[B, 32, 32, 3] float32 normalized, int32 labels.  Reads the python
+    pickle batches of the standard ``cifar-10-batches-py`` layout."""
+    if data_dir is not None:
+        def load(names):
+            xs, ys = [], []
+            for name in names:
+                d = pickle.loads(gcs.read_bytes(gcs.join(data_dir, name)),
+                                 encoding="bytes")
+                xs.append(np.asarray(d[b"data"], np.uint8))
+                ys.append(np.asarray(d[b"labels"], np.int64))
+            x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            x = (x.astype(np.float32) / 255.0 - CIFAR_MEAN) / CIFAR_STD
+            return ArrayDataset({"image": x,
+                                 "label": np.concatenate(ys).astype(np.int32)})
+
+        train = load([f"data_batch_{i}" for i in range(1, 6)])
+        test = load(["test_batch"])
+        return train, test
+    return (_synthetic_images(synthetic_size, (32, 32, 3), 10, seed=2),
+            _synthetic_images(max(synthetic_size // 8, 64), (32, 32, 3), 10,
+                              seed=3, template_seed=2))
+
+
+# ---------------------------------------------------------------------------
+# ImageNet — configs 3 & 5 [B:9][B:11]
+# ---------------------------------------------------------------------------
+
+def imagenet(data_dir: str | None = None, *, image_size: int = 224,
+             synthetic_size: int = 512):
+    """[B, S, S, 3] float32, int32 labels in [0, 1000).
+
+    Real ImageNet arrives as per-host ``.npy`` shards (images_XXXXX.npy /
+    labels_XXXXX.npy) prepared by ``tpuframe.data.prepare_imagenet`` —
+    decoding JPEGs on the training hosts would bottleneck the input pipeline
+    (SURVEY.md §7 hard part 2), so decode/resize happens offline.
+    """
+    if data_dir is not None:
+        names = [n for n in gcs.listdir(data_dir) if n.startswith("images_")]
+        xs = [np.load(io.BytesIO(gcs.read_bytes(gcs.join(data_dir, n))))
+              for n in names]
+        ys = [np.load(io.BytesIO(gcs.read_bytes(gcs.join(data_dir, n.replace("images_", "labels_")))))
+              for n in names]
+        x = np.concatenate(xs)
+        y = np.concatenate(ys).astype(np.int32)
+        split = int(0.99 * len(x))
+        return (ArrayDataset({"image": x[:split], "label": y[:split]}),
+                ArrayDataset({"image": x[split:], "label": y[split:]}))
+    return (_synthetic_images(synthetic_size, (image_size, image_size, 3), 1000, seed=4),
+            _synthetic_images(max(synthetic_size // 8, 64),
+                              (image_size, image_size, 3), 1000,
+                              seed=5, template_seed=4))
+
+
+# ---------------------------------------------------------------------------
+# GLUE (SST-2) — config 4 [B:10]
+# ---------------------------------------------------------------------------
+
+def glue_sst2(data_dir: str | None = None, *, seq_len: int = 128,
+              vocab_size: int = 30522, synthetic_size: int = 1024,
+              tokenizer=None):
+    """Tokenized sentence-classification batches: input_ids / attention_mask /
+    token_type_ids int32 [B, S], label int32.
+
+    With ``data_dir``: reads GLUE's SST-2 tsv files; tokenization uses the
+    provided HF tokenizer (the reference's path) or a hash-based fallback
+    that needs no vocab download.
+    """
+    if data_dir is not None:
+        def load(name):
+            text = gcs.read_bytes(gcs.join(data_dir, name)).decode()
+            lines = text.strip().split("\n")[1:]  # header
+            sents, labels = [], []
+            for line in lines:
+                sent, _, lbl = line.rpartition("\t")
+                sents.append(sent)
+                labels.append(int(lbl))
+            return _tokenize(sents, np.asarray(labels, np.int32), seq_len,
+                             vocab_size, tokenizer)
+
+        return load("train.tsv"), load("dev.tsv")
+    return (_synthetic_tokens(synthetic_size, seq_len, vocab_size, seed=6),
+            _synthetic_tokens(max(synthetic_size // 8, 64), seq_len, vocab_size, seed=7))
+
+
+def _tokenize(sents, labels, seq_len, vocab_size, tokenizer):
+    if tokenizer is not None:
+        enc = tokenizer(sents, padding="max_length", truncation=True,
+                        max_length=seq_len, return_tensors="np")
+        return ArrayDataset({
+            "input_ids": enc["input_ids"].astype(np.int32),
+            "attention_mask": enc["attention_mask"].astype(np.int32),
+            "token_type_ids": enc.get("token_type_ids",
+                                      np.zeros_like(enc["input_ids"])).astype(np.int32),
+            "label": labels,
+        })
+    # Hash-based whitespace tokenizer: deterministic (crc32, not Python's
+    # salted hash — ids must agree across host processes and restarts),
+    # vocab-free. Fine for pipeline/perf work; real GLUE scores need the
+    # WordPiece tokenizer.
+    ids = np.zeros((len(sents), seq_len), np.int32)
+    mask = np.zeros((len(sents), seq_len), np.int32)
+    for i, s in enumerate(sents):
+        toks = [101] + [2 + (zlib.crc32(w.encode()) % (vocab_size - 4))
+                        for w in s.split()][: seq_len - 2] + [102]
+        ids[i, :len(toks)] = toks
+        mask[i, :len(toks)] = 1
+    return ArrayDataset({"input_ids": ids, "attention_mask": mask,
+                         "token_type_ids": np.zeros_like(ids), "label": labels})
+
+
+# ---------------------------------------------------------------------------
+# Synthetic generators (deterministic; shapes/dtypes match the real data)
+# ---------------------------------------------------------------------------
+
+def _synthetic_images(n, shape, num_classes, *, seed, template_seed=None):
+    # A fixed random spatial template per class (high per-pixel SNR) makes the
+    # synthetic task quickly learnable, so convergence tests (loss decreasing,
+    # accuracy rising) are meaningful, not vacuous.  Pixel statistics mimic
+    # real normalized data (mean~0.5, std~0.3 like [0,1) images) — the LR
+    # recipes assume that scale.  ``template_seed`` is shared between the
+    # train and eval splits of one dataset (same classes, different examples)
+    # so eval accuracy actually measures generalization.
+    tmpl_rng = np.random.default_rng(seed if template_seed is None else template_seed)
+    templates = tmpl_rng.normal(0.0, 1.0, size=(num_classes, *shape)).astype(np.float32)
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    noise = rng.normal(0.0, 1.0, size=(n, *shape)).astype(np.float32)
+    x = np.clip(0.5 + 0.25 * templates[labels] + 0.1 * noise, 0.0, 1.0)
+    return ArrayDataset({"image": x.astype(np.float32), "label": labels})
+
+
+def _synthetic_tokens(n, seq_len, vocab_size, *, seed):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 2, size=n).astype(np.int32)
+    ids = rng.integers(4, vocab_size, size=(n, seq_len)).astype(np.int32)
+    # Learnable signal: first token id correlates with the label.
+    ids[:, 0] = 101
+    ids[:, 1] = 200 + labels
+    lengths = rng.integers(seq_len // 2, seq_len + 1, size=n)
+    mask = (np.arange(seq_len)[None, :] < lengths[:, None]).astype(np.int32)
+    return ArrayDataset({"input_ids": ids, "attention_mask": mask,
+                         "token_type_ids": np.zeros_like(ids), "label": labels})

@@ -1,0 +1,99 @@
+"""GCS-or-local filesystem abstraction.
+
+The reference streams training data from a GCS bucket and uploads checkpoints
+to one (SURVEY.md §3a "GCS data loader", §4.4).  This module gives the rest of
+the framework one path API that works on ``gs://bucket/key`` URIs when the
+``google-cloud-storage`` client is importable and on plain local paths always —
+so every pipeline and checkpoint codepath is testable in the zero-egress
+sandbox with local directories standing in for buckets.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from pathlib import Path
+
+
+def is_gcs_path(path: str) -> bool:
+    return str(path).startswith("gs://")
+
+
+def _gcs_client():
+    try:
+        from google.cloud import storage  # type: ignore
+
+        return storage.Client()
+    except Exception as e:
+        raise RuntimeError(
+            "gs:// path used but no usable google-cloud-storage client "
+            "(install it and set up application-default credentials on the "
+            "TPU-VM, or use a local path): " + repr(e)
+        ) from e
+
+
+def _split(path: str) -> tuple[str, str]:
+    rest = path[len("gs://"):]
+    bucket, _, key = rest.partition("/")
+    return bucket, key
+
+
+def read_bytes(path: str) -> bytes:
+    if is_gcs_path(path):
+        bucket, key = _split(path)
+        return _gcs_client().bucket(bucket).blob(key).download_as_bytes()
+    return Path(path).read_bytes()
+
+
+def write_bytes(path: str, data: bytes) -> None:
+    if is_gcs_path(path):
+        bucket, key = _split(path)
+        _gcs_client().bucket(bucket).blob(key).upload_from_string(data)
+        return
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_name(p.name + ".tmp")
+    tmp.write_bytes(data)
+    os.replace(tmp, p)  # atomic on POSIX — no torn checkpoint files
+
+
+def exists(path: str) -> bool:
+    if is_gcs_path(path):
+        bucket, key = _split(path)
+        return _gcs_client().bucket(bucket).blob(key).exists()
+    return Path(path).exists()
+
+
+def listdir(path: str) -> list[str]:
+    """Immediate children (names, not full paths)."""
+    if is_gcs_path(path):
+        bucket, key = _split(path)
+        prefix = key.rstrip("/") + "/" if key else ""
+        it = _gcs_client().list_blobs(bucket, prefix=prefix, delimiter="/")
+        names = [os.path.basename(b.name) for b in it]
+        names += [p.rstrip("/").split("/")[-1] for p in it.prefixes]
+        return sorted(n for n in names if n)
+    p = Path(path)
+    return sorted(os.listdir(p)) if p.exists() else []
+
+
+def makedirs(path: str) -> None:
+    if not is_gcs_path(path):
+        Path(path).mkdir(parents=True, exist_ok=True)
+
+
+def delete_tree(path: str) -> None:
+    if is_gcs_path(path):
+        bucket, key = _split(path)
+        client = _gcs_client()
+        for blob in client.list_blobs(bucket, prefix=key.rstrip("/") + "/"):
+            blob.delete()
+        return
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def join(*parts: str) -> str:
+    if parts and is_gcs_path(parts[0]):
+        return "/".join(p.strip("/") if i else p.rstrip("/")
+                        for i, p in enumerate(parts))
+    return os.path.join(*parts)

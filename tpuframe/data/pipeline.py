@@ -1,0 +1,141 @@
+"""Host→device input pipeline with per-host sharding and prefetch.
+
+Reference path (SURVEY.md §4.1): torch DataLoader worker processes feed
+per-rank batches; each rank's DataLoader holds a DistributedSampler shard.
+TPU-native path: each *host* process iterates its shard of the dataset and
+device_puts batches pre-sharded over the mesh's batch axes, one step ahead of
+compute (double buffering) so infeed overlaps the running step — the role
+Horovod leaves to DataLoader prefetch + CUDA streams.
+
+A C++ prefetch ring (tpuframe.ops.native) backs the ``native_prefetch`` mode
+for the ImageNet-rate pipelines; the pure-Python path is the default and the
+fallback.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from tpuframe.data.datasets import ArrayDataset
+from tpuframe.parallel import mesh as mesh_lib
+
+
+class ShardedLoader:
+    """Iterates epoch-shuffled, host-sharded, device-put batches.
+
+    Parameters
+    ----------
+    dataset: the FULL (logical) dataset; every host passes the same one and
+        takes its shard internally — keeps the call site identical from 1 host
+        to N hosts (the reference's DistributedSampler ergonomics).
+    global_batch: across all chips; each host feeds global/process_count rows.
+    mesh: batches are placed with the mesh's batch-axis sharding; None → plain
+        committed host→device transfer (single-device config 1).
+    """
+
+    def __init__(
+        self,
+        dataset: ArrayDataset,
+        global_batch: int,
+        mesh: Mesh | None = None,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        prefetch: int = 2,
+        shard_by_host: bool = True,
+    ):
+        # The remainder partial batch is always dropped: compiled SPMD steps
+        # need static shapes, and a ragged final batch would both recompile
+        # and shard unevenly. (The reference's DistributedSampler pads or
+        # drops similarly.)
+        self.global_batch = global_batch
+        self.mesh = mesh
+        self.shuffle = shuffle
+        self.seed = seed
+        self.prefetch = prefetch
+
+        n_proc = jax.process_count() if shard_by_host else 1
+        if global_batch % n_proc:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by {n_proc} hosts")
+        self.host_batch = global_batch // n_proc
+        if mesh is not None:
+            dp = mesh_lib.data_parallel_size(mesh)
+            if global_batch % dp:
+                raise ValueError(
+                    f"global batch {global_batch} not divisible by "
+                    f"data-parallel size {dp} (mesh {dict(mesh.shape)})")
+        self.dataset = (dataset.shard(n_proc, jax.process_index())
+                        if shard_by_host and n_proc > 1 else dataset)
+        if len(self.dataset) < self.host_batch:
+            raise ValueError(
+                f"host shard has {len(self.dataset)} examples < host batch "
+                f"{self.host_batch}")
+        self._sharding = (mesh_lib.batch_sharding(mesh)
+                          if mesh is not None else None)
+
+    def steps_per_epoch(self) -> int:
+        return len(self.dataset) // self.host_batch
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        n = len(self.dataset)
+        if not self.shuffle:
+            return np.arange(n)
+        # Same seed on every host + per-epoch fold-in: hosts draw disjoint
+        # shards of one global permutation stream (reference:
+        # DistributedSampler.set_epoch).
+        rng = np.random.default_rng(np.random.SeedSequence([self.seed, epoch]))
+        return rng.permutation(n)
+
+    def epoch(self, epoch: int, *, skip: int = 0) -> Iterator[dict]:
+        """Yield device-put batches for one epoch, ``prefetch`` steps ahead.
+        ``skip``: drop the first N batches without paying device transfer
+        (resume seeking)."""
+        order = self._epoch_order(epoch)
+        buf: collections.deque = collections.deque()
+        starts = range(0, len(order) - self.host_batch + 1, self.host_batch)
+        for lo in list(starts)[skip:]:
+            idx = order[lo:lo + self.host_batch]
+            batch = self.dataset[idx]
+            buf.append(self._to_device(batch))
+            if len(buf) > self.prefetch:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+
+    def from_step(self, step: int) -> Iterator[dict]:
+        """Infinite stream positioned as if ``step`` batches were already
+        consumed — exact-continuation resume (SURVEY.md §5.4 'exact-epoch
+        continuation'): the restored run sees the same remaining data order
+        as an uninterrupted run."""
+        spe = self.steps_per_epoch()
+        epoch, offset = divmod(step, spe)
+        while True:
+            yield from self.epoch(epoch, skip=offset)
+            offset = 0
+            epoch += 1
+
+    def __iter__(self):
+        """Infinite stream across epochs (step-based training loops)."""
+        return self.from_step(0)
+
+    def _to_device(self, batch: dict) -> dict:
+        if self._sharding is None:
+            return jax.tree.map(jax.device_put, batch)
+        # Host rows are this host's slice of the global batch; device_put with
+        # a NamedSharding scatters rows to local devices and (multi-host)
+        # assembles the logically-global array without gathering.
+        return jax.tree.map(
+            lambda x: _put_host_shard(x, self._sharding, self.global_batch), batch)
+
+
+def _put_host_shard(x: np.ndarray, sharding: NamedSharding, global_batch: int):
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    global_shape = (global_batch, *x.shape[1:])
+    return jax.make_array_from_process_local_data(sharding, x, global_shape)

@@ -1,0 +1,41 @@
+"""Model zoo — the reference's workload models, rebuilt in flax.
+
+Reference coverage (SURVEY.md §3a "Model defs", [B:7–10]):
+  - MNIST ConvNet (custom nn.Module in the reference)  → ``convnet.ConvNet``
+  - ResNet-18 / ResNet-50 (torchvision in the reference) → ``resnet``
+  - BERT-base for GLUE (HF transformers in the reference) → ``bert``
+
+All models are NHWC / bf16-compute-capable — the TPU-native layout/dtype
+choices (MXU wants large bf16 matmuls; see task guidance + pallas_guide).
+"""
+
+from typing import Any, Callable
+
+from tpuframe.models.convnet import ConvNet
+from tpuframe.models.resnet import ResNet, ResNet18, ResNet50
+from tpuframe.models.bert import BertConfig, BertForSequenceClassification
+
+_REGISTRY: dict[str, Callable[..., Any]] = {
+    "convnet": ConvNet,
+    "resnet18": ResNet18,
+    "resnet50": ResNet50,
+    "bert-base": BertForSequenceClassification,
+}
+
+
+def get_model(name: str, **kwargs):
+    """Construct a model by registry name (harness entry point)."""
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+__all__ = [
+    "ConvNet",
+    "ResNet",
+    "ResNet18",
+    "ResNet50",
+    "BertConfig",
+    "BertForSequenceClassification",
+    "get_model",
+]

@@ -1,0 +1,201 @@
+"""BERT-base for GLUE fine-tuning — config 4 (SURVEY.md §1, [B:10]).
+
+The reference uses HF ``transformers``' torch BERT; this is a from-scratch
+flax implementation of the same architecture (Devlin et al. 2018: post-LN
+encoder, learned position embeddings, GELU FFN, tanh pooler) so the whole
+compute path is jit-compiled and pallas-swappable.
+
+The config-4 workload exists to stress many-small-tensor gradient allreduce
+(BERT-base has ~200 parameter tensors); in this framework that pressure lands
+on XLA's all-reduce combiner rather than Horovod's fusion buffer — see
+``tpuframe.parallel.tuning``.
+
+The attention core routes through ``tpuframe.ops.attention`` so the pallas
+flash-attention TPU kernel can replace the naive einsum without touching the
+model definition.
+
+``load_hf_weights`` imports a HuggingFace torch checkpoint (the reference's
+starting point for fine-tuning) into this module's parameter tree.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    num_classes: int = 2
+    dtype: str = "float32"  # "bfloat16" for MXU throughput
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @classmethod
+    def base(cls, **kw) -> "BertConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        """4-layer/128-wide config for tests (same graph shape, tiny sizes)."""
+        base = dict(vocab_size=1024, hidden_size=128, num_layers=4,
+                    num_heads=4, intermediate_size=256, max_position=128)
+        base.update(kw)
+        return cls(**base)
+
+
+class BertEmbeddings(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, *, train: bool):
+        c = self.cfg
+        pos_ids = jnp.arange(input_ids.shape[-1])[None, :]
+        x = (nn.Embed(c.vocab_size, c.hidden_size, name="word")(input_ids)
+             + nn.Embed(c.max_position, c.hidden_size, name="position")(pos_ids)
+             + nn.Embed(c.type_vocab_size, c.hidden_size, name="type")(token_type_ids))
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, name="ln")(x)
+        x = nn.Dropout(c.dropout, deterministic=not train)(x)
+        return x.astype(c.jnp_dtype)
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask, *, train: bool):
+        from tpuframe.ops import attention as attn_ops
+
+        c = self.cfg
+        head_dim = c.hidden_size // c.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (c.num_heads, head_dim), dtype=c.jnp_dtype, name=name)
+        q = dense("query")(x)  # [B, S, H, D]
+        k = dense("key")(x)
+        v = dense("value")(x)
+        y = attn_ops.multihead_attention(
+            q, k, v, mask=attention_mask,
+            dropout_rate=c.dropout if train else 0.0,
+            dropout_rng=self.make_rng("dropout") if (train and c.dropout > 0) else None,
+        )
+        y = nn.DenseGeneral(c.hidden_size, axis=(-2, -1), dtype=c.jnp_dtype,
+                            name="out")(y)
+        return y
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask, *, train: bool):
+        c = self.cfg
+        # Post-LN (original BERT): sublayer → dropout → add → LN.
+        a = BertSelfAttention(c, name="attention")(x, attention_mask, train=train)
+        a = nn.Dropout(c.dropout, deterministic=not train)(a)
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, name="attention_ln")(x + a)
+
+        h = nn.Dense(c.intermediate_size, dtype=c.jnp_dtype, name="intermediate")(x)
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dense(c.hidden_size, dtype=c.jnp_dtype, name="output")(h)
+        h = nn.Dropout(c.dropout, deterministic=not train)(h)
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, name="output_ln")(x + h)
+        return x
+
+
+class BertEncoder(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask, *, train: bool):
+        for i in range(self.cfg.num_layers):
+            x = BertLayer(self.cfg, name=f"layer_{i}")(x, attention_mask,
+                                                       train=train)
+        return x
+
+
+class BertForSequenceClassification(nn.Module):
+    """Encoder + tanh pooler + classification head (the GLUE fine-tune model)."""
+
+    cfg: BertConfig = field(default_factory=BertConfig)
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 *, train: bool = False):
+        c = self.cfg
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+
+        x = BertEmbeddings(c, name="embeddings")(input_ids, token_type_ids,
+                                                 train=train)
+        x = BertEncoder(c, name="encoder")(x, attention_mask, train=train)
+        pooled = nn.tanh(nn.Dense(c.hidden_size, dtype=c.jnp_dtype,
+                                  name="pooler")(x[:, 0]))
+        pooled = nn.Dropout(c.dropout, deterministic=not train)(pooled)
+        logits = nn.Dense(c.num_classes, name="classifier")(pooled)
+        return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# HF torch checkpoint import (the reference fine-tunes from bert-base-uncased)
+# ---------------------------------------------------------------------------
+
+def load_hf_weights(params: dict, state_dict: dict, cfg: BertConfig) -> dict:
+    """Map a HuggingFace ``bert-base-uncased`` torch ``state_dict`` onto this
+    module's parameter tree.  Torch Linear weights are [out, in] and transpose
+    to flax's [in, out]; attention projections reshape to [in, heads, head_dim].
+    """
+    import jax
+
+    head_dim = cfg.hidden_size // cfg.num_heads
+    H, N, D = cfg.hidden_size, cfg.num_heads, head_dim
+
+    def t(name):
+        return np.asarray(state_dict[name])
+
+    out = jax.tree.map(lambda x: x, params)  # deep copy of structure
+    emb = out["embeddings"]
+    emb["word"]["embedding"] = t("bert.embeddings.word_embeddings.weight")
+    emb["position"]["embedding"] = t("bert.embeddings.position_embeddings.weight")
+    emb["type"]["embedding"] = t("bert.embeddings.token_type_embeddings.weight")
+    emb["ln"]["scale"] = t("bert.embeddings.LayerNorm.weight")
+    emb["ln"]["bias"] = t("bert.embeddings.LayerNorm.bias")
+
+    for i in range(cfg.num_layers):
+        src = f"bert.encoder.layer.{i}."
+        dst = out["encoder"][f"layer_{i}"]
+        att = dst["attention"]
+        for proj, hf in (("query", "attention.self.query"),
+                         ("key", "attention.self.key"),
+                         ("value", "attention.self.value")):
+            att[proj]["kernel"] = t(src + hf + ".weight").T.reshape(H, N, D)
+            att[proj]["bias"] = t(src + hf + ".bias").reshape(N, D)
+        att["out"]["kernel"] = t(src + "attention.output.dense.weight").T.reshape(N, D, H)
+        att["out"]["bias"] = t(src + "attention.output.dense.bias")
+        dst["attention_ln"]["scale"] = t(src + "attention.output.LayerNorm.weight")
+        dst["attention_ln"]["bias"] = t(src + "attention.output.LayerNorm.bias")
+        dst["intermediate"]["kernel"] = t(src + "intermediate.dense.weight").T
+        dst["intermediate"]["bias"] = t(src + "intermediate.dense.bias")
+        dst["output"]["kernel"] = t(src + "output.dense.weight").T
+        dst["output"]["bias"] = t(src + "output.dense.bias")
+        dst["output_ln"]["scale"] = t(src + "output.LayerNorm.weight")
+        dst["output_ln"]["bias"] = t(src + "output.LayerNorm.bias")
+
+    out["pooler"]["kernel"] = t("bert.pooler.dense.weight").T
+    out["pooler"]["bias"] = t("bert.pooler.dense.bias")
+    return out

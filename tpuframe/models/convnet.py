@@ -1,0 +1,34 @@
+"""MNIST ConvNet — config 1's model (SURVEY.md §1 workload 1, [B:7]).
+
+The reference uses a small custom ``nn.Module`` (torch MNIST-example style:
+two convs → pool → dropout → two dense).  Same capacity here, flax.linen,
+NHWC, optional bf16 compute (params stay f32; casts at the matmul boundary
+is XLA's preferred mixed-precision shape on TPU).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvNet(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        # x: [B, 28, 28, 1] NHWC
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)  # logits in f32 for a stable softmax

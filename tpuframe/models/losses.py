@@ -1,0 +1,38 @@
+"""Loss/metric functions shared by the harness configs.
+
+Reference parity: torch ``F.cross_entropy`` / ``F.nll_loss`` in ``train.py``
+plus accuracy computed per rank and hvd.allreduce-averaged (SURVEY.md §4.5).
+Here losses are plain functions used inside the compiled step; averaging
+across replicas is the step builder's job.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
+                          label_smoothing: float = 0.0) -> jax.Array:
+    """Mean CE over the batch; integer labels. ImageNet configs use
+    ``label_smoothing=0.1`` (standard ResNet-50 recipe)."""
+    num_classes = logits.shape[-1]
+    if label_smoothing > 0.0:
+        on = 1.0 - label_smoothing
+        off = label_smoothing / (num_classes - 1)
+        soft = jax.nn.one_hot(labels, num_classes) * (on - off) + off
+        loss = optax.softmax_cross_entropy(logits, soft)
+    else:
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return jnp.mean(loss)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+
+
+def topk_accuracy(logits: jax.Array, labels: jax.Array, k: int = 5) -> jax.Array:
+    topk = jax.lax.top_k(logits, k)[1]
+    hit = jnp.any(topk == labels[:, None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))

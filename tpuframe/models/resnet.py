@@ -1,0 +1,129 @@
+"""ResNet-18 / ResNet-50 — configs 2, 3 and 5 (SURVEY.md §1, [B:8][B:9][B:11]).
+
+The reference takes these from torchvision; this is a from-scratch flax
+implementation of the same architectures (He et al. 2015, v1.5 downsampling
+like torchvision: stride-2 on the 3x3 of a bottleneck, not the 1x1).
+
+TPU-native choices:
+  - NHWC layout (XLA:TPU's native conv layout; torchvision is NCHW).
+  - ``dtype`` controls compute precision (bf16 for MXU throughput); params
+    and BatchNorm statistics stay float32.
+  - A CIFAR stem (3x3/stride-1, no maxpool) for config 2's ResNet-18/CIFAR-10
+    and the standard 7x7/stride-2+maxpool ImageNet stem for ResNet-50.
+  - BatchNorm running stats live in the ``batch_stats`` collection; the
+    train step cross-replica-averages them (tpuframe.parallel.step), which
+    replaces the reference's per-GPU local stats + rank-0 checkpointing.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Callable[..., nn.Module]
+
+
+class BasicBlock(nn.Module):
+    """2x 3x3 — ResNet-18/34 block."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)  # zero-init last BN
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1),
+                                 (self.strides, self.strides),
+                                 name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class Bottleneck(nn.Module):
+    """1x1 → 3x3(stride) → 1x1(4x) — ResNet-50/101/152 block (v1.5)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 (self.strides, self.strides),
+                                 name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    width: int = 64
+    cifar_stem: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       kernel_init=nn.initializers.variance_scaling(
+                           2.0, "fan_out", "normal"))
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = conv(self.width, (3, 3), name="stem_conv")(x)
+        else:
+            x = conv(self.width, (7, 7), (2, 2), name="stem_conv")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(self.width * 2 ** i, strides, conv, norm)(x)
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     kernel_init=nn.initializers.normal(0.01))(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet18(num_classes: int = 10, *, cifar_stem: bool = True,
+             dtype: jnp.dtype = jnp.float32) -> ResNet:
+    """Config 2 default: ResNet-18 with the CIFAR stem ([B:8])."""
+    return ResNet(stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock,
+                  num_classes=num_classes, cifar_stem=cifar_stem, dtype=dtype)
+
+
+def ResNet50(num_classes: int = 1000, *, cifar_stem: bool = False,
+             dtype: jnp.dtype = jnp.float32) -> ResNet:
+    """Configs 3/5: ResNet-50 v1.5 for ImageNet ([B:9][B:11])."""
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck,
+                  num_classes=num_classes, cifar_stem=cifar_stem, dtype=dtype)

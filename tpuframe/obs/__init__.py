@@ -1,0 +1,7 @@
+"""Observability (SURVEY.md §5.1/§5.5): metrics, throughput, profiling,
+heartbeat/stall detection — the TPU-native stand-ins for Horovod Timeline and
+HOROVOD_STALL_CHECK."""
+
+from tpuframe.obs.metrics import MetricLogger, RateMeter  # noqa: F401
+from tpuframe.obs.heartbeat import Heartbeat  # noqa: F401
+from tpuframe.obs.timeline import profile_trace, start_profiler_server  # noqa: F401

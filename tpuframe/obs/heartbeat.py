@@ -1,0 +1,65 @@
+"""Heartbeat / stall detection — HOROVOD_STALL_CHECK's TPU-native analog
+(SURVEY.md §5.3).
+
+Horovod's stall check warns when a rank hasn't submitted a tensor others are
+waiting on.  Under compiled SPMD that class of bug can't occur (one program,
+one collective order), but a *host* can stall: input pipeline starvation, a
+hung GCS read, a dead coordinator.  This watchdog runs in a thread, watches a
+step counter the training loop bumps, and logs (or calls back) when no step
+completes within the window — the per-host symptom of any pod-wide stall.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+
+class Heartbeat:
+    def __init__(self, *, timeout_s: float = 120.0, poll_s: float = 5.0,
+                 on_stall: Callable[[float], None] | None = None):
+        self.timeout_s = timeout_s
+        self.poll_s = poll_s
+        self.on_stall = on_stall
+        self._last_beat = time.monotonic()
+        self._step = 0
+        self._stalled = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def beat(self, step: int) -> None:
+        """Call once per completed training step."""
+        self._step = step
+        self._last_beat = time.monotonic()
+        self._stalled = False
+
+    @property
+    def stalled(self) -> bool:
+        return self._stalled
+
+    def start(self) -> "Heartbeat":
+        self._thread = threading.Thread(target=self._watch, daemon=True,
+                                        name="tpuframe-heartbeat")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2 * self.poll_s)
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            idle = time.monotonic() - self._last_beat
+            if idle > self.timeout_s and not self._stalled:
+                self._stalled = True
+                logger.warning(
+                    "no training step completed in %.0fs (last step %d) — "
+                    "input pipeline stall, hung I/O, or peer failure",
+                    idle, self._step)
+                if self.on_stall:
+                    self.on_stall(idle)

@@ -1,0 +1,92 @@
+"""Metrics & throughput logging.
+
+Reference parity (SURVEY.md §5.5): rank-0-gated prints + allreduce-averaged
+scalars; the north-star metric is images/sec/chip [B:2], so the rate meter is
+first-class.  Output is stdout lines + a JSONL file (local or gs://-style via
+append-on-host then upload at close).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import jax
+
+
+class RateMeter:
+    """Examples/sec with warmup exclusion (first N steps are compile+cache)."""
+
+    def __init__(self, warmup_steps: int = 2):
+        self.warmup_steps = warmup_steps
+        self._count = 0
+        self._examples = 0
+        self._t0: float | None = None
+
+    def update(self, batch_examples: int) -> None:
+        self._count += 1
+        if self._count == self.warmup_steps:
+            self._t0 = time.perf_counter()
+            self._examples = 0
+        elif self._count > self.warmup_steps:
+            self._examples += batch_examples
+
+    def rate(self) -> float | None:
+        """examples/sec since warmup, None until measurable."""
+        if self._t0 is None or self._examples == 0:
+            return None
+        dt = time.perf_counter() - self._t0
+        return self._examples / dt if dt > 0 else None
+
+    def per_chip(self) -> float | None:
+        r = self.rate()
+        return r / jax.device_count() if r is not None else None
+
+
+class MetricLogger:
+    """Rank-0-gated structured logging: stdout + JSONL (local file appended
+    live; ``gs://`` paths buffered on host and uploaded at close — the
+    checkpoint-to-bucket pattern applied to logs)."""
+
+    def __init__(self, log_file: str | None = None, *, stdout: bool = True):
+        from tpuframe.data import gcs
+
+        self.primary = jax.process_index() == 0
+        self.stdout = stdout
+        self._fh = None
+        self._gcs_path: str | None = None
+        self._gcs_buf: list[str] = []
+        if self.primary and log_file:
+            if gcs.is_gcs_path(log_file):
+                self._gcs_path = log_file
+            else:
+                Path(log_file).parent.mkdir(parents=True, exist_ok=True)
+                self._fh = open(log_file, "a", buffering=1)
+
+    def log(self, step: int, metrics: dict, *, prefix: str = "train") -> None:
+        if not self.primary:
+            return
+        clean = {k: (float(v) if hasattr(v, "item") or isinstance(v, (int, float))
+                     else v) for k, v in metrics.items()}
+        record = {"step": step, "prefix": prefix, "time": time.time(), **clean}
+        line = json.dumps(record)
+        if self._fh:
+            self._fh.write(line + "\n")
+        elif self._gcs_path is not None:
+            self._gcs_buf.append(line)
+        if self.stdout:
+            body = " ".join(f"{k}={v:.5g}" if isinstance(v, float) else f"{k}={v}"
+                            for k, v in clean.items())
+            print(f"[{prefix} {step}] {body}", flush=True)
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+        if self._gcs_path is not None and self._gcs_buf:
+            from tpuframe.data import gcs
+
+            gcs.write_bytes(self._gcs_path,
+                            ("\n".join(self._gcs_buf) + "\n").encode())
+            self._gcs_buf = []

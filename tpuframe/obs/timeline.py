@@ -1,0 +1,39 @@
+"""Profiling hooks — the HOROVOD_TIMELINE replacement (SURVEY.md §5.1).
+
+Horovod records per-tensor negotiate/fuse/NCCL phases to a Chrome trace; on
+TPU the equivalent visibility comes from the XLA/jax profiler: a perfetto/
+TensorBoard trace of the compiled step, including the all-reduce ops and
+their overlap with compute.  ``TPUFRAME_TRACE_DIR`` env or config triggers a
+trace of steps [start, start+count) in the harness.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def start_profiler_server(port: int = 9012) -> bool:
+    """On-demand profiling endpoint (TensorBoard 'capture profile')."""
+    try:
+        jax.profiler.start_server(port)
+        return True
+    except Exception:
+        return False
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Trace a window of steps to ``log_dir`` (viewable in
+    TensorBoard/perfetto; the analog of one Horovod timeline segment)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a traced window (maps to a trace event)."""
+    return jax.profiler.TraceAnnotation(name)

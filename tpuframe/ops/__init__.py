@@ -1,0 +1,6 @@
+"""TPU compute kernels: reference (XLA-fused einsum) and pallas implementations,
+plus the native C++ host runtime (tpuframe.ops.native).
+
+Hot ops route through dispatch functions (e.g. ``attention.multihead_attention``)
+so kernels can be swapped without touching model code.
+"""

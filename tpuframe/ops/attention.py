@@ -1,0 +1,67 @@
+"""Multi-head attention core with pluggable kernels.
+
+The reference gets attention from HF transformers' torch BERT (cuDNN kernels
+under the hood).  Here the op is a dispatch point:
+  - ``xla``: einsum formulation — XLA fuses softmax into the matmuls well on
+    TPU for BERT-scale sequence lengths (128–512, [B:10]).
+  - ``pallas``: a flash-attention TPU kernel (tpuframe.ops.flash_attention),
+    block-tiled for MXU/VMEM — the long-sequence path.
+
+Selection: explicit ``impl=`` argument, else the ``TPUFRAME_ATTN_IMPL`` env
+var, else ``xla``.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+
+def multihead_attention(
+    q: jax.Array,  # [B, S, N, D]
+    k: jax.Array,  # [B, S, N, D]
+    v: jax.Array,  # [B, S, N, D]
+    *,
+    mask: jax.Array | None = None,  # [B, S] 1=keep or broadcastable [B,1,S,S]
+    dropout_rate: float = 0.0,
+    dropout_rng: jax.Array | None = None,
+    impl: str | None = None,
+) -> jax.Array:
+    impl = impl or os.environ.get("TPUFRAME_ATTN_IMPL", "xla")
+    if impl == "pallas":
+        try:
+            from tpuframe.ops import flash_attention
+        except ImportError:
+            warnings.warn("pallas flash attention unavailable; using xla impl")
+            flash_attention = None
+        if (flash_attention is not None and dropout_rate == 0.0
+                and flash_attention.supported(q)):
+            return flash_attention.flash_mha(q, k, v, mask=mask)
+        impl = "xla"  # dropout / unsupported shapes / missing kernel fall back
+    if impl != "xla":
+        raise ValueError(f"unknown attention impl {impl!r}")
+    return _xla_attention(q, k, v, mask=mask, dropout_rate=dropout_rate,
+                          dropout_rng=dropout_rng)
+
+
+def _xla_attention(q, k, v, *, mask, dropout_rate, dropout_rng):
+    depth = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(depth).astype(q.dtype)
+    # [B, N, S, S] scores; accumulate in f32 for softmax stability.
+    scores = jnp.einsum("bqnd,bknd->bnqk", q * scale, k,
+                        preferred_element_type=jnp.float32)
+    if mask is not None:
+        if mask.ndim == 2:  # [B, S] key padding mask
+            mask = mask[:, None, None, :]
+        scores = jnp.where(mask.astype(bool), scores, jnp.float32(-1e9))
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_rate > 0 requires dropout_rng")
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bnqk,bknd->bqnd", probs, v)

@@ -1,0 +1,303 @@
+"""Training harness (L4) — the reference's ``train.py``, TPU-native.
+
+Reference flow (SURVEY.md §4.1): init Horovod → pin GPU → build model/data/
+optimizer → broadcast params → epoch loop with async allreduce hooks.
+Here: bootstrap → mesh → compiled SPMD step → host loop that only feeds
+sharded batches, logs, evals and checkpoints.
+
+CLI:
+    python -m tpuframe.train --config cifar10_resnet18 \
+        [--set total_steps=100 --set global_batch=64] [--data-dir PATH] \
+        [--ckpt-dir PATH]
+
+Every workload config ([B:6–12]) runs through this one entry point, from
+single-process MNIST to the multi-host pod launch (tpuframe.launch execs this
+module on every worker).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import time
+from dataclasses import dataclass
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuframe import ckpt as ckpt_lib
+from tpuframe import models
+from tpuframe.data import ShardedLoader, datasets
+from tpuframe.models import losses
+from tpuframe.obs import Heartbeat, MetricLogger, RateMeter, profile_trace
+from tpuframe.parallel import bootstrap
+from tpuframe.parallel import mesh as mesh_lib
+from tpuframe.parallel import step as step_lib
+from tpuframe.utils import build_optimizer, get_config
+from tpuframe.utils.config import TrainConfig
+
+
+def build_datasets(cfg: TrainConfig):
+    builder = {
+        "mnist": datasets.mnist,
+        "cifar10": datasets.cifar10,
+        "imagenet": datasets.imagenet,
+        "glue_sst2": datasets.glue_sst2,
+    }[cfg.dataset]
+    return builder(cfg.data_dir, **cfg.dataset_kwargs)
+
+
+def _is_text_task(cfg: TrainConfig) -> bool:
+    return cfg.dataset == "glue_sst2"
+
+
+@dataclass
+class Harness:
+    """Everything the loop needs, built once from a config."""
+
+    cfg: TrainConfig
+    mesh: Any
+    model: Any
+    state: step_lib.TrainState
+    train_step: Any
+    eval_step: Any
+    train_loader: ShardedLoader
+    eval_loader: ShardedLoader
+    manager: ckpt_lib.CheckpointManager | None
+    start_step: int
+
+
+def build_harness(cfg: TrainConfig) -> Harness:
+    bootstrap.initialize()
+    mesh = mesh_lib.make_mesh(cfg.mesh) if cfg.distributed else None
+
+    dtype = jnp.dtype(cfg.compute_dtype)
+    model_kwargs = dict(cfg.model_kwargs)
+    if cfg.model == "bert-base":
+        bert_cfg = models.BertConfig.base(dtype=cfg.compute_dtype,
+                                          **model_kwargs)
+        model = models.BertForSequenceClassification(bert_cfg)
+    else:
+        model = models.get_model(cfg.model, dtype=dtype, **model_kwargs)
+
+    train_ds, eval_ds = build_datasets(cfg)
+    train_loader = ShardedLoader(train_ds, cfg.global_batch, mesh,
+                                 seed=cfg.seed)
+    eval_loader = ShardedLoader(eval_ds, cfg.global_batch, mesh,
+                                shuffle=False)
+
+    sample = train_ds[:2]
+    rng = jax.random.key(cfg.seed)
+    if _is_text_task(cfg):
+        variables = model.init(rng, jnp.asarray(sample["input_ids"]))
+    else:
+        variables = model.init(rng, jnp.asarray(sample["image"]))
+    params = variables["params"]
+    model_state = {k: v for k, v in variables.items() if k != "params"}
+
+    tx = build_optimizer(cfg, params)
+    state = step_lib.TrainState.create(params, tx, model_state=model_state,
+                                       rng=jax.random.key(cfg.seed + 1))
+    if mesh is not None:
+        state = step_lib.replicate_state(state, mesh)
+
+    loss_fn = make_loss_fn(cfg, model)
+    train_step = step_lib.make_train_step(loss_fn, tx, mesh)
+    eval_step = step_lib.make_eval_step(make_metric_fn(cfg, model), mesh)
+
+    manager = None
+    start_step = 0
+    if cfg.ckpt_dir is not None:
+        manager = ckpt_lib.CheckpointManager(
+            cfg.ckpt_dir, every_steps=cfg.ckpt_every, keep=cfg.ckpt_keep)
+        if cfg.resume:
+            resumed = manager.restore_latest(mesh=mesh, target=state)
+            if resumed is not None:
+                start_step, state = resumed
+                if bootstrap.is_primary():
+                    print(f"[tpuframe] resumed from step {start_step}",
+                          flush=True)
+
+    return Harness(cfg=cfg, mesh=mesh, model=model, state=state,
+                   train_step=train_step, eval_step=eval_step,
+                   train_loader=train_loader, eval_loader=eval_loader,
+                   manager=manager, start_step=start_step)
+
+
+def make_loss_fn(cfg: TrainConfig, model) -> step_lib.LossFn:
+    if _is_text_task(cfg):
+        def loss_fn(params, model_state, batch, rng):
+            logits = model.apply(
+                {"params": params, **model_state}, batch["input_ids"],
+                batch["attention_mask"], batch["token_type_ids"], train=True,
+                rngs={"dropout": rng})
+            loss = losses.softmax_cross_entropy(logits, batch["label"])
+            return loss, (model_state,
+                          {"accuracy": losses.accuracy(logits, batch["label"])})
+
+        return loss_fn
+
+    def loss_fn(params, model_state, batch, rng):
+        outputs = model.apply(
+            {"params": params, **model_state}, batch["image"], train=True,
+            rngs={"dropout": rng},
+            mutable=list(model_state) if model_state else False)
+        if model_state:
+            logits, mutated = outputs
+            model_state = dict(mutated)
+        else:
+            logits = outputs
+        loss = losses.softmax_cross_entropy(logits, batch["label"],
+                                            cfg.label_smoothing)
+        return loss, (model_state,
+                      {"accuracy": losses.accuracy(logits, batch["label"])})
+
+    return loss_fn
+
+
+def make_metric_fn(cfg: TrainConfig, model):
+    if _is_text_task(cfg):
+        def metric_fn(params, model_state, batch):
+            logits = model.apply({"params": params, **model_state},
+                                 batch["input_ids"], batch["attention_mask"],
+                                 batch["token_type_ids"])
+            return {"accuracy": losses.accuracy(logits, batch["label"]),
+                    "loss": losses.softmax_cross_entropy(logits, batch["label"])}
+
+        return metric_fn
+
+    def metric_fn(params, model_state, batch):
+        logits = model.apply({"params": params, **model_state}, batch["image"])
+        out = {"accuracy": losses.accuracy(logits, batch["label"]),
+               "loss": losses.softmax_cross_entropy(logits, batch["label"])}
+        if batch["label"].shape and cfg.dataset == "imagenet":
+            out["top5"] = losses.topk_accuracy(logits, batch["label"], 5)
+        return out
+
+    return metric_fn
+
+
+def evaluate(h: Harness, max_batches: int) -> dict:
+    agg: dict[str, float] = {}
+    n = 0
+    for i, batch in enumerate(h.eval_loader.epoch(0)):
+        if i >= max_batches:
+            break
+        m = jax.device_get(h.eval_step(h.state, batch))
+        for k, v in m.items():
+            agg[k] = agg.get(k, 0.0) + float(v)
+        n += 1
+    return {k: v / max(n, 1) for k, v in agg.items()}
+
+
+def train(cfg: TrainConfig, *, trace_dir: str | None = None,
+          log_file: str | None = None) -> dict:
+    """Run the workload; returns final metrics (the driver/test surface)."""
+    h = build_harness(cfg)
+    logger = MetricLogger(log_file)
+    rate = RateMeter()
+    heartbeat = Heartbeat(timeout_s=300.0).start()
+    examples_per_step = cfg.global_batch
+
+    if bootstrap.is_primary():
+        n_params = sum(int(np.prod(p.shape))
+                       for p in jax.tree.leaves(h.state.params))
+        print(f"[tpuframe] {cfg.name}: model={cfg.model} "
+              f"params={n_params/1e6:.2f}M devices={jax.device_count()} "
+              f"global_batch={cfg.global_batch} steps={cfg.total_steps}",
+              flush=True)
+
+    state = h.state
+    step = h.start_step
+    final_train_metrics: dict = {}
+    data_iter: Iterator = h.train_loader.from_step(step)
+    t_trace = None
+    while step < cfg.total_steps:
+        if trace_dir is not None and step == h.start_step + 5:
+            t_trace = profile_trace(trace_dir)
+            t_trace.__enter__()
+        if t_trace is not None and step == h.start_step + 8:
+            t_trace.__exit__(None, None, None)
+            t_trace = None
+
+        batch = next(data_iter)
+        state, metrics = h.train_step(state, batch)
+        step += 1
+        rate.update(examples_per_step)
+        heartbeat.beat(step)
+
+        if step % cfg.log_every == 0 or step == cfg.total_steps:
+            metrics = jax.device_get(metrics)
+            final_train_metrics = {k: float(v) for k, v in metrics.items()}
+            r = rate.rate()
+            if r is not None:
+                final_train_metrics["examples_per_sec"] = r
+                final_train_metrics["examples_per_sec_per_chip"] = rate.per_chip()
+            logger.log(step, final_train_metrics)
+
+        if step % cfg.eval_every == 0 or step == cfg.total_steps:
+            h.state = state
+            eval_metrics = evaluate(h, cfg.eval_batches)
+            logger.log(step, eval_metrics, prefix="eval")
+            final_train_metrics.update(
+                {f"eval_{k}": v for k, v in eval_metrics.items()})
+
+        if h.manager is not None:
+            h.manager.maybe_save(step, state)
+
+    if t_trace is not None:
+        t_trace.__exit__(None, None, None)
+    if h.manager is not None and step % cfg.ckpt_every != 0:
+        h.manager.save(step, state)  # final state always durable
+    heartbeat.stop()
+    logger.close()
+    final_train_metrics["step"] = step
+    return final_train_metrics
+
+
+def _parse_set(values: list[str]) -> dict:
+    out: dict = {}
+    for item in values:
+        key, _, raw = item.partition("=")
+        if not raw:
+            raise ValueError(f"--set needs key=value, got {item!r}")
+        try:
+            out[key] = ast.literal_eval(raw)
+        except (ValueError, SyntaxError):
+            out[key] = raw
+    return out
+
+
+def main(argv: list[str] | None = None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", required=True,
+                   help="workload name (see tpuframe.utils.config.WORKLOADS)")
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="override any TrainConfig field")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--log-file", default=None)
+    p.add_argument("--trace-dir", default=None,
+                   help="capture an XLA profiler trace of a few steps")
+    args = p.parse_args(argv)
+
+    cfg = get_config(args.config)
+    overrides = _parse_set(args.set)
+    if args.data_dir:
+        overrides["data_dir"] = args.data_dir
+    if args.ckpt_dir:
+        overrides["ckpt_dir"] = args.ckpt_dir
+    cfg = cfg.with_overrides(**overrides)
+    t0 = time.time()
+    metrics = train(cfg, trace_dir=args.trace_dir, log_file=args.log_file)
+    if bootstrap.is_primary():
+        print(f"[tpuframe] done in {time.time() - t0:.1f}s: "
+              f"{ {k: round(v, 5) if isinstance(v, float) else v for k, v in metrics.items()} }",
+              flush=True)
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+"""Shared utilities: config dataclasses, optimizer/schedule builders."""
+
+from tpuframe.utils.config import TrainConfig, WORKLOADS, get_config  # noqa: F401
+from tpuframe.utils.optim import build_optimizer  # noqa: F401

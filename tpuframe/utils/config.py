@@ -1,0 +1,153 @@
+"""Workload configs — the five reference configurations (SURVEY.md §1, [B:6–12]).
+
+The reference drives these via argparse flags + env vars (SURVEY.md §5.6);
+here each workload is a frozen dataclass with CLI overrides applied on top
+(``python -m tpuframe.train --config cifar10_resnet18 --set total_steps=100``).
+
+Batch sizes / LRs follow the standard recipes the reference genre uses
+(linear-LR scaling with world size — the ``scale LR by hvd.size()`` rule,
+SURVEY.md §3a "Distributed glue").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+from tpuframe.parallel.mesh import MeshSpec
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    name: str
+    model: str                      # registry name (tpuframe.models)
+    model_kwargs: dict[str, Any] = field(default_factory=dict)
+    dataset: str = "mnist"          # mnist | cifar10 | imagenet | glue_sst2
+    dataset_kwargs: dict[str, Any] = field(default_factory=dict)
+    data_dir: str | None = None     # local dir or gs:// bucket path
+
+    # distribution
+    distributed: bool = True        # False → config-1 style unmapped jit
+    mesh: MeshSpec = field(default_factory=MeshSpec)
+
+    # optimization
+    optimizer: str = "sgd"          # sgd | adamw
+    base_lr: float = 0.1            # per-256-examples; scaled by global batch
+    scale_lr_by_batch: bool = True  # the hvd.size() linear-scaling rule
+    warmup_steps: int = 0
+    schedule: str = "cosine"        # cosine | linear | constant
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    grad_clip_norm: float | None = None
+    label_smoothing: float = 0.0
+
+    # loop
+    global_batch: int = 64
+    total_steps: int = 200
+    eval_every: int = 100
+    eval_batches: int = 8
+    log_every: int = 10
+    seed: int = 42
+
+    # precision
+    compute_dtype: str = "float32"  # bfloat16 on real TPU runs
+
+    # checkpoint (SURVEY.md §4.4)
+    ckpt_dir: str | None = None
+    ckpt_every: int = 500
+    ckpt_keep: int = 3
+    resume: bool = True
+
+    def with_overrides(self, **kv) -> "TrainConfig":
+        known = {f.name for f in dataclasses.fields(self)}
+        bad = set(kv) - known
+        if bad:
+            raise ValueError(f"unknown config fields {sorted(bad)}")
+        if "mesh" in kv and isinstance(kv["mesh"], dict):
+            kv["mesh"] = MeshSpec(**kv["mesh"])
+        return dataclasses.replace(self, **kv)
+
+
+def _mnist_single() -> TrainConfig:
+    """Config 1 [B:7]: MNIST ConvNet, single process, no collectives."""
+    return TrainConfig(
+        name="mnist_single", model="convnet", dataset="mnist",
+        distributed=False, optimizer="sgd", base_lr=0.02,
+        scale_lr_by_batch=False, schedule="constant", global_batch=64,
+        total_steps=400, eval_every=200,
+    )
+
+
+def _cifar10_resnet18() -> TrainConfig:
+    """Config 2 [B:8]: ResNet-18 / CIFAR-10, data-parallel (reference: 2-process
+    Horovod). Mesh defaults to all chips; 2-chip parity comes from running on 2."""
+    return TrainConfig(
+        name="cifar10_resnet18", model="resnet18",
+        model_kwargs={"num_classes": 10, "cifar_stem": True},
+        dataset="cifar10", optimizer="sgd", base_lr=0.1, warmup_steps=200,
+        schedule="cosine", weight_decay=5e-4, global_batch=256,
+        total_steps=2000, eval_every=500,
+    )
+
+
+def _imagenet_resnet50() -> TrainConfig:
+    """Config 3 [B:9]: ResNet-50 / ImageNet, 8-chip DP with the GCS pipeline.
+    Standard 90-epoch recipe scaled by batch; bf16 compute for the MXU."""
+    return TrainConfig(
+        name="imagenet_resnet50", model="resnet50",
+        model_kwargs={"num_classes": 1000},
+        dataset="imagenet", optimizer="sgd", base_lr=0.1, warmup_steps=1565,
+        schedule="cosine", weight_decay=1e-4, label_smoothing=0.1,
+        global_batch=2048, total_steps=56300, eval_every=2000,
+        compute_dtype="bfloat16", ckpt_every=2000,
+    )
+
+
+def _glue_bert() -> TrainConfig:
+    """Config 4 [B:10]: BERT-base GLUE (SST-2) fine-tune — the many-small-grads
+    allreduce stress test."""
+    return TrainConfig(
+        name="glue_bert", model="bert-base", dataset="glue_sst2",
+        dataset_kwargs={"seq_len": 128}, optimizer="adamw", base_lr=2e-5,
+        scale_lr_by_batch=False, warmup_steps=200, schedule="linear",
+        weight_decay=0.01, grad_clip_norm=1.0, global_batch=32,
+        total_steps=6000, eval_every=500, compute_dtype="bfloat16",
+    )
+
+
+def _imagenet_resnet50_pod() -> TrainConfig:
+    """Config 5 [B:11]: ResNet-50 / ImageNet on a multi-host pod (v4-32).
+    Same recipe as config 3 at 4x the batch; launched via tpuframe.launch."""
+    cfg = _imagenet_resnet50()
+    return cfg.with_overrides(
+        name="imagenet_resnet50_pod", global_batch=8192, warmup_steps=391,
+        total_steps=14075,
+    )
+
+
+def _smoke() -> TrainConfig:
+    """Tiny end-to-end config for tests/CI (not a reference workload)."""
+    return TrainConfig(
+        name="smoke", model="convnet", dataset="mnist",
+        dataset_kwargs={"synthetic_size": 512}, optimizer="sgd", base_lr=0.02,
+        scale_lr_by_batch=False, schedule="constant", global_batch=32,
+        total_steps=30, eval_every=15, eval_batches=2, log_every=5,
+        ckpt_every=10,
+    )
+
+
+WORKLOADS = {
+    "mnist_single": _mnist_single,
+    "cifar10_resnet18": _cifar10_resnet18,
+    "imagenet_resnet50": _imagenet_resnet50,
+    "glue_bert": _glue_bert,
+    "imagenet_resnet50_pod": _imagenet_resnet50_pod,
+    "smoke": _smoke,
+}
+
+
+def get_config(name: str) -> TrainConfig:
+    if name not in WORKLOADS:
+        raise ValueError(f"unknown config {name!r}; have {sorted(WORKLOADS)}")
+    return WORKLOADS[name]()

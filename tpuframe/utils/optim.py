@@ -1,0 +1,68 @@
+"""Optimizer / LR-schedule builders.
+
+Reference parity: torch SGD-momentum (+ LR scaled by ``hvd.size()``) for the
+vision configs and AdamW with warmup for BERT, wrapped in
+``hvd.DistributedOptimizer`` (SURVEY.md §3a).  Here the distributed wrapping
+is unnecessary — gradient averaging lives in the compiled step — but the same
+optax transformation chain is exposed so configs map 1:1.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from tpuframe.utils.config import TrainConfig
+
+
+def lr_schedule(cfg: TrainConfig, world_batch_scale: float) -> optax.Schedule:
+    peak = cfg.base_lr * (world_batch_scale if cfg.scale_lr_by_batch else 1.0)
+    decay_steps = max(cfg.total_steps - cfg.warmup_steps, 1)
+    if cfg.schedule == "cosine":
+        sched = optax.cosine_decay_schedule(peak, decay_steps)
+    elif cfg.schedule == "linear":
+        sched = optax.linear_schedule(peak, 0.0, decay_steps)
+    elif cfg.schedule == "constant":
+        sched = optax.constant_schedule(peak)
+    else:
+        raise ValueError(f"unknown schedule {cfg.schedule!r}")
+    if cfg.warmup_steps > 0:
+        warmup = optax.linear_schedule(0.0, peak, cfg.warmup_steps)
+        return optax.join_schedules([warmup, sched], [cfg.warmup_steps])
+    return sched
+
+
+def _decay_mask(params) -> object:
+    """No weight decay on biases/norm scales (standard recipe; matches the
+    reference's torch param-group split)."""
+    import jax
+
+    def keep(path, _):
+        name = str(path[-1].key) if hasattr(path[-1], "key") else ""
+        return name not in ("bias", "scale", "b")
+
+    return jax.tree_util.tree_map_with_path(keep, params)
+
+
+def build_optimizer(cfg: TrainConfig, params=None) -> optax.GradientTransformation:
+    """Chain: [clip] → optimizer(+wd) → schedule. LR linear-scaling rule:
+    peak = base_lr * global_batch/256 (the hvd.size() scaling, SURVEY.md §3a)."""
+    scale = cfg.global_batch / 256.0
+    sched = lr_schedule(cfg, scale)
+    parts: list[optax.GradientTransformation] = []
+    if cfg.grad_clip_norm is not None:
+        parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    if cfg.optimizer == "sgd":
+        parts.append(optax.sgd(sched, momentum=cfg.momentum, nesterov=True))
+        if cfg.weight_decay > 0.0:
+            # torch SGD couples weight decay into the gradient; add_decayed_
+            # weights before the update is the optax equivalent.
+            parts.insert(-1, optax.add_decayed_weights(
+                cfg.weight_decay,
+                mask=_decay_mask(params) if params is not None else None))
+    elif cfg.optimizer == "adamw":
+        parts.append(optax.adamw(
+            sched, weight_decay=cfg.weight_decay,
+            mask=_decay_mask(params) if params is not None else None))
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    return optax.chain(*parts)
