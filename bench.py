@@ -1,0 +1,105 @@
+"""Benchmark: ResNet-50 training throughput, images/sec/chip.
+
+The driver-defined metric (BASELINE.json:2): ResNet-50 images/sec/chip.
+This runs the flagship model's full training step (fwd+bwd+update, bf16
+compute, batch 128/chip) on the available chip(s) with synthetic ImageNet
+shapes, which isolates accelerator throughput from input-pipeline effects.
+
+``vs_baseline``: the reference's own numbers are unpublished (BASELINE.md —
+`"published": {}` and the source mount was empty), so the anchor is the
+Horovod-GPU era per-chip figure for this exact workload: ~360 images/sec on a
+V100 with standard fp16/32 ResNet-50 training (MLPerf v0.6-era single-GPU
+throughput; the Horovod paper's hardware class, PAPERS.md:8).
+vs_baseline = value / 360.0.
+
+Output: one JSON line
+  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+   "unit": "images/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+V100_HOROVOD_ANCHOR = 360.0  # images/sec/chip, see module docstring
+
+BATCH_PER_CHIP = 128
+IMAGE_SIZE = 224
+WARMUP_STEPS = 3
+MEASURE_STEPS = 10
+
+
+def main() -> None:
+    from tpuframe import models
+    from tpuframe.models import losses
+    from tpuframe.parallel import mesh as mesh_lib
+    from tpuframe.parallel import step as step_lib
+
+    n_chips = jax.device_count()
+    mesh = mesh_lib.make_mesh() if n_chips > 1 else None
+    global_batch = BATCH_PER_CHIP * n_chips
+
+    model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0.5, 0.25, size=(global_batch, IMAGE_SIZE, IMAGE_SIZE, 3)
+                   ).astype(np.float32)
+    y = rng.integers(0, 1000, size=(global_batch,)).astype(np.int32)
+    variables = model.init(jax.random.key(0), jnp.asarray(x[:2]))
+
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+
+    def loss_fn(params, model_state, batch, step_rng):
+        logits, mutated = model.apply(
+            {"params": params, **model_state}, batch["image"], train=True,
+            mutable=["batch_stats"])
+        loss = losses.softmax_cross_entropy(logits, batch["label"],
+                                            label_smoothing=0.1)
+        return loss, (dict(mutated), {})
+
+    state = step_lib.TrainState.create(
+        variables["params"], tx,
+        model_state={"batch_stats": variables["batch_stats"]})
+    train_step = step_lib.make_train_step(loss_fn, tx, mesh, donate=True)
+
+    if mesh is not None:
+        state = step_lib.replicate_state(state, mesh)
+        put = lambda a: jax.device_put(a, mesh_lib.batch_sharding(mesh))  # noqa: E731
+    else:
+        put = jax.device_put
+    batch = {"image": put(x), "label": put(y)}
+
+    def synced_step(state):
+        state, metrics = train_step(state, batch)
+        # Hard sync via scalar fetch: on the sandbox's axon relay platform,
+        # block_until_ready over a chain of donated buffers can return before
+        # execution finishes, inflating async-loop timings ~80x; fetching the
+        # loss forces completion of the whole step.
+        float(metrics["loss"])
+        return state
+
+    for _ in range(WARMUP_STEPS):
+        state = synced_step(state)
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state = synced_step(state)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = MEASURE_STEPS * global_batch / dt
+    per_chip = images_per_sec / n_chips
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / V100_HOROVOD_ANCHOR, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -209,17 +209,35 @@ def restore(directory: str, step: int, *, mesh: Mesh | None = None,
 
     def _placed(name: str, tgt) -> Any:
         entry = manifest["leaves"][name]
+        tgt_sharding = getattr(tgt, "sharding", None)
+        if (tgt_sharding is not None and "prng_impl" not in entry
+                and not tgt_sharding.is_fully_replicated
+                and isinstance(tgt_sharding, NamedSharding)):
+            # Sharded target: read only the shard files overlapping each
+            # locally-addressable device slice, never the full array.
+            shape = tuple(entry["shape"])
+            cache: dict = {}
+            idx_map = tgt_sharding.addressable_devices_indices_map(shape)
+            pieces = [
+                jax.device_put(
+                    _assemble_region(path, entry, idx, manifest["crc"],
+                                     verify_crc, cache),
+                    device)
+                for device, idx in idx_map.items()
+            ]
+            return jax.make_array_from_single_device_arrays(
+                shape, tgt_sharding, pieces)
         arr = _assemble(path, entry, manifest["crc"], verify_crc)
         arr = arr.astype(np.dtype(entry["dtype"]), copy=False)
         if "prng_impl" in entry:
             key = jax.random.wrap_key_data(jnp_asarray(arr),
                                            impl=entry["prng_impl"])
-            if tgt is not None and hasattr(tgt, "sharding"):
-                key = jax.device_put(key, tgt.sharding)
+            if tgt_sharding is not None:
+                key = jax.device_put(key, tgt_sharding)
             return key
-        if tgt is not None and hasattr(tgt, "sharding"):
-            # Reshard onto the target's (possibly different-size) mesh.
-            return jax.device_put(arr, tgt.sharding)
+        if tgt_sharding is not None:
+            # Replicated target: full assemble + device_put.
+            return jax.device_put(arr, tgt_sharding)
         if mesh is not None:
             spec = P(*[tuple(e) if e else None for e in entry["spec"]]) \
                 if entry["spec"] else P()
@@ -256,22 +274,59 @@ def _assemble(path: str, entry: dict, crcs: dict, verify_crc: bool) -> np.ndarra
     shards = entry["shards"] if entry["shards"] else []
     if not shards:
         raise FileNotFoundError(f"manifest entry has no shard files: {entry}")
-    first = _load_shard(path, shards[0]["file"], crcs, verify_crc)
+    first = _load_shard(path, shards[0]["file"], crcs, verify_crc, dtype)
     if shards[0]["index"] is None or first.shape == shape:
         return first
     out = np.empty(shape, dtype)
     for sh in shards:
-        data = _load_shard(path, sh["file"], crcs, verify_crc)
+        data = _load_shard(path, sh["file"], crcs, verify_crc, dtype)
         slices = tuple(slice(lo, hi) for lo, hi in sh["index"])
         out[slices] = data
     return out
 
 
-def _load_shard(path: str, fname: str, crcs: dict, verify_crc: bool) -> np.ndarray:
+def _assemble_region(path: str, entry: dict, region: tuple[slice, ...],
+                     crcs: dict, verify_crc: bool,
+                     file_cache: dict) -> np.ndarray:
+    """Materialize only ``region`` of a saved leaf, reading just the shard
+    files that overlap it — the per-device restore path that avoids every
+    host reading the whole checkpoint (SURVEY.md §4.4's no-rank-0-bottleneck
+    goal applied to restore)."""
+    shape = tuple(entry["shape"])
+    dtype = np.dtype(entry["dtype"])
+    bounds = [(0 if s.start is None else s.start,
+               dim if s.stop is None else s.stop)
+              for s, dim in zip(region, shape)]
+    out = np.empty([hi - lo for lo, hi in bounds], dtype)
+    for sh in entry["shards"]:
+        idx = sh["index"] or [(0, d) for d in shape]
+        overlap = [(max(lo, slo), min(hi, shi))
+                   for (lo, hi), (slo, shi) in zip(bounds, idx)]
+        if any(lo >= hi for lo, hi in overlap):
+            continue
+        if sh["file"] not in file_cache:
+            file_cache[sh["file"]] = _load_shard(path, sh["file"], crcs,
+                                                 verify_crc, dtype)
+        data = file_cache[sh["file"]]
+        src = tuple(slice(lo - slo, hi - slo)
+                    for (lo, hi), (slo, _) in zip(overlap, idx))
+        dst = tuple(slice(lo - blo, hi - blo)
+                    for (lo, hi), (blo, _) in zip(overlap, bounds))
+        out[dst] = data[src]
+    return out
+
+
+def _load_shard(path: str, fname: str, crcs: dict, verify_crc: bool,
+                dtype: np.dtype | None = None) -> np.ndarray:
     raw = gcs.read_bytes(gcs.join(path, fname))
     if verify_crc and fname in crcs and _crc32(raw) != crcs[fname]:
         raise IOError(f"CRC mismatch in checkpoint shard {fname} — corrupt file")
-    return np.load(io.BytesIO(raw), allow_pickle=False)
+    arr = np.load(io.BytesIO(raw), allow_pickle=False)
+    if arr.dtype.kind == "V" and dtype is not None:
+        # numpy round-trips ml_dtypes (bfloat16 etc.) as raw void records;
+        # reinterpret with the dtype recorded in the manifest.
+        arr = arr.view(dtype)
+    return arr
 
 
 def _barrier() -> None:

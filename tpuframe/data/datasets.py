@@ -26,9 +26,14 @@ from tpuframe.data import gcs
 
 @dataclass
 class ArrayDataset:
-    """In-memory columnar dataset: dict of equal-length arrays."""
+    """In-memory columnar dataset: dict of equal-length arrays.
+
+    ``host_presharded`` (instance attribute, default False): set by builders
+    whose on-disk layout is already one shard per host, so ShardedLoader
+    skips its own host split."""
 
     columns: dict[str, np.ndarray]
+    host_presharded: bool = False
 
     def __post_init__(self):
         lens = {k: len(v) for k, v in self.columns.items()}
@@ -129,7 +134,20 @@ def imagenet(data_dir: str | None = None, *, image_size: int = 224,
     (SURVEY.md §7 hard part 2), so decode/resize happens offline.
     """
     if data_dir is not None:
-        names = [n for n in gcs.listdir(data_dir) if n.startswith("images_")]
+        import jax
+
+        names = sorted(n for n in gcs.listdir(data_dir)
+                       if n.startswith("images_"))
+        # Each host loads only its slice of the file list — the shard files
+        # ARE the host shards; loading everything everywhere would cost
+        # O(hosts x dataset) reads and OOM a TPU-VM host on real ImageNet.
+        n_proc, proc = jax.process_count(), jax.process_index()
+        if n_proc > 1:
+            if len(names) % n_proc:
+                raise ValueError(
+                    f"{len(names)} imagenet shard files not divisible by "
+                    f"{n_proc} hosts — re-shard with prepare_imagenet")
+            names = names[proc::n_proc]
         xs = [np.load(io.BytesIO(gcs.read_bytes(gcs.join(data_dir, n))))
               for n in names]
         ys = [np.load(io.BytesIO(gcs.read_bytes(gcs.join(data_dir, n.replace("images_", "labels_")))))
@@ -137,8 +155,12 @@ def imagenet(data_dir: str | None = None, *, image_size: int = 224,
         x = np.concatenate(xs)
         y = np.concatenate(ys).astype(np.int32)
         split = int(0.99 * len(x))
-        return (ArrayDataset({"image": x[:split], "label": y[:split]}),
-                ArrayDataset({"image": x[split:], "label": y[split:]}))
+        train = ArrayDataset({"image": x[:split], "label": y[:split]})
+        test = ArrayDataset({"image": x[split:], "label": y[split:]})
+        # Tell ShardedLoader the per-host split already happened.
+        train.host_presharded = n_proc > 1
+        test.host_presharded = n_proc > 1
+        return train, test
     return (_synthetic_images(synthetic_size, (image_size, image_size, 3), 1000, seed=4),
             _synthetic_images(max(synthetic_size // 8, 64),
                               (image_size, image_size, 3), 1000,

@@ -59,11 +59,15 @@ class ShardedLoader:
         self.seed = seed
         self.prefetch = prefetch
 
-        n_proc = jax.process_count() if shard_by_host else 1
+        n_proc = jax.process_count()
         if global_batch % n_proc:
             raise ValueError(
                 f"global batch {global_batch} not divisible by {n_proc} hosts")
         self.host_batch = global_batch // n_proc
+        # Builders that load one shard file per host mark the dataset
+        # host_presharded; re-sharding it here would drop (N-1)/N of the data.
+        shard_by_host = (shard_by_host
+                         and not getattr(dataset, "host_presharded", False))
         if mesh is not None:
             dp = mesh_lib.data_parallel_size(mesh)
             if global_batch % dp:

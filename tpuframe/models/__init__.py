@@ -15,11 +15,22 @@ from tpuframe.models.convnet import ConvNet
 from tpuframe.models.resnet import ResNet, ResNet18, ResNet50
 from tpuframe.models.bert import BertConfig, BertForSequenceClassification
 
+def _bert_base(dtype=None, **kwargs):
+    """Registry adapter: flag-style kwargs → BertConfig (so get_model's
+    uniform ``get_model(name, dtype=..., **kwargs)`` call shape works for
+    BERT too)."""
+    import numpy as np
+
+    if dtype is not None:
+        kwargs.setdefault("dtype", str(np.dtype(dtype)))
+    return BertForSequenceClassification(BertConfig.base(**kwargs))
+
+
 _REGISTRY: dict[str, Callable[..., Any]] = {
     "convnet": ConvNet,
     "resnet18": ResNet18,
     "resnet50": ResNet50,
-    "bert-base": BertForSequenceClassification,
+    "bert-base": _bert_base,
 }
 
 

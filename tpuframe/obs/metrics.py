@@ -8,6 +8,7 @@ append-on-host then upload at close).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import time
 from pathlib import Path
@@ -16,27 +17,40 @@ import jax
 
 
 class RateMeter:
-    """Examples/sec with warmup exclusion (first N steps are compile+cache)."""
+    """Examples/sec with warmup exclusion (first N steps are compile+cache)
+    and pause support so eval/checkpoint wall-clock doesn't deflate the
+    training-throughput number (the north-star metric, [B:2])."""
 
     def __init__(self, warmup_steps: int = 2):
         self.warmup_steps = warmup_steps
         self._count = 0
         self._examples = 0
         self._t0: float | None = None
+        self._excluded = 0.0
 
     def update(self, batch_examples: int) -> None:
         self._count += 1
         if self._count == self.warmup_steps:
             self._t0 = time.perf_counter()
             self._examples = 0
+            self._excluded = 0.0
         elif self._count > self.warmup_steps:
             self._examples += batch_examples
+
+    @contextlib.contextmanager
+    def paused(self):
+        """Exclude the wrapped wall-clock (eval passes, blocking saves)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._excluded += time.perf_counter() - t0
 
     def rate(self) -> float | None:
         """examples/sec since warmup, None until measurable."""
         if self._t0 is None or self._examples == 0:
             return None
-        dt = time.perf_counter() - self._t0
+        dt = time.perf_counter() - self._t0 - self._excluded
         return self._examples / dt if dt > 0 else None
 
     def per_chip(self) -> float | None:
@@ -46,10 +60,11 @@ class RateMeter:
 
 class MetricLogger:
     """Rank-0-gated structured logging: stdout + JSONL (local file appended
-    live; ``gs://`` paths buffered on host and uploaded at close — the
-    checkpoint-to-bucket pattern applied to logs)."""
+    live; ``gs://`` paths uploaded as periodic segment objects so a crash
+    loses at most one flush window and resumes never overwrite history)."""
 
-    def __init__(self, log_file: str | None = None, *, stdout: bool = True):
+    def __init__(self, log_file: str | None = None, *, stdout: bool = True,
+                 gcs_flush_every: int = 50):
         from tpuframe.data import gcs
 
         self.primary = jax.process_index() == 0
@@ -57,9 +72,14 @@ class MetricLogger:
         self._fh = None
         self._gcs_path: str | None = None
         self._gcs_buf: list[str] = []
+        self._gcs_segment = 0
+        self._gcs_flush_every = gcs_flush_every
         if self.primary and log_file:
             if gcs.is_gcs_path(log_file):
                 self._gcs_path = log_file
+                # Unique run suffix: resumed runs append new segments instead
+                # of overwriting the previous run's log at the same path.
+                self._gcs_run = int(time.time())
             else:
                 Path(log_file).parent.mkdir(parents=True, exist_ok=True)
                 self._fh = open(log_file, "a", buffering=1)
@@ -75,18 +95,29 @@ class MetricLogger:
             self._fh.write(line + "\n")
         elif self._gcs_path is not None:
             self._gcs_buf.append(line)
+            if len(self._gcs_buf) >= self._gcs_flush_every:
+                self._flush_gcs()
         if self.stdout:
             body = " ".join(f"{k}={v:.5g}" if isinstance(v, float) else f"{k}={v}"
                             for k, v in clean.items())
             print(f"[{prefix} {step}] {body}", flush=True)
 
+    def _flush_gcs(self) -> None:
+        """Write the buffered lines as a new segment object
+        (``<path>.<runid>.<seg>``) so crashes lose at most one window and
+        resumed runs never clobber earlier segments; readers concatenate."""
+        if not self._gcs_buf:
+            return
+        from tpuframe.data import gcs
+
+        seg_path = f"{self._gcs_path}.{self._gcs_run}.{self._gcs_segment:04d}"
+        gcs.write_bytes(seg_path, ("\n".join(self._gcs_buf) + "\n").encode())
+        self._gcs_segment += 1
+        self._gcs_buf = []
+
     def close(self) -> None:
         if self._fh:
             self._fh.close()
             self._fh = None
-        if self._gcs_path is not None and self._gcs_buf:
-            from tpuframe.data import gcs
-
-            gcs.write_bytes(self._gcs_path,
-                            ("\n".join(self._gcs_buf) + "\n").encode())
-            self._gcs_buf = []
+        if self._gcs_path is not None:
+            self._flush_gcs()

@@ -74,13 +74,7 @@ def build_harness(cfg: TrainConfig) -> Harness:
     mesh = mesh_lib.make_mesh(cfg.mesh) if cfg.distributed else None
 
     dtype = jnp.dtype(cfg.compute_dtype)
-    model_kwargs = dict(cfg.model_kwargs)
-    if cfg.model == "bert-base":
-        bert_cfg = models.BertConfig.base(dtype=cfg.compute_dtype,
-                                          **model_kwargs)
-        model = models.BertForSequenceClassification(bert_cfg)
-    else:
-        model = models.get_model(cfg.model, dtype=dtype, **model_kwargs)
+    model = models.get_model(cfg.model, dtype=dtype, **cfg.model_kwargs)
 
     train_ds, eval_ds = build_datasets(cfg)
     train_loader = ShardedLoader(train_ds, cfg.global_batch, mesh,
@@ -239,13 +233,15 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
 
         if step % cfg.eval_every == 0 or step == cfg.total_steps:
             h.state = state
-            eval_metrics = evaluate(h, cfg.eval_batches)
+            with rate.paused():  # eval time isn't training throughput
+                eval_metrics = evaluate(h, cfg.eval_batches)
             logger.log(step, eval_metrics, prefix="eval")
             final_train_metrics.update(
                 {f"eval_{k}": v for k, v in eval_metrics.items()})
 
         if h.manager is not None:
-            h.manager.maybe_save(step, state)
+            with rate.paused():
+                h.manager.maybe_save(step, state)
 
     if t_trace is not None:
         t_trace.__exit__(None, None, None)
