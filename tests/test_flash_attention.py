@@ -1,0 +1,147 @@
+"""Flash-attention kernel vs the XLA einsum reference (SURVEY.md §7 test
+strategy: unit tests per module on CPU jax — the Pallas interpreter executes
+the very kernel that compiles for TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuframe.ops import attention
+from tpuframe.ops import flash_attention as fa
+
+
+def _qkv(b=2, s=256, n=4, d=64, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    shape = (b, s, n, d)
+    return tuple(jax.random.normal(k, shape, dtype) * 0.5 for k in ks)
+
+
+def _padding_mask(b=2, s=256, seed=1):
+    lengths = jax.random.randint(jax.random.key(seed), (b,), s // 4, s + 1)
+    return (jnp.arange(s)[None, :] < lengths[:, None]).astype(jnp.int32)
+
+
+def test_forward_matches_xla():
+    q, k, v = _qkv()
+    got = fa.flash_mha(q, k, v, interpret=True)
+    want = attention._xla_attention(q, k, v, mask=None, dropout_rate=0.0,
+                                    dropout_rng=None)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_padding_mask():
+    q, k, v = _qkv()
+    mask = _padding_mask()
+    got = fa.flash_mha(q, k, v, mask=mask, interpret=True)
+    want = attention._xla_attention(q, k, v, mask=mask, dropout_rate=0.0,
+                                    dropout_rng=None)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_causal():
+    q, k, v = _qkv(s=256)
+    got = fa.flash_mha(q, k, v, causal=True, interpret=True)
+    s = q.shape[1]
+    causal = jnp.tril(jnp.ones((s, s), bool))[None, None]
+    want = attention._xla_attention(q, k, v, mask=causal, dropout_rate=0.0,
+                                    dropout_rng=None)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_multi_block_seq():
+    # 2 q-blocks x 2 kv-blocks exercises the online-softmax accumulation.
+    q, k, v = _qkv(s=256)
+    got = fa.flash_mha(q, k, v, block_q=128, block_k=128, interpret=True)
+    want = attention._xla_attention(q, k, v, mask=None, dropout_rate=0.0,
+                                    dropout_rng=None)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_xla(causal):
+    q, k, v = _qkv(b=1, s=256, n=2, d=64)
+    mask = None if causal else _padding_mask(b=1, s=256)
+
+    def loss_flash(q, k, v):
+        o = fa.flash_mha(q, k, v, mask=mask, causal=causal, interpret=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_xla(q, k, v):
+        m = mask
+        if causal:
+            s = q.shape[1]
+            m = jnp.tril(jnp.ones((s, s), bool))[None, None]
+        o = attention._xla_attention(q, k, v, mask=m, dropout_rate=0.0,
+                                     dropout_rng=None)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for gf, gx, name in zip(g_flash, g_xla, "qkv"):
+        np.testing.assert_allclose(gf, gx, atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_bf16_inputs():
+    q, k, v = _qkv(dtype=jnp.bfloat16, s=128)
+    got = fa.flash_mha(q, k, v, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = attention._xla_attention(q.astype(jnp.float32),
+                                    k.astype(jnp.float32),
+                                    v.astype(jnp.float32), mask=None,
+                                    dropout_rate=0.0, dropout_rng=None)
+    np.testing.assert_allclose(got.astype(jnp.float32), want,
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_dispatch_selects_pallas(monkeypatch):
+    q, k, v = _qkv(b=1, s=128, n=2, d=64)
+    calls = []
+    real = fa.flash_mha
+    monkeypatch.setattr(fa, "flash_mha",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+    out = attention.multihead_attention(q, k, v, impl="pallas")
+    assert calls, "dispatch silently fell back to the XLA path"
+    want = attention.multihead_attention(q, k, v, impl="xla")
+    np.testing.assert_allclose(out, want, atol=2e-5, rtol=2e-5)
+
+
+def test_unsupported_shape_falls_back():
+    # seq 100 doesn't tile; dispatch must silently use the XLA path.
+    q, k, v = _qkv(b=1, s=100, n=2, d=64)
+    out = attention.multihead_attention(q, k, v, impl="pallas")
+    want = attention.multihead_attention(q, k, v, impl="xla")
+    np.testing.assert_allclose(out, want, atol=2e-5, rtol=2e-5)
+    assert not fa.supported(q)
+
+
+def test_cross_attention_kv_shape_guard():
+    # s_kv=200 doesn't tile into 128-blocks: supported() must reject it and
+    # flash_mha must refuse rather than silently truncating keys.
+    q, _, _ = _qkv(b=1, s=128, n=2, d=64)
+    k = jnp.ones((1, 200, 2, 64), jnp.float32)
+    v = jnp.ones((1, 200, 2, 64), jnp.float32)
+    assert not fa.supported(q, k)
+    with pytest.raises(ValueError, match="do not tile"):
+        fa.flash_mha(q, k, v, interpret=True)
+
+
+def test_fully_masked_row_zero_grads():
+    # A zero-length (all-padding) batch row: output and all grads must be
+    # exactly zero for it — not s_kv-inflated garbage.
+    q, k, v = _qkv(b=2, s=128, n=2, d=64)
+    mask = jnp.stack([jnp.zeros(128, jnp.int32), jnp.ones(128, jnp.int32)])
+
+    out = fa.flash_mha(q, k, v, mask=mask, interpret=True)
+    np.testing.assert_array_equal(out[0], jnp.zeros_like(out[0]))
+
+    def loss(q, k, v):
+        o = fa.flash_mha(q, k, v, mask=mask, interpret=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g, name in ((dq, "dq"), (dk, "dk"), (dv, "dv")):
+        np.testing.assert_array_equal(
+            g[0], jnp.zeros_like(g[0]), err_msg=f"{name}[masked row]")
+        assert float(jnp.max(jnp.abs(g[1]))) > 0  # live row still flows

@@ -38,7 +38,8 @@ def multihead_attention(
             warnings.warn("pallas flash attention unavailable; using xla impl")
             flash_attention = None
         if (flash_attention is not None and dropout_rate == 0.0
-                and flash_attention.supported(q)):
+                and flash_attention.supported(q, k)
+                and (mask is None or mask.ndim == 2)):
             return flash_attention.flash_mha(q, k, v, mask=mask)
         impl = "xla"  # dropout / unsupported shapes / missing kernel fall back
     if impl != "xla":

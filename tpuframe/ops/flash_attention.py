@@ -1,0 +1,416 @@
+"""Flash attention as a Pallas TPU kernel — the framework's hot-op path.
+
+The reference's attention ran inside HF torch BERT on cuDNN (SURVEY.md §3a
+"Model defs"); its FLOPs lived in fused CUDA kernels.  The TPU-native
+equivalent is a block-tiled online-softmax attention kernel that keeps the
+S×S score matrix out of HBM entirely:
+
+  * forward: for each query block, stream key/value blocks through VMEM,
+    maintaining running max ``m``, normalizer ``l`` and an f32 accumulator —
+    one HBM pass over K/V, scores never materialized.
+  * backward: two kernels (dq-major and dkv-major), recomputing probabilities
+    from the saved logsumexp instead of storing them — the standard
+    flash-attention-2 residual scheme (O, logsumexp, delta=rowsum(dO·O)).
+
+Block sizes default to 128 — the MXU tile edge — so every matmul in the loop
+is a full systolic-array issue.  Accumulation is float32 regardless of input
+dtype (bf16 inputs keep bf16 in HBM, f32 in VMEM).
+
+Used through :func:`tpuframe.ops.attention.multihead_attention` with
+``impl="pallas"`` (or ``TPUFRAME_ATTN_IMPL=pallas``); CPU tests run the same
+kernel under the Pallas interpreter.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30  # softmax mask fill; finite so (x - x) stays 0, not nan
+
+_LANES = 128  # VMEM lane width: per-row stats are stored lane-broadcast
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _sds(like: jax.Array, shape, dtype) -> jax.ShapeDtypeStruct:
+    """out_shape that inherits ``like``'s varying-mesh-axes, so the kernel
+    works unchanged inside ``shard_map`` (where jax requires outputs to
+    declare their vma) and outside it (empty vma)."""
+    return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
+
+
+def supported(q: jax.Array, k: jax.Array | None = None,
+              block_q: int = DEFAULT_BLOCK_Q,
+              block_k: int = DEFAULT_BLOCK_K) -> bool:
+    """True when shapes fit the kernel's static tiling (else caller falls
+    back to the XLA einsum path, tpuframe.ops.attention)."""
+    if q.ndim != 4:
+        return False
+    _, s_q, _, d = q.shape
+    s_kv = s_q if k is None else k.shape[1]
+    bq, bk = min(block_q, s_q), min(block_k, s_kv)
+    # seq dims must tile into whole blocks and stay sublane-aligned (mult of
+    # 8); head dim beyond 256 would blow the per-block VMEM budget.
+    return (d <= 256 and s_q % bq == 0 and s_kv % bk == 0
+            and s_q % 8 == 0 and s_kv % 8 == 0)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref,  # inputs
+                o_ref, lse_ref,                 # outputs
+                acc_ref, m_ref, l_ref,          # scratch
+                *, scale: float, causal: bool, block_q: int, block_k: int,
+                n_kv: int):
+    qi = pl.program_id(1)
+    kv = pl.program_id(2)
+
+    @pl.when(kv == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def compute():
+        q = q_ref[0]                     # [bq, d]
+        k = k_ref[0]                     # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+
+        keep = None                                       # [bq, bk] or None
+        if mask_ref is not None:
+            keep = jnp.broadcast_to(mask_ref[0, 0][None, :] != 0, s.shape)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            tri = qi * block_q + rows >= kv * block_k + cols
+            keep = tri if keep is None else jnp.logical_and(keep, tri)
+        if keep is not None:
+            s = jnp.where(keep, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                             # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)        # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                   # rescale factor
+        p = jnp.exp(s - m_new)                            # [bq, bk]
+        if keep is not None:
+            # Explicit zeroing (not exp-underflow): a fully-masked row keeps
+            # l == 0 and yields zero output + NEG_INF lse, and the backward
+            # recompute below reproduces exactly p == 0 for it.
+            p = jnp.where(keep, p, 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing: skip.
+        @pl.when(kv * block_k <= qi * block_q + (block_q - 1))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(kv == n_kv - 1)
+    def _finalize():
+        m = m_ref[:, :1]
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows → zeros
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        # logsumexp residual for the backward pass
+        lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+        lse_ref[0, 0] = lse[:, 0]
+
+
+def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret):
+    bn, s_q, d = q.shape
+    s_kv = k.shape[1]
+    bq, bk = min(block_q, s_q), min(block_k, s_kv)
+    n_q, n_kv = s_q // bq, s_kv // bk
+    grid = (bn, n_q, n_kv)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),          # q
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),          # k
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),          # v
+    ]
+    args = [q, k, v]
+    if mask is not None:
+        n_heads = bn // mask.shape[0]
+        in_specs.insert(0, pl.BlockSpec(
+            (1, 1, bk), lambda b, i, j, h=n_heads: (b // h, 0, j)))
+        args.insert(0, mask[:, None, :])
+        kernel = functools.partial(
+            _fwd_kernel, scale=scale, causal=causal,
+            block_q=bq, block_k=bk, n_kv=n_kv)
+    else:
+        kernel = functools.partial(
+            _fwd_kernel, None, scale=scale, causal=causal,
+            block_q=bq, block_k=bk, n_kv=n_kv)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            _sds(q, (bn, s_q, d), q.dtype),
+            _sds(q, (bn, 1, s_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return out, lse[:, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _recompute_p(q_ref, k_ref, lse_ref, mask_ref, *, scale, causal,
+                 qi, kv, block_q, block_k):
+    """Rebuild the probability block from saved logsumexp (f32)."""
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    keep = None
+    if mask_ref is not None:
+        keep = jnp.broadcast_to(mask_ref[0, 0][None, :] != 0, s.shape)
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        tri = qi * block_q + rows >= kv * block_k + cols
+        keep = tri if keep is None else jnp.logical_and(keep, tri)
+    lse = lse_ref[0, 0][:, None]                            # [bq, 1]
+    p = jnp.exp(jnp.where(keep, s, NEG_INF) - lse) if keep is not None \
+        else jnp.exp(s - lse)
+    if keep is not None:
+        p = jnp.where(keep, p, 0.0)                         # see fwd kernel
+    return p                                                # [bq, bk]
+
+
+def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k, n_kv):
+    qi = pl.program_id(1)
+    kv = pl.program_id(2)
+
+    @pl.when(kv == 0)
+    def _():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def compute():
+        p = _recompute_p(q_ref, k_ref, lse_ref, mask_ref, scale=scale,
+                         causal=causal, qi=qi, kv=kv,
+                         block_q=block_q, block_k=block_k)
+        dp = jax.lax.dot_general(                       # dO @ V^T  [bq, bk]
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None])        # [bq, bk]
+        dq_acc[...] += scale * jax.lax.dot_general(     # ds @ K    [bq, d]
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(kv * block_k <= qi * block_q + (block_q - 1))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(kv == n_kv - 1)
+    def _():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, block_q, block_k, n_q):
+    kv = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        p = _recompute_p(q_ref, k_ref, lse_ref, mask_ref, scale=scale,
+                         causal=causal, qi=qi, kv=kv,
+                         block_q=block_q, block_k=block_k)
+        dv_acc[...] += jax.lax.dot_general(             # P^T @ dO  [bk, d]
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        dk_acc[...] += scale * jax.lax.dot_general(     # ds^T @ Q  [bk, d]
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(qi * block_q + (block_q - 1) >= kv * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == n_q - 1)
+    def _():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
+               block_q, block_k, interpret):
+    bn, s_q, d = q.shape
+    s_kv = k.shape[1]
+    bq, bk = min(block_q, s_q), min(block_k, s_kv)
+    n_q, n_kv = s_q // bq, s_kv // bk
+
+    # delta_i = rowsum(dO_i * O_i) — tiny elementwise reduce; let XLA fuse it.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+    lse3 = lse[:, None, :]
+
+    q_spec_qmajor = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    kv_spec_qmajor = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
+    row_spec_qmajor = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))
+
+    common = [q, k, v, do, lse3, delta]
+
+    def with_mask(kernel, index_map):
+        if mask is None:
+            return functools.partial(kernel, None), [], []
+        n_heads = bn // mask.shape[0]
+        spec = pl.BlockSpec((1, 1, bk), functools.partial(index_map, n_heads))
+        return kernel, [spec], [mask[:, None, :]]
+
+    # --- dq: grid (bn, q blocks, kv blocks) ---
+    kernel, mspec, margs = with_mask(
+        _bwd_dq_kernel, lambda h, b, i, j: (b // h, 0, j))
+    dq = pl.pallas_call(
+        functools.partial(kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, n_kv=n_kv),
+        grid=(bn, n_q, n_kv),
+        in_specs=mspec + [q_spec_qmajor, kv_spec_qmajor, kv_spec_qmajor,
+                          q_spec_qmajor, row_spec_qmajor, row_spec_qmajor],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=_sds(q, q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(*margs, *common)
+
+    # --- dk/dv: grid (bn, kv blocks, q blocks) ---
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
+    row_spec = pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i))
+    kernel, mspec, margs = with_mask(
+        _bwd_dkv_kernel, lambda h, b, j, i: (b // h, 0, j))
+    dk, dv = pl.pallas_call(
+        functools.partial(kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, n_q=n_q),
+        grid=(bn, n_kv, n_q),
+        in_specs=mspec + [q_spec, kv_spec, kv_spec, q_spec, row_spec,
+                          row_spec],
+        out_specs=[pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+                   pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))],
+        out_shape=[_sds(q, k.shape, k.dtype),
+                   _sds(q, v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(*margs, *common)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, mask, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, mask, scale=q.shape[-1] ** -0.5,
+                        causal=causal, block_q=block_q, block_k=block_k,
+                        interpret=interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, mask, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, mask, scale=q.shape[-1] ** -0.5,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          interpret=interpret)
+    return out, (q, k, v, mask, out, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, mask, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, mask, out, lse, do,
+                            scale=q.shape[-1] ** -0.5, causal=causal,
+                            block_q=block_q, block_k=block_k,
+                            interpret=interpret)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_mha(q: jax.Array, k: jax.Array, v: jax.Array, *,
+              mask: jax.Array | None = None, causal: bool = False,
+              block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+              interpret: bool | None = None) -> jax.Array:
+    """Flash multi-head attention.
+
+    Args:
+      q, k, v: ``[batch, seq, heads, head_dim]`` (the attention.py layout).
+      mask: optional ``[batch, seq_kv]`` key-padding mask, 1 = attend.
+      causal: apply a causal (autoregressive) mask; above-diagonal key/value
+        blocks are skipped entirely, halving the work.
+      interpret: run under the Pallas interpreter (defaults to True off-TPU,
+        which is how the CPU test suite executes this kernel).
+
+    Returns ``[batch, seq, heads, head_dim]`` attention output in q's dtype.
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    if not supported(q, k, block_q, block_k):
+        raise ValueError(
+            f"flash_mha: shapes q={q.shape} k={k.shape} do not tile into "
+            f"block_q={block_q}, block_k={block_k} blocks; use "
+            f"tpuframe.ops.attention.multihead_attention for the fallback")
+    b, s_q, n, d = q.shape
+    s_kv = k.shape[1]
+
+    def fold(x):  # [B, S, N, D] → [B*N, S, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * n, x.shape[1], d)
+
+    mask = None if mask is None else mask.astype(jnp.int32)
+    out = _flash(fold(q), fold(k), fold(v), mask, causal,
+                 block_q, block_k, interpret)
+    return out.reshape(b, n, s_q, d).transpose(0, 2, 1, 3)
