@@ -26,6 +26,7 @@ def multihead_attention(
     v: jax.Array,  # [B, S, N, D]
     *,
     mask: jax.Array | None = None,  # [B, S] 1=keep or broadcastable [B,1,S,S]
+    causal: bool = False,
     dropout_rate: float = 0.0,
     dropout_rng: jax.Array | None = None,
     impl: str | None = None,
@@ -40,10 +41,17 @@ def multihead_attention(
         if (flash_attention is not None and dropout_rate == 0.0
                 and flash_attention.supported(q, k)
                 and (mask is None or mask.ndim == 2)):
-            return flash_attention.flash_mha(q, k, v, mask=mask)
+            return flash_attention.flash_mha(q, k, v, mask=mask, causal=causal)
         impl = "xla"  # dropout / unsupported shapes / missing kernel fall back
     if impl != "xla":
         raise ValueError(f"unknown attention impl {impl!r}")
+    if causal:
+        s_q, s_kv = q.shape[1], k.shape[1]
+        tri = jnp.tril(jnp.ones((s_q, s_kv), bool))[None, None]
+        if mask is not None:
+            pad = mask[:, None, None, :] if mask.ndim == 2 else mask
+            tri = jnp.logical_and(tri, pad.astype(bool))
+        mask = tri
     return _xla_attention(q, k, v, mask=mask, dropout_rate=dropout_rate,
                           dropout_rng=dropout_rng)
 

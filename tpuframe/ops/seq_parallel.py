@@ -1,0 +1,153 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference never scales the sequence dimension (its longest workload is
+BERT-base GLUE, seq ≤ 512 — SURVEY.md §5.7); this framework makes
+long-context training first-class.  Both strategies run *inside* a
+``shard_map`` over the mesh's ``seq`` axis, with the sequence dimension of
+activations sharded across chips:
+
+  * **Ring attention** — K/V chunks rotate around the ``seq`` axis ring via
+    ``lax.ppermute`` (ICI neighbor hops); each device accumulates its query
+    chunk's attention over every K/V chunk with online-softmax merging, so
+    the full S×S score matrix never exists on any chip and per-chip memory
+    is O(S/n).  This is the classic blockwise/ring formulation; gradients
+    flow through the rotation automatically (the transpose of ppermute is
+    the reverse ring).
+
+  * **Ulysses** — two ``all_to_all``s re-shard [B, S/n, N, D] → [B, S, N/n, D]
+    so each device sees the whole sequence for a subset of heads, runs plain
+    (or pallas flash) attention locally, then re-shards back.  Cheaper in
+    collective volume for moderate S; requires heads % seq_size == 0.
+
+Both are numerically identical to full attention over the gathered sequence
+(tests/test_seq_parallel.py asserts this against the XLA reference on the
+8-device virtual mesh).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30  # matches tpuframe.ops.flash_attention.NEG_INF
+
+
+def _chunk_attn(q, k, v, keep, scale):
+    """Unnormalized blockwise attention in f32.
+
+    q: [B, Cq, N, D]; k/v: [B, Ck, N, D]; keep: [B, 1, Cq, Ck] bool or None.
+    Returns (acc [B, Cq, N, D] f32, m [B, N, Cq] f32, l [B, N, Cq] f32).
+    """
+    s = jnp.einsum("bqnd,bknd->bnqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if keep is not None:
+        s = jnp.where(keep, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # [B, N, Cq]
+    p = jnp.exp(s - m[..., None])
+    if keep is not None:
+        p = jnp.where(keep, p, 0.0)  # fully-masked rows stay exactly zero
+    l = jnp.sum(p, axis=-1)                                   # [B, N, Cq]
+    acc = jnp.einsum("bnqk,bknd->bqnd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return acc, m, l
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis: str = "seq",
+                   mask: jax.Array | None = None,
+                   causal: bool = False) -> jax.Array:
+    """Exact attention over a sequence sharded across the ``axis`` ring.
+
+    Must be called inside ``shard_map`` with ``axis`` bound.  Per-device
+    inputs are the local sequence chunk ``[B, S/n, N, D]`` (and ``mask``
+    ``[B, S/n]``, 1 = attend, for the *local keys*).  Output is the local
+    query chunk's attention over the FULL sequence, ``[B, S/n, N, D]``.
+
+    Causal masking uses global positions: device ``i``'s queries occupy
+    ``[i*C, (i+1)*C)`` of the gathered sequence.
+    """
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    b, c, heads, d = q.shape
+    scale = d ** -0.5
+    perm = [(i, (i + 1) % n) for i in range(n)]  # rotate kv chunks rightward
+
+    def make_keep(kv_owner, kv_mask):
+        keep = None
+        if kv_mask is not None:
+            keep = (kv_mask != 0)[:, None, None, :]           # [B,1,1,Ck]
+            keep = jnp.broadcast_to(keep, (b, 1, c, c))
+        if causal:
+            q_pos = my * c + jnp.arange(c)[:, None]           # [Cq, 1]
+            kv_pos = kv_owner * c + jnp.arange(c)[None, :]    # [1, Ck]
+            tri = (q_pos >= kv_pos)[None, None]               # [1,1,Cq,Ck]
+            tri = jnp.broadcast_to(tri, (b, 1, c, c))
+            keep = tri if keep is None else jnp.logical_and(keep, tri)
+        return keep
+
+    def step(carry, i):
+        acc, m, l, kv_k, kv_v, kv_mask = carry
+        kv_owner = (my - i) % n  # whose chunk we hold after i rotations
+        acc_c, m_c, l_c = _chunk_attn(q, kv_k, kv_v,
+                                      make_keep(kv_owner, kv_mask), scale)
+        m_new = jnp.maximum(m, m_c)
+        a1 = jnp.exp(m - m_new)
+        a2 = jnp.exp(m_c - m_new)
+        # [B, N, Cq] stats scale the [B, Cq, N, D] accumulator.
+        t = lambda x: x.transpose(0, 2, 1)[..., None]  # noqa: E731
+        acc = acc * t(a1) + acc_c * t(a2)
+        l = l * a1 + l_c * a2
+        m = m_new
+        kv_k = lax.ppermute(kv_k, axis, perm)
+        kv_v = lax.ppermute(kv_v, axis, perm)
+        if kv_mask is not None:
+            kv_mask = lax.ppermute(kv_mask, axis, perm)
+        return (acc, m, l, kv_k, kv_v, kv_mask), None
+
+    # Fresh accumulators are unvarying; mark them varying over the same mesh
+    # axes as q so the scan carry type is stable under shard_map's vma checks.
+    vary = lambda x: lax.pvary(x, tuple(jax.typeof(q).vma))  # noqa: E731
+    init = (
+        vary(jnp.zeros((b, c, heads, d), jnp.float32)),
+        vary(jnp.full((b, heads, c), NEG_INF, jnp.float32)),
+        vary(jnp.zeros((b, heads, c), jnp.float32)),
+        k, v, mask,
+    )
+    (acc, m, l, *_), _ = lax.scan(step, init, jnp.arange(n))
+    l = l.transpose(0, 2, 1)[..., None]                       # [B, Cq, N, 1]
+    return (acc / jnp.where(l == 0.0, 1.0, l)).astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis: str = "seq",
+                      mask: jax.Array | None = None,
+                      causal: bool = False,
+                      impl: str | None = None) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence-parallel attention.
+
+    Re-shards seq→heads so each device runs full-sequence attention on
+    ``heads/n`` heads — the inner attention is the regular dispatch
+    (``tpuframe.ops.attention``), so the pallas flash kernel applies.
+    Requires ``heads % axis_size == 0``.
+    """
+    from tpuframe.ops import attention as attn_ops
+
+    n = lax.axis_size(axis)
+    b, c, heads, d = q.shape
+    if heads % n != 0:
+        raise ValueError(f"ulysses needs heads ({heads}) % seq axis ({n}) == 0")
+
+    def to_heads(x):  # [B, S/n, N, D] → [B, S, N/n, D]
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    def to_seq(x):    # [B, S, N/n, D] → [B, S/n, N, D]
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    full_mask = None
+    if mask is not None:
+        full_mask = lax.all_gather(mask, axis, axis=1, tiled=True)  # [B, S]
+    out = attn_ops.multihead_attention(qh, kh, vh, mask=full_mask,
+                                        causal=causal, impl=impl)
+    return to_seq(out)
