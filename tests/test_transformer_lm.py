@@ -1,0 +1,124 @@
+"""TransformerLM + sequence-parallel training: the golden-loss invariant
+(SURVEY.md §7 test strategy) extended to the seq axis — the same model, data
+and seed must produce the same losses whether the sequence is sharded over
+8 virtual devices (ring or Ulysses attention) or run unsharded on one."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpuframe import models
+from tpuframe.models import losses
+from tpuframe.models.transformer_lm import LMConfig, TransformerLM
+from tpuframe.parallel import mesh as mesh_lib
+from tpuframe.parallel import step as step_lib
+from tpuframe.utils.config import get_config
+
+
+def _data(b=8, s=64, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, size=(b, s + 1)).astype(np.int32)
+    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+
+def _make_step(model, mesh, shard_seq):
+    from jax.sharding import PartitionSpec as P
+
+    tx = optax.adam(1e-3)
+
+    def loss_fn(params, model_state, batch, rng):
+        logits = model.apply({"params": params}, batch["input_ids"],
+                             train=True, rngs={"dropout": rng})
+        loss = losses.softmax_cross_entropy(logits, batch["labels"])
+        return loss, ({}, {"acc": losses.accuracy(logits, batch["labels"])})
+
+    kwargs = {}
+    if shard_seq:
+        part = P(mesh_lib.BATCH_AXES, "seq")
+        kwargs = dict(batch_partition=part,
+                      reduce_axes=(*mesh_lib.BATCH_AXES, "seq"))
+    return tx, step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
+                                        **kwargs)
+
+
+def _train_steps(seq_mode, n_steps=3, mesh_spec=None):
+    cfg = LMConfig.tiny(vocab_size=64, seq_mode=seq_mode, max_seq=64)
+    model = TransformerLM(cfg)
+    batch = _data()
+    variables = model.init(jax.random.key(0),
+                           jnp.asarray(batch["input_ids"][:1]))
+
+    mesh = mesh_lib.make_mesh(mesh_spec) if mesh_spec else None
+    tx, train_step = _make_step(model, mesh, shard_seq=(seq_mode != "none"))
+    state = step_lib.TrainState.create(variables["params"], tx)
+    if mesh is not None:
+        state = step_lib.replicate_state(state, mesh)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        part = (P(mesh_lib.BATCH_AXES, "seq") if seq_mode != "none"
+                else mesh_lib.batch_spec())
+        batch = jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, part)), batch)
+
+    lost = []
+    for _ in range(n_steps):
+        state, metrics = train_step(state, batch)
+        lost.append(float(metrics["loss"]))
+    return lost
+
+
+def test_ring_golden_loss_vs_unsharded():
+    ref = _train_steps("none")
+    got = _train_steps("ring", mesh_spec=mesh_lib.MeshSpec(data=2, seq=4))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+    assert ref[-1] < ref[0]  # actually learning
+
+
+def test_ulysses_golden_loss_vs_unsharded():
+    ref = _train_steps("none")
+    got = _train_steps("ulysses", mesh_spec=mesh_lib.MeshSpec(data=2, seq=4))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_remat_matches_no_remat():
+    batch = _data(b=2, s=32)
+    outs = []
+    for remat in (False, True):
+        cfg = LMConfig.tiny(vocab_size=64, remat=remat, max_seq=32)
+        model = TransformerLM(cfg)
+        v = model.init(jax.random.key(0), jnp.asarray(batch["input_ids"]))
+
+        def loss(params):
+            logits = model.apply({"params": params},
+                                 jnp.asarray(batch["input_ids"]), train=True,
+                                 rngs={"dropout": jax.random.key(1)})
+            return losses.softmax_cross_entropy(logits,
+                                                jnp.asarray(batch["labels"]))
+
+        l, g = jax.value_and_grad(loss)(v["params"])
+        outs.append((l, g))
+    np.testing.assert_allclose(outs[0][0], outs[1][0], rtol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+                 outs[0][1], outs[1][1])
+
+
+def test_registry_and_config():
+    model = models.get_model("transformer-lm", tiny=True)
+    assert isinstance(model, TransformerLM)
+    cfg = get_config("lm_smoke")
+    assert cfg.shard_seq and cfg.mesh.seq == 4
+
+
+def test_rope_position_offset_consistency():
+    """RoPE with global offsets: a chunked forward with explicit positions
+    equals the full-sequence forward — the property the seq-sharded model
+    relies on (lax.axis_index offset)."""
+    from tpuframe.models.transformer_lm import rope
+
+    x = jax.random.normal(jax.random.key(0), (1, 16, 2, 8))
+    full = rope(x, jnp.arange(16), 10000.0)
+    lo = rope(x[:, :8], jnp.arange(8), 10000.0)
+    hi = rope(x[:, 8:], 8 + jnp.arange(8), 10000.0)
+    np.testing.assert_allclose(jnp.concatenate([lo, hi], axis=1), full,
+                               rtol=1e-6, atol=1e-6)

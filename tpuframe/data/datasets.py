@@ -225,6 +225,52 @@ def _tokenize(sents, labels, seq_len, vocab_size, tokenizer):
 
 
 # ---------------------------------------------------------------------------
+# Causal LM — long-context workload (beyond the reference's capability bar)
+# ---------------------------------------------------------------------------
+
+def lm_text(data_dir: str | None = None, *, seq_len: int = 2048,
+            vocab_size: int = 32000, synthetic_size: int = 256):
+    """Next-token-prediction chunks: input_ids [N, S], labels [N, S] int32
+    (labels pre-shifted on the host so the loss is positionwise — no
+    cross-shard shift is needed when the sequence dim is sharded over the
+    mesh's seq axis).
+
+    With ``data_dir``: reads ``tokens.npy`` (a single int32 token stream,
+    e.g. pre-tokenized wikitext) and chunks it; synthetic mode generates an
+    order-2 structured stream so convergence tests are meaningful.
+    """
+    if data_dir is not None:
+        stream = np.load(io.BytesIO(gcs.read_bytes(gcs.join(data_dir, "tokens.npy"))))
+        stream = stream.astype(np.int32) % vocab_size
+        n = (len(stream) - 1) // seq_len
+        split = max(int(0.98 * n), 1)
+        def chunk(lo, hi):
+            ids = np.stack([stream[i*seq_len:(i+1)*seq_len] for i in range(lo, hi)])
+            lbl = np.stack([stream[i*seq_len+1:(i+1)*seq_len+1] for i in range(lo, hi)])
+            return ArrayDataset({"input_ids": ids, "labels": lbl})
+        return chunk(0, split), chunk(split, n)
+    return (_synthetic_lm(synthetic_size, seq_len, vocab_size, seed=8),
+            _synthetic_lm(max(synthetic_size // 8, 8), seq_len, vocab_size, seed=9))
+
+
+def _synthetic_lm(n, seq_len, vocab_size, *, seed):
+    """Deterministic affine-recurrence token stream: x_{t+1} =
+    (a*x_t + b) mod V with occasional noise — next-token loss can fall well
+    below log(V), so "loss decreases" tests measure learning, not chance."""
+    rng = np.random.default_rng(seed)
+    starts = rng.integers(0, vocab_size, size=n)
+    a, b = 31, 17
+    ids = np.empty((n, seq_len + 1), np.int64)
+    ids[:, 0] = starts
+    for t in range(seq_len):
+        ids[:, t + 1] = (a * ids[:, t] + b) % vocab_size
+    noise = rng.random((n, seq_len + 1)) < 0.05
+    ids[noise] = rng.integers(0, vocab_size, size=int(noise.sum()))
+    return ArrayDataset({"input_ids": ids[:, :-1].astype(np.int32),
+                         "labels": ids[:, 1:].astype(np.int32)})
+
+
+# ---------------------------------------------------------------------------
 # Synthetic generators (deterministic; shapes/dtypes match the real data)
 # ---------------------------------------------------------------------------
 

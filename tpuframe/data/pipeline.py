@@ -48,6 +48,7 @@ class ShardedLoader:
         seed: int = 0,
         prefetch: int = 2,
         shard_by_host: bool = True,
+        partition=None,
     ):
         # The remainder partial batch is always dropped: compiled SPMD steps
         # need static shapes, and a ragged final batch would both recompile
@@ -80,6 +81,10 @@ class ShardedLoader:
             raise ValueError(
                 f"host shard has {len(self.dataset)} examples < host batch "
                 f"{self.host_batch}")
+        # ``partition``: PartitionSpec override (seq-parallel configs shard
+        # the sequence dim too); trimmed per-leaf to the array rank at
+        # device_put so mixed-rank batches work.
+        self._partition = partition
         self._sharding = (mesh_lib.batch_sharding(mesh)
                           if mesh is not None else None)
 
@@ -134,8 +139,14 @@ class ShardedLoader:
         # Host rows are this host's slice of the global batch; device_put with
         # a NamedSharding scatters rows to local devices and (multi-host)
         # assembles the logically-global array without gathering.
-        return jax.tree.map(
-            lambda x: _put_host_shard(x, self._sharding, self.global_batch), batch)
+        def put(x):
+            sharding = self._sharding
+            if self._partition is not None:
+                from jax.sharding import PartitionSpec as P
+                sharding = NamedSharding(self.mesh,
+                                         P(*self._partition[:x.ndim]))
+            return _put_host_shard(x, sharding, self.global_batch)
+        return jax.tree.map(put, batch)
 
 
 def _put_host_shard(x: np.ndarray, sharding: NamedSharding, global_batch: int):

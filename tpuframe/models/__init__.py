@@ -14,6 +14,7 @@ from typing import Any, Callable
 from tpuframe.models.convnet import ConvNet
 from tpuframe.models.resnet import ResNet, ResNet18, ResNet50
 from tpuframe.models.bert import BertConfig, BertForSequenceClassification
+from tpuframe.models.transformer_lm import LMConfig, TransformerLM
 
 def _bert_base(dtype=None, **kwargs):
     """Registry adapter: flag-style kwargs → BertConfig (so get_model's
@@ -26,11 +27,21 @@ def _bert_base(dtype=None, **kwargs):
     return BertForSequenceClassification(BertConfig.base(**kwargs))
 
 
+def _transformer_lm(dtype=None, tiny=False, **kwargs):
+    import numpy as np
+
+    if dtype is not None:
+        kwargs.setdefault("dtype", str(np.dtype(dtype)))
+    cfg = LMConfig.tiny(**kwargs) if tiny else LMConfig(**kwargs)
+    return TransformerLM(cfg)
+
+
 _REGISTRY: dict[str, Callable[..., Any]] = {
     "convnet": ConvNet,
     "resnet18": ResNet18,
     "resnet50": ResNet50,
     "bert-base": _bert_base,
+    "transformer-lm": _transformer_lm,
 }
 
 
@@ -43,6 +54,8 @@ def get_model(name: str, **kwargs):
 
 __all__ = [
     "ConvNet",
+    "LMConfig",
+    "TransformerLM",
     "ResNet",
     "ResNet18",
     "ResNet50",

@@ -1,0 +1,159 @@
+"""Decoder-only causal transformer LM — the long-context workload.
+
+Beyond the reference's capability bar (its longest sequence is BERT-base
+GLUE at 512 tokens — SURVEY.md §5.7): this model exists to exercise the
+framework's first-class long-context path.  Architecture is the standard
+modern decoder: pre-LN, RoPE, GELU MLP, untied LM head, bf16-compute capable.
+
+Sequence parallelism is a *model config*, not a code fork: with
+``seq_mode="ring"`` or ``"ulysses"`` the attention core runs the
+sequence-parallel kernels from :mod:`tpuframe.ops.seq_parallel` over the
+mesh's ``seq`` axis, and RoPE positions are offset by the device's global
+chunk position (``lax.axis_index``).  Outside shard_map (or with the seq
+axis unbound / size 1) the same model falls back to full attention — the
+laptop-to-pod property the framework keeps everywhere.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class LMConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_seq: int = 8192
+    dropout: float = 0.0
+    rope_theta: float = 10000.0
+    dtype: str = "float32"          # "bfloat16" for MXU throughput
+    attn_impl: str | None = None    # None → TPUFRAME_ATTN_IMPL env / xla
+    seq_axis: str = "seq"
+    seq_mode: str = "none"          # none | ring | ulysses
+    remat: bool = False             # jax.checkpoint each block (long-context)
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw) -> "LMConfig":
+        base = dict(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, intermediate_size=128, max_seq=512)
+        base.update(kw)
+        return cls(**base)
+
+
+def _seq_axis_bound(name: str) -> bool:
+    try:
+        lax.axis_size(name)
+    except NameError:
+        return False
+    return True
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding. x: [B, S, N, D]; positions: [S] global."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)  # [D/2]
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.stack([xf1 * cos - xf2 * sin, xf1 * sin + xf2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x, positions, *, train: bool):
+        from tpuframe.ops import attention as attn_ops
+        from tpuframe.ops import seq_parallel
+
+        c = self.cfg
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (c.num_heads, c.head_dim), use_bias=False, dtype=c.jnp_dtype,
+            name=name)
+        q = rope(dense("query")(x), positions, c.rope_theta)
+        k = rope(dense("key")(x), positions, c.rope_theta)
+        v = dense("value")(x)
+
+        mode = c.seq_mode
+        if mode != "none" and not _seq_axis_bound(c.seq_axis):
+            mode = "none"  # unmapped run of a seq-parallel config
+        if mode == "ring":
+            y = seq_parallel.ring_attention(q, k, v, axis=c.seq_axis,
+                                            causal=True)
+        elif mode == "ulysses":
+            y = seq_parallel.ulysses_attention(q, k, v, axis=c.seq_axis,
+                                               causal=True, impl=c.attn_impl)
+        elif mode == "none":
+            y = attn_ops.multihead_attention(q, k, v, causal=True,
+                                             impl=c.attn_impl)
+        else:
+            raise ValueError(f"unknown seq_mode {c.seq_mode!r}")
+        return nn.DenseGeneral(c.hidden_size, axis=(-2, -1), use_bias=False,
+                               dtype=c.jnp_dtype, name="out")(y)
+
+
+class Block(nn.Module):
+    cfg: LMConfig
+    train: bool = False  # attribute (not call arg) so nn.remat sees only arrays
+
+    @nn.compact
+    def __call__(self, x, positions):
+        c = self.cfg
+        train = self.train
+        h = nn.LayerNorm(use_bias=False, name="attn_ln")(x)
+        h = CausalSelfAttention(c, name="attn")(h, positions, train=train)
+        h = nn.Dropout(c.dropout, deterministic=not train)(h)
+        x = x + h
+        h = nn.LayerNorm(use_bias=False, name="mlp_ln")(x)
+        h = nn.Dense(c.intermediate_size, use_bias=False, dtype=c.jnp_dtype,
+                     name="up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(c.hidden_size, use_bias=False, dtype=c.jnp_dtype,
+                     name="down")(h)
+        h = nn.Dropout(c.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """input_ids [B, S_local] → logits [B, S_local, V] (f32)."""
+
+    cfg: LMConfig = field(default_factory=LMConfig)
+
+    @nn.compact
+    def __call__(self, input_ids, *, train: bool = False):
+        c = self.cfg
+        s_local = input_ids.shape[-1]
+        # Global positions: offset by this device's chunk index when the
+        # sequence dimension is sharded over the seq axis.
+        start = 0
+        if c.seq_mode != "none" and _seq_axis_bound(c.seq_axis):
+            start = lax.axis_index(c.seq_axis) * s_local
+        positions = start + jnp.arange(s_local)
+
+        x = nn.Embed(c.vocab_size, c.hidden_size, name="embed")(input_ids)
+        x = x.astype(c.jnp_dtype)
+        block = nn.remat(Block) if c.remat else Block
+        for i in range(c.num_layers):
+            x = block(c, train, name=f"block_{i}")(x, positions)
+        x = nn.LayerNorm(use_bias=False, name="final_ln")(x)
+        logits = nn.Dense(c.vocab_size, use_bias=False, name="lm_head")(x)
+        return logits.astype(jnp.float32)

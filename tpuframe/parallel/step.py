@@ -111,8 +111,16 @@ def make_train_step(
     *,
     mode: str = "shard_map",
     donate: bool = True,
+    batch_partition: P | None = None,
+    reduce_axes: tuple[str, ...] | None = None,
 ):
     """Build the compiled train step.
+
+    ``batch_partition``/``reduce_axes``: sequence-parallel configs pass
+    ``P(('data','fsdp'), 'seq')`` and ``('data','fsdp','seq')`` so batches
+    shard along their sequence dim and the loss mean spans the seq axis.
+    A non-default ``batch_partition`` applies to every batch leaf, so all
+    leaves must share the partitioned ranks.
 
     ``mesh=None`` → single-device jit (config 1, SURVEY.md §7 step 1): same
     body, no collectives — the property the reference gets from Horovod's
@@ -124,10 +132,14 @@ def make_train_step(
 
     # Reduce over every batch-like axis, including size-1 ones: a size-1 pmean
     # is free after compilation but tells shard_map's replication checker the
-    # outputs are single-valued across those axes.
-    axes = mesh_lib.BATCH_AXES
+    # outputs are single-valued across those axes.  Sequence-parallel configs
+    # extend both: the batch is additionally sharded along its seq dim and the
+    # loss mean spans the seq axis too.
+    axes = reduce_axes if reduce_axes is not None else mesh_lib.BATCH_AXES
     repl = NamedSharding(mesh, P())
-    batch_sh = mesh_lib.batch_sharding(mesh)
+    batch_part = (batch_partition if batch_partition is not None
+                  else mesh_lib.batch_spec())
+    batch_sh = NamedSharding(mesh, batch_part)
 
     if mode == "jit":
         # Auto-SPMD: annotate shardings, let the partitioner insert collectives.
@@ -143,10 +155,9 @@ def make_train_step(
         raise ValueError(f"unknown step mode {mode!r}")
 
     body = functools.partial(_grad_step, loss_fn, tx, axes)
-    batch_spec = mesh_lib.batch_spec()
     mapped = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(), batch_spec),
+        in_specs=(P(), batch_part),
         out_specs=(P(), P()),
     )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
@@ -155,6 +166,9 @@ def make_train_step(
 def make_eval_step(
     metric_fn: Callable[[PyTree, PyTree, PyTree], dict],
     mesh: Mesh | None = None,
+    *,
+    batch_partition: P | None = None,
+    reduce_axes: tuple[str, ...] | None = None,
 ):
     """Forward-only step with cross-replica metric averaging.
 
@@ -165,7 +179,9 @@ def make_eval_step(
     if mesh is None:
         return jax.jit(lambda s, b: metric_fn(s.params, s.model_state, b))
 
-    axes = mesh_lib.BATCH_AXES
+    axes = reduce_axes if reduce_axes is not None else mesh_lib.BATCH_AXES
+    batch_part = (batch_partition if batch_partition is not None
+                  else mesh_lib.batch_spec())
 
     def body(state: TrainState, batch: PyTree) -> dict:
         metrics = metric_fn(state.params, state.model_state, batch)
@@ -173,7 +189,7 @@ def make_eval_step(
 
     mapped = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(), mesh_lib.batch_spec()),
+        in_specs=(P(), batch_part),
         out_specs=P(),
     )
     return jax.jit(mapped)

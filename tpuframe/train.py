@@ -45,12 +45,28 @@ def build_datasets(cfg: TrainConfig):
         "cifar10": datasets.cifar10,
         "imagenet": datasets.imagenet,
         "glue_sst2": datasets.glue_sst2,
+        "lm_text": datasets.lm_text,
     }[cfg.dataset]
     return builder(cfg.data_dir, **cfg.dataset_kwargs)
 
 
 def _is_text_task(cfg: TrainConfig) -> bool:
     return cfg.dataset == "glue_sst2"
+
+
+def _is_lm_task(cfg: TrainConfig) -> bool:
+    return cfg.dataset == "lm_text"
+
+
+def _batch_layout(cfg: TrainConfig):
+    """(loader partition, step batch_partition, reduce axes) for the config.
+    Sequence-parallel configs shard the batch's seq dim and extend the loss
+    mean over the seq axis; everything else uses the pure batch layout."""
+    from jax.sharding import PartitionSpec as P
+    if cfg.shard_seq:
+        part = P(mesh_lib.BATCH_AXES, "seq")
+        return part, part, (*mesh_lib.BATCH_AXES, "seq")
+    return None, None, None
 
 
 @dataclass
@@ -77,14 +93,15 @@ def build_harness(cfg: TrainConfig) -> Harness:
     model = models.get_model(cfg.model, dtype=dtype, **cfg.model_kwargs)
 
     train_ds, eval_ds = build_datasets(cfg)
+    loader_part, step_part, reduce_axes = _batch_layout(cfg)
     train_loader = ShardedLoader(train_ds, cfg.global_batch, mesh,
-                                 seed=cfg.seed)
+                                 seed=cfg.seed, partition=loader_part)
     eval_loader = ShardedLoader(eval_ds, cfg.global_batch, mesh,
-                                shuffle=False)
+                                shuffle=False, partition=loader_part)
 
     sample = train_ds[:2]
     rng = jax.random.key(cfg.seed)
-    if _is_text_task(cfg):
+    if _is_text_task(cfg) or _is_lm_task(cfg):
         variables = model.init(rng, jnp.asarray(sample["input_ids"]))
     else:
         variables = model.init(rng, jnp.asarray(sample["image"]))
@@ -98,8 +115,11 @@ def build_harness(cfg: TrainConfig) -> Harness:
         state = step_lib.replicate_state(state, mesh)
 
     loss_fn = make_loss_fn(cfg, model)
-    train_step = step_lib.make_train_step(loss_fn, tx, mesh)
-    eval_step = step_lib.make_eval_step(make_metric_fn(cfg, model), mesh)
+    train_step = step_lib.make_train_step(
+        loss_fn, tx, mesh, batch_partition=step_part, reduce_axes=reduce_axes)
+    eval_step = step_lib.make_eval_step(
+        make_metric_fn(cfg, model), mesh, batch_partition=step_part,
+        reduce_axes=reduce_axes)
 
     manager = None
     start_step = 0
@@ -121,6 +141,17 @@ def build_harness(cfg: TrainConfig) -> Harness:
 
 
 def make_loss_fn(cfg: TrainConfig, model) -> step_lib.LossFn:
+    if _is_lm_task(cfg):
+        def loss_fn(params, model_state, batch, rng):
+            logits = model.apply({"params": params, **model_state},
+                                 batch["input_ids"], train=True,
+                                 rngs={"dropout": rng})
+            loss = losses.softmax_cross_entropy(logits, batch["labels"])
+            return loss, (model_state,
+                          {"accuracy": losses.accuracy(logits, batch["labels"])})
+
+        return loss_fn
+
     if _is_text_task(cfg):
         def loss_fn(params, model_state, batch, rng):
             logits = model.apply(
@@ -152,6 +183,16 @@ def make_loss_fn(cfg: TrainConfig, model) -> step_lib.LossFn:
 
 
 def make_metric_fn(cfg: TrainConfig, model):
+    if _is_lm_task(cfg):
+        def metric_fn(params, model_state, batch):
+            logits = model.apply({"params": params, **model_state},
+                                 batch["input_ids"])
+            loss = losses.softmax_cross_entropy(logits, batch["labels"])
+            return {"loss": loss, "perplexity": jnp.exp(loss),
+                    "accuracy": losses.accuracy(logits, batch["labels"])}
+
+        return metric_fn
+
     if _is_text_task(cfg):
         def metric_fn(params, model_state, batch):
             logits = model.apply({"params": params, **model_state},

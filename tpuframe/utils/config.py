@@ -30,6 +30,7 @@ class TrainConfig:
     # distribution
     distributed: bool = True        # False → config-1 style unmapped jit
     mesh: MeshSpec = field(default_factory=MeshSpec)
+    shard_seq: bool = False         # shard batch seq dim over the seq axis
 
     # optimization
     optimizer: str = "sgd"          # sgd | adamw
@@ -126,6 +127,38 @@ def _imagenet_resnet50_pod() -> TrainConfig:
     )
 
 
+def _lm_long() -> TrainConfig:
+    """Long-context causal LM with ring-attention sequence parallelism —
+    beyond the reference's capability bar (SURVEY.md §5.7); seq/data mesh
+    degrees come from --set mesh='{"data": N, "seq": M}'."""
+    return TrainConfig(
+        name="lm_long", model="transformer-lm",
+        model_kwargs={"seq_mode": "ring", "remat": True,
+                      "max_seq": 32768, "vocab_size": 32000},
+        dataset="lm_text", dataset_kwargs={"seq_len": 32768},
+        shard_seq=True, mesh=MeshSpec(data=1, seq=-1),
+        optimizer="adamw", base_lr=3e-4, scale_lr_by_batch=False,
+        warmup_steps=200, schedule="cosine", weight_decay=0.1,
+        grad_clip_norm=1.0, global_batch=8, total_steps=5000,
+        eval_every=500, compute_dtype="bfloat16",
+    )
+
+
+def _lm_smoke() -> TrainConfig:
+    """Tiny seq-parallel LM for tests/CI: 2-way data x 4-way seq on the
+    8-device virtual mesh."""
+    return TrainConfig(
+        name="lm_smoke", model="transformer-lm",
+        model_kwargs={"tiny": True, "seq_mode": "ring", "vocab_size": 64},
+        dataset="lm_text",
+        dataset_kwargs={"seq_len": 64, "vocab_size": 64, "synthetic_size": 64},
+        shard_seq=True, mesh=MeshSpec(data=2, seq=4),
+        optimizer="adamw", base_lr=3e-3, scale_lr_by_batch=False,
+        schedule="constant", global_batch=8, total_steps=40,
+        eval_every=20, eval_batches=2, log_every=10, ckpt_every=20,
+    )
+
+
 def _smoke() -> TrainConfig:
     """Tiny end-to-end config for tests/CI (not a reference workload)."""
     return TrainConfig(
@@ -143,6 +176,8 @@ WORKLOADS = {
     "imagenet_resnet50": _imagenet_resnet50,
     "glue_bert": _glue_bert,
     "imagenet_resnet50_pod": _imagenet_resnet50_pod,
+    "lm_long": _lm_long,
+    "lm_smoke": _lm_smoke,
     "smoke": _smoke,
 }
 
