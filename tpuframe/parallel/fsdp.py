@@ -1,0 +1,89 @@
+"""FSDP / ZeRO-style parameter + optimizer-state sharding over ``fsdp``.
+
+The reference is pure replicated-parameter data parallelism (SURVEY.md §3c);
+its optimizer state is replicated on every GPU.  On TPU the idiomatic
+memory-scaling upgrade is sharding parameters and optimizer state across a
+mesh axis and letting XLA's SPMD partitioner insert the all-gathers (before
+use) and reduce-scatters (of gradients) — cross-replica weight-update
+sharding (PAPERS.md:5) generalized to ZeRO-3.  No runtime machinery: the
+sharding is a *placement decision* expressed as ``NamedSharding``s on the
+``TrainState`` pytree, consumed by the auto-SPMD (``mode="jit"``) train step.
+
+Rule: each array leaf shards its largest dimension divisible by the fsdp
+axis size; indivisible or tiny leaves stay replicated.  The same rule
+applied to the optimizer state (whose momentum/variance leaves mirror the
+param shapes) yields consistent placement for the whole update.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+
+MIN_SHARD_ELEMENTS = 1024  # below this, sharding overhead beats the savings
+
+
+def auto_mesh(mesh: Mesh) -> Mesh:
+    """An Auto-axis-typed twin of ``mesh``.
+
+    ``jax.make_mesh`` yields Explicit axes (sharding-in-types), under which
+    auto-SPMD propagation refuses ambiguous ops (e.g. embedding gathers from
+    an fsdp-sharded table).  The FSDP path wants classic GSPMD propagation,
+    so its shardings are built on an Auto twin of the same device layout."""
+    if all(t == AxisType.Auto for t in mesh.axis_types):
+        return mesh
+    return Mesh(mesh.devices, mesh.axis_names,
+                axis_types=(AxisType.Auto,) * len(mesh.axis_names))
+
+
+def choose_spec(shape: tuple[int, ...], fsdp_size: int,
+                axis: str = "fsdp") -> P:
+    """Shard the largest divisible dim of ``shape`` over ``axis``."""
+    if fsdp_size <= 1 or int(np.prod(shape or (1,))) < MIN_SHARD_ELEMENTS:
+        return P()
+    dims = sorted(range(len(shape)), key=lambda i: shape[i], reverse=True)
+    for i in dims:
+        if shape[i] % fsdp_size == 0:
+            spec = [None] * len(shape)
+            spec[i] = axis
+            return P(*spec)
+    return P()
+
+
+def state_shardings(state: PyTree, mesh: Mesh, axis: str = "fsdp") -> PyTree:
+    """NamedSharding tree for a TrainState (or any pytree of arrays)."""
+    size = mesh.shape[axis]
+    amesh = auto_mesh(mesh)
+
+    def leaf(x) -> NamedSharding:
+        shape = tuple(getattr(x, "shape", ()))
+        return NamedSharding(amesh, choose_spec(shape, size, axis))
+
+    return jax.tree.map(leaf, state)
+
+
+def shard_state(state: PyTree, mesh: Mesh, axis: str = "fsdp") -> PyTree:
+    """Place a (host or replicated) TrainState with fsdp shardings."""
+    shardings = state_shardings(state, mesh, axis)
+    return jax.tree.map(jax.device_put, state, shardings)
+
+
+def param_fraction_sharded(state: PyTree, axis: str = "fsdp") -> float:
+    """Diagnostics: fraction of state elements whose placement splits ``axis``
+    (used by tests and the harness banner)."""
+    total, sharded = 0, 0
+    for leaf in jax.tree.leaves(state):
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        total += n
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        if spec is not None and any(
+                (ax == axis or (isinstance(ax, tuple) and axis in ax))
+                for ax in spec if ax is not None):
+            sharded += n
+    return sharded / max(total, 1)

@@ -113,6 +113,7 @@ def make_train_step(
     donate: bool = True,
     batch_partition: P | None = None,
     reduce_axes: tuple[str, ...] | None = None,
+    state_shardings: PyTree | None = None,
 ):
     """Build the compiled train step.
 
@@ -121,6 +122,11 @@ def make_train_step(
     shard along their sequence dim and the loss mean spans the seq axis.
     A non-default ``batch_partition`` applies to every batch leaf, so all
     leaves must share the partitioned ranks.
+
+    ``state_shardings``: a NamedSharding tree over the TrainState (see
+    tpuframe.parallel.fsdp) — selects the auto-SPMD ``jit`` mode with
+    parameters/optimizer state sharded; XLA inserts the all-gathers and
+    reduce-scatters of ZeRO-style training.
 
     ``mesh=None`` → single-device jit (config 1, SURVEY.md §7 step 1): same
     body, no collectives — the property the reference gets from Horovod's
@@ -141,13 +147,21 @@ def make_train_step(
                   else mesh_lib.batch_spec())
     batch_sh = NamedSharding(mesh, batch_part)
 
+    if state_shardings is not None:
+        mode = "jit"  # sharded state is an auto-SPMD placement decision
+        # All shardings must live on one mesh; the fsdp tree is built on an
+        # Auto-typed twin (see tpuframe.parallel.fsdp.auto_mesh).
+        any_leaf = jax.tree.leaves(state_shardings)[0]
+        repl = NamedSharding(any_leaf.mesh, P())
+        batch_sh = NamedSharding(any_leaf.mesh, batch_part)
     if mode == "jit":
         # Auto-SPMD: annotate shardings, let the partitioner insert collectives.
         body = functools.partial(_grad_step, loss_fn, tx, None)
+        state_sh = repl if state_shardings is None else state_shardings
         return jax.jit(
             body,
-            in_shardings=(repl, batch_sh),
-            out_shardings=(repl, repl),
+            in_shardings=(state_sh, batch_sh),
+            out_shardings=(state_sh, repl),
             donate_argnums=(0,) if donate else (),
         )
 
@@ -169,6 +183,7 @@ def make_eval_step(
     *,
     batch_partition: P | None = None,
     reduce_axes: tuple[str, ...] | None = None,
+    state_shardings: PyTree | None = None,
 ):
     """Forward-only step with cross-replica metric averaging.
 
@@ -182,6 +197,17 @@ def make_eval_step(
     axes = reduce_axes if reduce_axes is not None else mesh_lib.BATCH_AXES
     batch_part = (batch_partition if batch_partition is not None
                   else mesh_lib.batch_spec())
+
+    if state_shardings is not None:
+        # Auto-SPMD eval against fsdp-sharded state (shard_map would demand a
+        # replicated state); means over the sharded batch become global
+        # reductions via sharding propagation.
+        amesh = jax.tree.leaves(state_shardings)[0].mesh
+        return jax.jit(
+            lambda s, b: metric_fn(s.params, s.model_state, b),
+            in_shardings=(state_shardings, NamedSharding(amesh, batch_part)),
+            out_shardings=NamedSharding(amesh, P()),
+        )
 
     def body(state: TrainState, batch: PyTree) -> dict:
         metrics = metric_fn(state.params, state.model_state, batch)

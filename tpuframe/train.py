@@ -88,15 +88,23 @@ class Harness:
 def build_harness(cfg: TrainConfig) -> Harness:
     bootstrap.initialize()
     mesh = mesh_lib.make_mesh(cfg.mesh) if cfg.distributed else None
+    use_fsdp = mesh is not None and mesh.shape["fsdp"] > 1
+    data_mesh = mesh
+    if use_fsdp:
+        # FSDP inputs/state must share one mesh; the fsdp path lives on the
+        # Auto-typed twin (tpuframe.parallel.fsdp.auto_mesh).
+        from tpuframe.parallel import fsdp as fsdp_lib
+
+        data_mesh = fsdp_lib.auto_mesh(mesh)
 
     dtype = jnp.dtype(cfg.compute_dtype)
     model = models.get_model(cfg.model, dtype=dtype, **cfg.model_kwargs)
 
     train_ds, eval_ds = build_datasets(cfg)
     loader_part, step_part, reduce_axes = _batch_layout(cfg)
-    train_loader = ShardedLoader(train_ds, cfg.global_batch, mesh,
+    train_loader = ShardedLoader(train_ds, cfg.global_batch, data_mesh,
                                  seed=cfg.seed, partition=loader_part)
-    eval_loader = ShardedLoader(eval_ds, cfg.global_batch, mesh,
+    eval_loader = ShardedLoader(eval_ds, cfg.global_batch, data_mesh,
                                 shuffle=False, partition=loader_part)
 
     sample = train_ds[:2]
@@ -111,15 +119,24 @@ def build_harness(cfg: TrainConfig) -> Harness:
     tx = build_optimizer(cfg, params)
     state = step_lib.TrainState.create(params, tx, model_state=model_state,
                                        rng=jax.random.key(cfg.seed + 1))
-    if mesh is not None:
+    state_shardings = None
+    if use_fsdp:
+        # ZeRO/FSDP: params + optimizer state sharded over the fsdp axis
+        # (tpuframe.parallel.fsdp); the step switches to auto-SPMD mode.
+        from tpuframe.parallel import fsdp as fsdp_lib
+
+        state_shardings = fsdp_lib.state_shardings(state, mesh)
+        state = jax.tree.map(jax.device_put, state, state_shardings)
+    elif mesh is not None:
         state = step_lib.replicate_state(state, mesh)
 
     loss_fn = make_loss_fn(cfg, model)
     train_step = step_lib.make_train_step(
-        loss_fn, tx, mesh, batch_partition=step_part, reduce_axes=reduce_axes)
+        loss_fn, tx, mesh, batch_partition=step_part, reduce_axes=reduce_axes,
+        state_shardings=state_shardings)
     eval_step = step_lib.make_eval_step(
         make_metric_fn(cfg, model), mesh, batch_partition=step_part,
-        reduce_axes=reduce_axes)
+        reduce_axes=reduce_axes, state_shardings=state_shardings)
 
     manager = None
     start_step = 0
