@@ -1,0 +1,12 @@
+"""L5/L6: slice provisioning + SPMD launch (SURVEY.md §2, §4.2).
+
+Reference: gcloud GPU-fleet scripts + ``horovodrun`` [B:5]; here: TPU-VM
+slice lifecycle (provision), SSH fan-out of one SPMD binary per host
+(SliceLauncher), and a local multi-process fake cluster for CI
+(LocalCluster)."""
+
+from tpuframe.launch.provision import SliceConfig, emit_scripts
+from tpuframe.launch.launcher import LocalCluster, SliceLauncher, main
+
+__all__ = ["SliceConfig", "emit_scripts", "LocalCluster", "SliceLauncher",
+           "main"]

@@ -1,0 +1,3 @@
+from tpuframe.launch.launcher import main
+
+raise SystemExit(main())

@@ -1,0 +1,192 @@
+"""Launchers — L5 of the layer map: the ``horovodrun`` replacement.
+
+Reference launch path (SURVEY.md §4.2): ``horovodrun -np 32 -H a:8,... python
+train.py`` → mpirun/ssh spawns one process per GPU.  TPU-native SPMD launch
+is simpler and different in shape: ONE process per *host*, each seeing the
+host's chips, every host running the SAME binary; rendezvous happens through
+``jax.distributed.initialize`` (GRPC coordinator), not MPI.
+
+Two launchers:
+
+  * :class:`SliceLauncher` — production: fans the command out to every
+    TPU-VM worker over ``gcloud ... ssh --worker=all`` (built by
+    tpuframe.launch.provision); each worker autodetects its process id from
+    the TPU metadata (``TPUFRAME_MULTIHOST=1``).
+
+  * :class:`LocalCluster` — the CI stand-in (SURVEY.md §7 "fake cluster"):
+    spawns N *local* processes, each a separate jax runtime with K forced
+    host CPU devices, wired together with TPUFRAME_COORDINATOR/_PROCESS_ID
+    env vars consumed by tpuframe.parallel.bootstrap.  Multi-host semantics
+    (process_count > 1, cross-host collectives, per-host data sharding) are
+    exercised for real, with zero TPUs.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from dataclasses import dataclass, field
+
+from tpuframe.launch.provision import SliceConfig
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class CompletedProcess:
+    process_id: int
+    returncode: int
+    stdout: str
+    stderr: str
+
+
+@dataclass
+class LocalCluster:
+    """Spawn ``num_processes`` local SPMD processes (CPU backend).
+
+    ``devices_per_process`` forced host devices each → a virtual
+    ``num_processes × devices_per_process``-chip cluster.
+    """
+
+    num_processes: int = 2
+    devices_per_process: int = 4
+    timeout: float = 600.0
+    extra_env: dict[str, str] = field(default_factory=dict)
+
+    def launch(self, argv: list[str]) -> list[CompletedProcess]:
+        """Run ``argv`` (e.g. ``[sys.executable, "-m", "tpuframe.train", ...]``)
+        once per process; block until all exit.  Raises ``RuntimeError`` if
+        any process fails — with every rank's tail, since SPMD failures often
+        only explain themselves on one rank."""
+        port = _free_port()
+        procs = []
+        for pid in range(self.num_processes):
+            env = dict(os.environ)
+            env.update({
+                # kill any sandbox TPU plugin; force the CPU fake cluster
+                "PALLAS_AXON_POOL_IPS": "",
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": (env.get("XLA_FLAGS", "") +
+                              f" --xla_force_host_platform_device_count="
+                              f"{self.devices_per_process}"),
+                "TPUFRAME_COORDINATOR": f"127.0.0.1:{port}",
+                "TPUFRAME_NUM_PROCESSES": str(self.num_processes),
+                "TPUFRAME_PROCESS_ID": str(pid),
+            })
+            env.update(self.extra_env)
+            procs.append(subprocess.Popen(
+                argv, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+
+        results = []
+        for pid, p in enumerate(procs):
+            try:
+                out, err = p.communicate(timeout=self.timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise RuntimeError(
+                    f"local cluster rank {pid} timed out after {self.timeout}s")
+            results.append(CompletedProcess(pid, p.returncode, out, err))
+
+        failures = [r for r in results if r.returncode != 0]
+        if failures:
+            detail = "\n".join(
+                f"--- rank {r.process_id} (exit {r.returncode}) ---\n"
+                f"{r.stderr[-2000:]}" for r in failures)
+            raise RuntimeError(f"local cluster failed:\n{detail}")
+        return results
+
+
+@dataclass
+class SliceLauncher:
+    """Fan a command out to every worker of a TPU-VM slice.
+
+    ``dry_run=True`` returns the argv lists instead of executing — the
+    testable surface in environments without gcloud credentials."""
+
+    slice_cfg: SliceConfig
+    dry_run: bool = False
+
+    def launch(self, command: str, env: dict[str, str] | None = None):
+        full_env = {"TPUFRAME_MULTIHOST": "1", **(env or {})}
+        cmd = self.slice_cfg.ssh_cmd(command, worker="all", env=full_env)
+        if self.dry_run:
+            return cmd
+        return subprocess.run(cmd, check=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI::
+
+        # fake cluster (CI): 2 hosts x 4 devices running the smoke config
+        python -m tpuframe.launch local --nprocs 2 --devices 4 -- \\
+            python -m tpuframe.train --config smoke
+
+        # real slice: provision scripts + SPMD fan-out
+        python -m tpuframe.launch provision --name pod --accelerator v4-32 \\
+            --out launch_scripts/
+        python -m tpuframe.launch slice --name pod --accelerator v4-32 -- \\
+            python -m tpuframe.train --config imagenet_resnet50_pod
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(prog="tpuframe.launch", description=main.__doc__)
+    sub = p.add_subparsers(dest="mode", required=True)
+
+    lp = sub.add_parser("local", help="spawn a local multi-process fake cluster")
+    lp.add_argument("--nprocs", type=int, default=2)
+    lp.add_argument("--devices", type=int, default=4,
+                    help="forced host devices per process")
+    lp.add_argument("cmd", nargs=argparse.REMAINDER)
+
+    pp = sub.add_parser("provision", help="emit gcloud provisioning scripts")
+    pp.add_argument("--name", required=True)
+    pp.add_argument("--zone", default="us-central2-b")
+    pp.add_argument("--accelerator", default="v4-32")
+    pp.add_argument("--out", default="launch_scripts")
+
+    sp = sub.add_parser("slice", help="run a command on every slice worker")
+    sp.add_argument("--name", required=True)
+    sp.add_argument("--zone", default="us-central2-b")
+    sp.add_argument("--accelerator", default="v4-32")
+    sp.add_argument("--dry-run", action="store_true")
+    sp.add_argument("cmd", nargs=argparse.REMAINDER)
+
+    args = p.parse_args(argv)
+
+    if args.mode == "local":
+        cmd = [c for c in args.cmd if c != "--"]
+        results = LocalCluster(args.nprocs, args.devices).launch(cmd)
+        for r in results:
+            prefix = f"[rank {r.process_id}] "
+            for line in r.stdout.strip().splitlines():
+                print(prefix + line)
+        return 0
+
+    cfg = SliceConfig(name=args.name, zone=args.zone,
+                      accelerator=args.accelerator)
+    if args.mode == "provision":
+        from tpuframe.launch.provision import emit_scripts
+
+        paths = emit_scripts(cfg, args.out)
+        for name, path in paths.items():
+            print(f"wrote {path}")
+        return 0
+
+    cmd = " ".join(c for c in args.cmd if c != "--")
+    launcher = SliceLauncher(cfg, dry_run=args.dry_run)
+    out = launcher.launch(cmd)
+    if args.dry_run:
+        print(" ".join(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

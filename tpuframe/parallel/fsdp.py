@@ -69,8 +69,10 @@ def state_shardings(state: PyTree, mesh: Mesh, axis: str = "fsdp") -> PyTree:
 
 def shard_state(state: PyTree, mesh: Mesh, axis: str = "fsdp") -> PyTree:
     """Place a (host or replicated) TrainState with fsdp shardings."""
+    from tpuframe.parallel import mesh as mesh_lib
+
     shardings = state_shardings(state, mesh, axis)
-    return jax.tree.map(jax.device_put, state, shardings)
+    return jax.tree.map(mesh_lib.host_device_put, state, shardings)
 
 
 def param_fraction_sharded(state: PyTree, axis: str = "fsdp") -> float:

@@ -121,6 +121,23 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, replicated_spec())
 
 
+def host_device_put(x, sharding: NamedSharding):
+    """Multi-host-safe placement of host data.
+
+    ``jax.device_put`` rejects shardings spanning non-addressable devices;
+    on multi-host meshes each process contributes its shard via
+    ``make_array_from_callback``.  Handles PRNG-key (extended-dtype) leaves,
+    which numpy cannot represent directly."""
+    if jax.process_count() == 1 or sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    if hasattr(x, "dtype") and jax.dtypes.issubdtype(x.dtype, jax.dtypes.extended):
+        data = host_device_put(jax.random.key_data(x), sharding)
+        return jax.random.wrap_key_data(data, impl=jax.random.key_impl(x))
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
 def local_batch_size(mesh: Mesh, global_batch: int) -> int:
     """Per-host batch share (reference: DistributedSampler num_replicas/rank
     partitioning, SURVEY.md §3a 'GCS data loader')."""

@@ -226,4 +226,4 @@ def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
     ``broadcast_parameters`` at startup, SURVEY.md §4.1 — under SPMD this is a
     device_put with a replicated sharding, no network broadcast needed)."""
     repl = mesh_lib.replicated_sharding(mesh)
-    return jax.tree.map(lambda t: jax.device_put(t, repl), state)
+    return jax.tree.map(lambda t: mesh_lib.host_device_put(t, repl), state)

@@ -126,7 +126,7 @@ def build_harness(cfg: TrainConfig) -> Harness:
         from tpuframe.parallel import fsdp as fsdp_lib
 
         state_shardings = fsdp_lib.state_shardings(state, mesh)
-        state = jax.tree.map(jax.device_put, state, state_shardings)
+        state = jax.tree.map(mesh_lib.host_device_put, state, state_shardings)
     elif mesh is not None:
         state = step_lib.replicate_state(state, mesh)
 
