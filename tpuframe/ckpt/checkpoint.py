@@ -18,9 +18,9 @@ The source mesh size does not need to match — restoring an 8-chip checkpoint
 onto a 32-chip mesh reassembles from the shard table (SURVEY.md §7 hard
 part 3: "restore 8-chip ckpt on 32 chips").
 
-Integrity: CRC32 of every shard file is recorded in the manifest and verified
-on restore (tpuframe.ops.native provides a C++ CRC32 for large files; zlib is
-the fallback).
+Integrity: CRC32C (Castagnoli — the polynomial GCS object checksums use) of
+every shard file is recorded in the manifest and verified on restore; the
+checksum runs in C++ (tpuframe.native) with a pure-Python fallback.
 """
 
 from __future__ import annotations
@@ -28,7 +28,6 @@ from __future__ import annotations
 import io
 import json
 import re
-import zlib
 from typing import Any
 
 import jax
@@ -45,12 +44,9 @@ _COMMIT = "COMMIT"
 
 
 def _crc32(data: bytes) -> int:
-    try:
-        from tpuframe.ops import native
+    from tpuframe import native
 
-        return native.crc32(data)
-    except Exception:
-        return zlib.crc32(data) & 0xFFFFFFFF
+    return native.crc32c(data)
 
 
 def _flatten_with_paths(tree: PyTree):

@@ -44,6 +44,13 @@ class ArrayDataset:
         return len(next(iter(self.columns.values())))
 
     def __getitem__(self, idx) -> dict[str, np.ndarray]:
+        if isinstance(idx, np.ndarray) and idx.ndim == 1:
+            # Batch assembly: multi-threaded native gather (tpuframe.native)
+            # — the loader's per-step host work, off the GIL.
+            from tpuframe import native
+
+            return {k: native.gather_rows(v, idx)
+                    for k, v in self.columns.items()}
         return {k: v[idx] for k, v in self.columns.items()}
 
     def shard(self, num_shards: int, index: int) -> "ArrayDataset":

@@ -14,7 +14,8 @@ fallback.
 
 from __future__ import annotations
 
-import collections
+import queue
+import threading
 from typing import Iterator
 
 import jax
@@ -102,20 +103,50 @@ class ShardedLoader:
         return rng.permutation(n)
 
     def epoch(self, epoch: int, *, skip: int = 0) -> Iterator[dict]:
-        """Yield device-put batches for one epoch, ``prefetch`` steps ahead.
-        ``skip``: drop the first N batches without paying device transfer
-        (resume seeking)."""
+        """Yield device-put batches for one epoch, assembled ``prefetch``
+        steps ahead on a background thread (native gather + device_put run
+        concurrently with the consumer's compute — the torch DataLoader
+        worker role, SURVEY.md §4.1).  ``skip``: drop the first N batches
+        without paying device transfer (resume seeking)."""
         order = self._epoch_order(epoch)
-        buf: collections.deque = collections.deque()
-        starts = range(0, len(order) - self.host_batch + 1, self.host_batch)
-        for lo in list(starts)[skip:]:
-            idx = order[lo:lo + self.host_batch]
-            batch = self.dataset[idx]
-            buf.append(self._to_device(batch))
-            if len(buf) > self.prefetch:
-                yield buf.popleft()
-        while buf:
-            yield buf.popleft()
+        starts = list(range(0, len(order) - self.host_batch + 1,
+                            self.host_batch))[skip:]
+        q: queue.Queue = queue.Queue(maxsize=max(self.prefetch, 1))
+        stop = threading.Event()
+        sentinel = object()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for lo in starts:
+                    idx = order[lo:lo + self.host_batch]
+                    if not put(self._to_device(self.dataset[idx])):
+                        return  # consumer gone
+                put(sentinel)
+            except BaseException as e:  # noqa: BLE001 — surface to consumer
+                put(e)
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="tpuframe-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
 
     def from_step(self, step: int) -> Iterator[dict]:
         """Infinite stream positioned as if ``step`` batches were already

@@ -1,0 +1,40 @@
+"""Lazy g++ build of the native library, cached by source hash.
+
+No pybind11 in this environment (see repo docs) — the ABI is plain C,
+consumed via ctypes.  Rebuilds only when ``src/tpuframe_native.cc`` changes;
+concurrent builders (multi-process test runs) race benignly on a temp file +
+atomic rename.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "tpuframe_native.cc")
+_OUT_DIR = os.path.join(os.path.dirname(__file__), "_build")
+
+
+def build(force: bool = False) -> str:
+    """Compile (if needed) and return the shared-library path."""
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_OUT_DIR, f"libtpuframe_native_{digest}.so")
+    if os.path.exists(out) and not force:
+        return out
+    os.makedirs(_OUT_DIR, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_OUT_DIR)
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+             _SRC, "-o", tmp],
+            check=True, capture_output=True, text=True)
+        os.replace(tmp, out)  # atomic: concurrent builders converge
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return out

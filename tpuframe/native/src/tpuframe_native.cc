@@ -1,0 +1,109 @@
+// tpuframe native runtime — host-side C++ for the paths the reference keeps
+// native (SURVEY.md §3b: Horovod's runtime is C++; on TPU the *device* side
+// belongs to XLA, the host side — batch assembly and checkpoint integrity —
+// is implemented here).
+//
+//   * tf_gather_rows: multi-threaded gather of dataset rows into a batch
+//     buffer. This is the per-step host work of the input pipeline (numpy
+//     fancy indexing is single-threaded and GIL-bound; this runs on a small
+//     thread pool with the GIL released by the ctypes call).
+//   * tf_crc32c: Castagnoli CRC (slicing-by-8) for checkpoint integrity
+//     (the same polynomial GCS uses for object checksums).
+//
+// Built by tpuframe/native/build.py: g++ -O3 -shared -fPIC, no external
+// dependencies.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Gather n_idx rows of row_bytes each: dst[i] = src[indices[i]].
+// Rows are raw bytes — dtype-agnostic; caller guarantees bounds.
+void tf_gather_rows(const char* src, const int64_t* indices, int64_t n_idx,
+                    int64_t row_bytes, char* dst, int32_t n_threads) {
+  if (n_idx <= 0) return;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n_idx) n_threads = static_cast<int32_t>(n_idx);
+  // Small batches: threading overhead dominates, copy inline.
+  if (n_threads == 1 || n_idx * row_bytes < (1 << 20)) {
+    for (int64_t i = 0; i < n_idx; ++i) {
+      std::memcpy(dst + i * row_bytes, src + indices[i] * row_bytes,
+                  row_bytes);
+    }
+    return;
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(n_threads);
+  const int64_t chunk = (n_idx + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = std::min(lo + chunk, n_idx);
+    if (lo >= hi) break;
+    workers.emplace_back([=] {
+      for (int64_t i = lo; i < hi; ++i) {
+        std::memcpy(dst + i * row_bytes, src + indices[i] * row_bytes,
+                    row_bytes);
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+}
+
+// ---------------------------------------------------------------------------
+// crc32c (Castagnoli, poly 0x1EDC6F41 reflected = 0x82F63B78), slicing-by-8.
+// ---------------------------------------------------------------------------
+
+namespace {
+uint32_t kTable[8][256];
+std::atomic<bool> kTableInit{false};
+
+void init_table() {
+  bool expected = false;
+  static std::atomic<bool> building{false};
+  if (kTableInit.load(std::memory_order_acquire)) return;
+  if (building.exchange(true)) {
+    while (!kTableInit.load(std::memory_order_acquire)) {}
+    return;
+  }
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; ++j)
+      crc = (crc >> 1) ^ (0x82F63B78u & (~(crc & 1) + 1));
+    kTable[0][i] = crc;
+  }
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t crc = kTable[0][i];
+    for (int k = 1; k < 8; ++k) {
+      crc = kTable[0][crc & 0xFF] ^ (crc >> 8);
+      kTable[k][i] = crc;
+    }
+  }
+  kTableInit.store(true, std::memory_order_release);
+  (void)expected;
+}
+}  // namespace
+
+uint32_t tf_crc32c(const uint8_t* data, int64_t n, uint32_t seed) {
+  init_table();
+  uint32_t crc = ~seed;
+  while (n >= 8) {
+    uint64_t word;
+    std::memcpy(&word, data, 8);
+    crc ^= static_cast<uint32_t>(word);
+    uint32_t hi = static_cast<uint32_t>(word >> 32);
+    crc = kTable[7][crc & 0xFF] ^ kTable[6][(crc >> 8) & 0xFF] ^
+          kTable[5][(crc >> 16) & 0xFF] ^ kTable[4][crc >> 24] ^
+          kTable[3][hi & 0xFF] ^ kTable[2][(hi >> 8) & 0xFF] ^
+          kTable[1][(hi >> 16) & 0xFF] ^ kTable[0][hi >> 24];
+    data += 8;
+    n -= 8;
+  }
+  while (n-- > 0) crc = kTable[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+}  // extern "C"
