@@ -1,0 +1,84 @@
+"""Observability: step timeline (HOROVOD_TIMELINE parity) + fusion-threshold
+knob (HOROVOD_FUSION_THRESHOLD parity) — SURVEY.md §5.1, §3b."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpuframe.obs.timeline import StepTimeline
+from tpuframe.parallel import tuning
+
+
+def test_step_timeline_events(tmp_path):
+    path = str(tmp_path / "trace.json")
+    tl = StepTimeline(path)
+    with tl.phase("train_step", step=1):
+        pass
+    tl.instant("fault", reason="test")
+    tl.close()
+    trace = json.load(open(path))
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert names == ["train_step", "fault"]
+    ev = trace["traceEvents"][0]
+    assert ev["ph"] == "X" and ev["dur"] >= 0 and ev["args"] == {"step": 1}
+
+
+def test_from_env_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("TPUFRAME_TIMELINE", raising=False)
+    assert StepTimeline.from_env() is None
+
+
+def test_fusion_flags_shape():
+    flags = tuning.fusion_flags(64 * 1024 * 1024)
+    assert any("all_reduce_combine_threshold_bytes=67108864" in f
+               for f in flags)
+
+
+def test_apply_after_backend_init_refuses():
+    # jax backend is live in the test process — apply must refuse, not lie.
+    assert tuning.apply(1 << 20) is False
+
+
+@pytest.mark.slow
+def test_fusion_env_applies_in_fresh_process():
+    code = (
+        "import os; os.environ['TPUFRAME_FUSION_THRESHOLD'] = str(1 << 25)\n"
+        "from tpuframe.parallel import bootstrap, tuning\n"
+        "bootstrap.initialize()\n"
+        "assert tuning.current() == 1 << 25, tuning.current()\n"
+        "assert 'combine_threshold_bytes=33554432' in os.environ['XLA_FLAGS']\n"
+        "import jax; jax.numpy.zeros(2).block_until_ready()\n"
+        "print('FUSION_OK')\n"
+    )
+    env = dict(os.environ)
+    env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"})
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert "FUSION_OK" in out.stdout, out.stderr[-800:]
+
+
+@pytest.mark.slow
+def test_timeline_through_harness(tmp_path):
+    path = str(tmp_path / "tl.json")
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": env.get("XLA_FLAGS", "") +
+        " --xla_force_host_platform_device_count=4",
+        "TPUFRAME_TIMELINE": path,
+    })
+    out = subprocess.run(
+        [sys.executable, "-m", "tpuframe.train", "--config", "smoke",
+         "--set", "total_steps=6", "--set", "log_every=3",
+         "--set", "eval_every=6", "--set", "eval_batches=1",
+         "--set", "global_batch=16"],
+        env=env, capture_output=True, text=True, timeout=500)
+    assert out.returncode == 0, out.stderr[-1500:]
+    trace = json.load(open(path))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"data_wait", "train_step", "eval"} <= names
+    steps = [e for e in trace["traceEvents"] if e["name"] == "train_step"]
+    assert len(steps) == 6

@@ -4,4 +4,5 @@ HOROVOD_STALL_CHECK."""
 
 from tpuframe.obs.metrics import MetricLogger, RateMeter  # noqa: F401
 from tpuframe.obs.heartbeat import Heartbeat  # noqa: F401
-from tpuframe.obs.timeline import profile_trace, start_profiler_server  # noqa: F401
+from tpuframe.obs.timeline import (StepTimeline, profile_trace,  # noqa: F401
+                                   start_profiler_server)

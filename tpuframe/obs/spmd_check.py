@@ -1,0 +1,54 @@
+"""SPMD-divergence debug checks (SURVEY.md §5.2).
+
+Horovod needs a runtime coordinator to keep collective order identical on
+every rank; compiled SPMD cannot reorder collectives, so the only remaining
+divergence risk is *building different programs* on different hosts — a
+config drift, a host-dependent code path, a non-deterministic data seed.
+This module catches exactly that class in debug mode
+(``TPUFRAME_CHECK_SPMD=1``): every host hashes its step program (lowered
+StableHLO) and config, and the hashes are cross-checked with one small
+allgather before training starts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import numpy as np
+
+
+def digest(payload: bytes | str) -> np.ndarray:
+    if isinstance(payload, str):
+        payload = payload.encode()
+    return np.frombuffer(hashlib.sha256(payload).digest(), np.uint8).copy()
+
+
+def assert_uniform_across_hosts(tag: str, payload: bytes | str) -> None:
+    """Raise RuntimeError if any host's payload hash differs (no-op
+    single-process)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    mine = digest(payload)
+    everyone = np.asarray(multihost_utils.process_allgather(mine))
+    bad = [i for i in range(everyone.shape[0])
+           if not np.array_equal(everyone[i], mine)]
+    if bad:
+        raise RuntimeError(
+            f"SPMD divergence in {tag!r}: host {jax.process_index()} disagrees "
+            f"with host(s) {bad} — hosts are about to run different programs. "
+            f"Check for config drift / host-dependent branches / unseeded "
+            f"randomness.")
+
+
+def check_step_program(compiled_or_jitted, tag: str, *example_args) -> None:
+    """Hash the step function's lowered StableHLO across hosts.
+
+    ``lower()`` traces but does not backend-compile, so this is cheap enough
+    for a startup debug check; the trace also warms nothing (jit caches by
+    avals, and the same args are about to be used for real).
+    """
+    lowered = compiled_or_jitted.lower(*example_args)
+    assert_uniform_across_hosts(f"{tag}:stablehlo", lowered.as_text())

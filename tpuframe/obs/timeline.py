@@ -10,6 +10,8 @@ trace of steps [start, start+count) in the harness.
 from __future__ import annotations
 
 import contextlib
+import os
+import time
 
 import jax
 
@@ -37,3 +39,56 @@ def profile_trace(log_dir: str):
 def annotate(name: str):
     """Named region inside a traced window (maps to a trace event)."""
     return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimeline:
+    """Host-side chrome-trace timeline — the direct HOROVOD_TIMELINE analog.
+
+    Horovod's timeline shows per-tensor collective phases; under one-program
+    SPMD the interesting host phases are coarser: data wait (input pipeline),
+    step submit/execute, eval, checkpoint.  Events accumulate in memory and
+    flush as a Chrome ``chrome://tracing`` / Perfetto JSON array on close.
+
+    Enable via ``TPUFRAME_TIMELINE=/path/trace.json`` (env parity with
+    ``HOROVOD_TIMELINE=file.json``) — the harness wires the phases.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._events: list[dict] = []
+        self._t0 = time.perf_counter()
+
+    @classmethod
+    def from_env(cls) -> "StepTimeline | None":
+        path = os.environ.get("TPUFRAME_TIMELINE")
+        return cls(path) if path else None
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    @contextlib.contextmanager
+    def phase(self, name: str, **args):
+        start = self._now_us()
+        try:
+            yield
+        finally:
+            self._events.append({
+                "name": name, "ph": "X", "ts": start,
+                "dur": self._now_us() - start,
+                "pid": jax.process_index(), "tid": 0,
+                **({"args": args} if args else {}),
+            })
+
+    def instant(self, name: str, **args) -> None:
+        self._events.append({
+            "name": name, "ph": "i", "ts": self._now_us(), "s": "p",
+            "pid": jax.process_index(), "tid": 0,
+            **({"args": args} if args else {}),
+        })
+
+    def close(self) -> None:
+        import json
+
+        with open(self.path, "w") as f:
+            json.dump({"traceEvents": self._events,
+                       "displayTimeUnit": "ms"}, f)

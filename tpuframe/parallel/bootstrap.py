@@ -57,6 +57,10 @@ def initialize(config: DistConfig | None = None) -> None:
     """
     if _STATE["initialized"]:
         return
+    from tpuframe.parallel import tuning
+
+    tuning.apply_from_env()  # HOROVOD_FUSION_THRESHOLD parity (must precede
+    # first backend touch; no-op unless TPUFRAME_FUSION_THRESHOLD is set)
     cfg = config or DistConfig.from_env()
     explicit = cfg.coordinator_address is not None
     # On Cloud TPU VMs jax.distributed.initialize() can autodetect everything

@@ -1,0 +1,72 @@
+"""Collective-fusion tuning — HOROVOD_FUSION_THRESHOLD parity (SURVEY.md §3b).
+
+Horovod packs small gradient tensors into a fusion buffer (default 64 MB)
+before each NCCL allreduce; the knob matters most for many-small-tensor
+models (BERT-base, ~200 tensors — config 4's stress axis [B:10]).  Under XLA
+the same role is played by the all-reduce combiner pass, which merges small
+AllReduce HLOs up to a byte threshold.  This module maps the Horovod-style
+env knob onto the XLA flags:
+
+    TPUFRAME_FUSION_THRESHOLD=67108864   # bytes, like HOROVOD_FUSION_THRESHOLD
+
+XLA flags only take effect before backend initialization, so the harness
+calls :func:`apply_from_env` at import/startup (tpuframe.parallel.bootstrap);
+afterwards the combiner threshold is compiled into every program.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+ENV_KNOB = "TPUFRAME_FUSION_THRESHOLD"
+
+# The combiner passes read DebugOptions.xla_gpu_all_reduce_combine_threshold
+# _bytes ("gpu" is historical naming — it is the generic DebugOptions field,
+# and XLA's flag parser aborts on unknown flags, so only real fields can be
+# set).  On TPU slices, additional libtpu-private combiner knobs travel via
+# LIBTPU_INIT_ARGS, which the launcher propagates (SURVEY.md §5.6).
+_FLAG_TEMPLATES = (
+    "--xla_gpu_all_reduce_combine_threshold_bytes={n}",
+)
+
+_APPLIED: dict = {"threshold": None}
+
+
+def fusion_flags(threshold_bytes: int) -> list[str]:
+    return [t.format(n=int(threshold_bytes)) for t in _FLAG_TEMPLATES]
+
+
+def apply(threshold_bytes: int) -> bool:
+    """Prepend the combiner flags to XLA_FLAGS. Returns False (with a
+    warning) if the backend already initialized — too late to take effect."""
+    import jax
+
+    live = jax._src.xla_bridge._backends  # noqa: SLF001 — init probe only
+    if live:
+        logger.warning(
+            "%s=%d requested after backend init — combiner flags ignored; "
+            "set the env before importing jax workloads", ENV_KNOB,
+            threshold_bytes)
+        return False
+    existing = os.environ.get("XLA_FLAGS", "")
+    flags = [f for f in fusion_flags(threshold_bytes) if f not in existing]
+    os.environ["XLA_FLAGS"] = (existing + " " + " ".join(flags)).strip()
+    _APPLIED["threshold"] = int(threshold_bytes)
+    return True
+
+
+def apply_from_env() -> int | None:
+    """Honor TPUFRAME_FUSION_THRESHOLD if set; returns the applied value."""
+    raw = os.environ.get(ENV_KNOB)
+    if not raw:
+        return None
+    threshold = int(raw)
+    apply(threshold)
+    return threshold
+
+
+def current() -> int | None:
+    return _APPLIED["threshold"]

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import ast
+import os
 import time
 from dataclasses import dataclass
 from typing import Any, Iterator
@@ -31,7 +32,8 @@ from tpuframe import ckpt as ckpt_lib
 from tpuframe import models
 from tpuframe.data import ShardedLoader, datasets
 from tpuframe.models import losses
-from tpuframe.obs import Heartbeat, MetricLogger, RateMeter, profile_trace
+from tpuframe.obs import (Heartbeat, MetricLogger, RateMeter, StepTimeline,
+                          profile_trace)
 from tpuframe.parallel import bootstrap
 from tpuframe.parallel import mesh as mesh_lib
 from tpuframe.parallel import step as step_lib
@@ -251,6 +253,7 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
     logger = MetricLogger(log_file)
     rate = RateMeter()
     heartbeat = Heartbeat(timeout_s=300.0).start()
+    timeline = StepTimeline.from_env()  # HOROVOD_TIMELINE parity (§5.1)
     examples_per_step = cfg.global_batch
 
     if bootstrap.is_primary():
@@ -260,6 +263,18 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
               f"params={n_params/1e6:.2f}M devices={jax.device_count()} "
               f"global_batch={cfg.global_batch} steps={cfg.total_steps}",
               flush=True)
+
+    if os.environ.get("TPUFRAME_CHECK_SPMD") == "1":
+        # Debug mode (SURVEY.md §5.2): every host verifies it built the same
+        # config + step program before any collective runs.
+        from tpuframe.obs import spmd_check
+
+        spmd_check.assert_uniform_across_hosts("config", repr(cfg))
+
+    # Test-only fault injection (SURVEY.md §5.3): simulate a host crash at an
+    # exact step — os._exit skips all cleanup, so resume must cope with torn
+    # trailing state (uncommitted checkpoints, open logs).
+    fault_step = int(os.environ.get("TPUFRAME_FAULT_STEP", "0") or "0")
 
     state = h.state
     step = h.start_step
@@ -274,9 +289,19 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             t_trace.__exit__(None, None, None)
             t_trace = None
 
-        batch = next(data_iter)
-        state, metrics = h.train_step(state, batch)
+        if timeline is not None:
+            with timeline.phase("data_wait", step=step):
+                batch = next(data_iter)
+            with timeline.phase("train_step", step=step):
+                state, metrics = h.train_step(state, batch)
+        else:
+            batch = next(data_iter)
+            state, metrics = h.train_step(state, batch)
         step += 1
+        if fault_step and step == fault_step:
+            print(f"[tpuframe] FAULT INJECTION: dying at step {step}",
+                  flush=True)
+            os._exit(42)
         rate.update(examples_per_step)
         heartbeat.beat(step)
 
@@ -292,20 +317,33 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
         if step % cfg.eval_every == 0 or step == cfg.total_steps:
             h.state = state
             with rate.paused():  # eval time isn't training throughput
-                eval_metrics = evaluate(h, cfg.eval_batches)
+                if timeline is not None:
+                    with timeline.phase("eval", step=step):
+                        eval_metrics = evaluate(h, cfg.eval_batches)
+                else:
+                    eval_metrics = evaluate(h, cfg.eval_batches)
             logger.log(step, eval_metrics, prefix="eval")
             final_train_metrics.update(
                 {f"eval_{k}": v for k, v in eval_metrics.items()})
 
         if h.manager is not None:
             with rate.paused():
-                h.manager.maybe_save(step, state)
+                if timeline is not None and h.manager.should_save(step):
+                    with timeline.phase("checkpoint", step=step):
+                        h.manager.maybe_save(step, state)
+                else:
+                    h.manager.maybe_save(step, state)
 
     if t_trace is not None:
         t_trace.__exit__(None, None, None)
     if h.manager is not None and step % cfg.ckpt_every != 0:
         h.manager.save(step, state)  # final state always durable
     heartbeat.stop()
+    if timeline is not None:
+        timeline.close()
+        if bootstrap.is_primary():
+            print(f"[tpuframe] step timeline written to "
+                  f"{os.environ['TPUFRAME_TIMELINE']}", flush=True)
     logger.close()
     final_train_metrics["step"] = step
     return final_train_metrics
