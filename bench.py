@@ -29,10 +29,13 @@ import optax
 
 V100_HOROVOD_ANCHOR = 360.0  # images/sec/chip, see module docstring
 
-BATCH_PER_CHIP = 128
+# Batch 512/chip measured fastest on the v5e bench chip (sweep 2026-07-29:
+# 128->1083, 256->1454, 512->1824, 1024->1797 images/sec/chip); large batches
+# keep the MXU fed through the small-spatial late stages.
+BATCH_PER_CHIP = 512
 IMAGE_SIZE = 224
 WARMUP_STEPS = 3
-MEASURE_STEPS = 10
+MEASURE_STEPS = 8
 
 
 def main() -> None:
