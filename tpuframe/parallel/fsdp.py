@@ -55,16 +55,56 @@ def choose_spec(shape: tuple[int, ...], fsdp_size: int,
     return P()
 
 
-def state_shardings(state: PyTree, mesh: Mesh, axis: str = "fsdp") -> PyTree:
-    """NamedSharding tree for a TrainState (or any pytree of arrays)."""
+def state_shardings(state: PyTree, mesh: Mesh, axis: str = "fsdp",
+                    *, tp_rules=None, tp_axis: str = "model") -> PyTree:
+    """NamedSharding tree for a TrainState (or any pytree of arrays).
+
+    With ``tp_rules`` (tpuframe.parallel.tp) the tensor-parallel spec is
+    applied first by parameter path; the ``fsdp`` axis then shards the
+    largest *still-unsharded* divisible dim of each leaf — composing
+    ZeRO × TP from placement alone.
+    """
     size = mesh.shape[axis]
+    tp_size = mesh.shape.get(tp_axis, 1) if tp_rules else 1
     amesh = auto_mesh(mesh)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
 
-    def leaf(x) -> NamedSharding:
+    def path_str(path) -> str:
+        parts = []
+        for k in path:
+            for attr in ("key", "name", "idx"):
+                if hasattr(k, attr):
+                    parts.append(str(getattr(k, attr)))
+                    break
+            else:
+                parts.append(str(k))
+        return "/".join(parts)
+
+    out = []
+    for path, x in flat:
         shape = tuple(getattr(x, "shape", ()))
-        return NamedSharding(amesh, choose_spec(shape, size, axis))
+        base = None
+        if tp_size > 1:
+            from tpuframe.parallel import tp as tp_lib
 
-    return jax.tree.map(leaf, state)
+            base = tp_lib.match_spec(path_str(path), shape, tp_size, tp_rules)
+        spec = _add_fsdp(shape, base, size, axis)
+        out.append(NamedSharding(amesh, spec))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _add_fsdp(shape: tuple[int, ...], base: P | None, fsdp_size: int,
+              axis: str) -> P:
+    """Overlay the fsdp axis on the largest unsharded divisible dim."""
+    entries = list(base) + [None] * (len(shape) - len(base)) if base else         [None] * len(shape)
+    if fsdp_size <= 1 or int(np.prod(shape or (1,))) < MIN_SHARD_ELEMENTS:
+        return P(*entries) if base else P()
+    dims = sorted(range(len(shape)), key=lambda i: shape[i], reverse=True)
+    for i in dims:
+        if entries[i] is None and shape[i] % fsdp_size == 0:
+            entries[i] = axis
+            return P(*entries)
+    return P(*entries) if base else P()
 
 
 def shard_state(state: PyTree, mesh: Mesh, axis: str = "fsdp") -> PyTree:

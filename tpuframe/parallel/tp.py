@@ -1,0 +1,78 @@
+"""Tensor parallelism over the ``model`` mesh axis — Megatron-style, the
+XLA way.
+
+Absent from the reference (SURVEY.md §3c: DP only); implemented here because
+the mesh reserves the axis and large models need it.  There is no runtime
+machinery and no model-code fork: TP is a set of *parameter placement rules*
+(path-pattern → PartitionSpec) consumed by the auto-SPMD step — GSPMD then
+inserts the activation all-reduces that Megatron wires by hand:
+
+  * attention q/k/v projections: heads dim over ``model`` (column-parallel)
+  * attention output projection: heads dim over ``model`` (row-parallel —
+    its products are partial sums; GSPMD emits the all-reduce)
+  * MLP up: intermediate dim over ``model``; MLP down: the same dim
+    (row-parallel)
+  * embedding + LM head: hidden/vocab dim over ``model``
+
+Rules compose with FSDP: tpuframe.parallel.fsdp adds the ``fsdp`` axis on
+the largest still-unsharded divisible dim of every leaf, so a
+``data × fsdp × model`` mesh gives ZeRO-sharded, tensor-parallel training
+from placement alone.
+"""
+
+from __future__ import annotations
+
+import re
+
+from jax.sharding import PartitionSpec as P
+
+# (path regex, spec). First match wins; paths are "/"-joined flax param
+# paths, e.g. "block_3/attn/query/kernel" — optimizer-state leaves carry the
+# same tail (".../mu/block_3/attn/query/kernel"), so the rules cover them.
+TRANSFORMER_LM_RULES: tuple[tuple[str, P], ...] = (
+    (r"attn/(query|key|value)/kernel$", P(None, "model", None)),
+    (r"attn/out/kernel$", P("model", None, None)),
+    (r"up/kernel$", P(None, "model")),
+    (r"down/kernel$", P("model", None)),
+    (r"lm_head/kernel$", P(None, "model")),
+    (r"embed/embedding$", P(None, "model")),
+)
+
+BERT_RULES: tuple[tuple[str, P], ...] = (
+    (r"attention/(query|key|value)/kernel$", P(None, "model", None)),
+    (r"attention/(query|key|value)/bias$", P("model", None)),
+    (r"attention/out/kernel$", P("model", None, None)),
+    (r"intermediate/kernel$", P(None, "model")),
+    (r"intermediate/bias$", P("model")),
+    (r"output/kernel$", P("model", None)),
+    (r"embeddings/word/embedding$", P(None, "model")),
+)
+
+RULES_BY_MODEL: dict[str, tuple[tuple[str, P], ...]] = {
+    "transformer-lm": TRANSFORMER_LM_RULES,
+    "bert-base": BERT_RULES,
+}
+
+
+def rules_for_model(name: str) -> tuple[tuple[str, P], ...]:
+    if name not in RULES_BY_MODEL:
+        raise ValueError(
+            f"no tensor-parallel rules for model {name!r}; "
+            f"have {sorted(RULES_BY_MODEL)} — add rules to tpuframe.parallel.tp")
+    return RULES_BY_MODEL[name]
+
+
+def match_spec(path: str, shape: tuple[int, ...], tp_size: int,
+               rules: tuple[tuple[str, P], ...]) -> P | None:
+    """The TP spec for a param path, or None when no rule applies/divides."""
+    if tp_size <= 1:
+        return None
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            if len(spec) > len(shape):
+                return None
+            for dim, entry in zip(shape, spec):
+                if entry is not None and dim % tp_size != 0:
+                    return None  # indivisible → replicate rather than crash
+            return spec
+    return None

@@ -90,11 +90,13 @@ class Harness:
 def build_harness(cfg: TrainConfig) -> Harness:
     bootstrap.initialize()
     mesh = mesh_lib.make_mesh(cfg.mesh) if cfg.distributed else None
-    use_fsdp = mesh is not None and mesh.shape["fsdp"] > 1
+    # Sharded-state (auto-SPMD) mode: ZeRO/FSDP over the fsdp axis and/or
+    # Megatron-style TP over the model axis — both are placement decisions
+    # living on the Auto-typed mesh twin (tpuframe.parallel.fsdp.auto_mesh).
+    use_sharded_state = mesh is not None and (
+        mesh.shape["fsdp"] > 1 or mesh.shape["model"] > 1)
     data_mesh = mesh
-    if use_fsdp:
-        # FSDP inputs/state must share one mesh; the fsdp path lives on the
-        # Auto-typed twin (tpuframe.parallel.fsdp.auto_mesh).
+    if use_sharded_state:
         from tpuframe.parallel import fsdp as fsdp_lib
 
         data_mesh = fsdp_lib.auto_mesh(mesh)
@@ -122,12 +124,16 @@ def build_harness(cfg: TrainConfig) -> Harness:
     state = step_lib.TrainState.create(params, tx, model_state=model_state,
                                        rng=jax.random.key(cfg.seed + 1))
     state_shardings = None
-    if use_fsdp:
-        # ZeRO/FSDP: params + optimizer state sharded over the fsdp axis
-        # (tpuframe.parallel.fsdp); the step switches to auto-SPMD mode.
+    if use_sharded_state:
         from tpuframe.parallel import fsdp as fsdp_lib
 
-        state_shardings = fsdp_lib.state_shardings(state, mesh)
+        tp_rules = None
+        if mesh.shape["model"] > 1:
+            from tpuframe.parallel import tp as tp_lib
+
+            tp_rules = tp_lib.rules_for_model(cfg.model)
+        state_shardings = fsdp_lib.state_shardings(state, mesh,
+                                                   tp_rules=tp_rules)
         state = jax.tree.map(mesh_lib.host_device_put, state, state_shardings)
     elif mesh is not None:
         state = step_lib.replicate_state(state, mesh)
