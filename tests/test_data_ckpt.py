@@ -236,3 +236,48 @@ def chex_all_equal_structs(a, b):
     ja = jax.tree_util.tree_structure(a)
     jb = jax.tree_util.tree_structure(b)
     assert ja == jb, (ja, jb)
+
+
+class TestPrepareImagenet:
+    def _make_tree(self, root, n_classes=2, per_class=3):
+        from PIL import Image
+
+        rng = np.random.default_rng(0)
+        for c in range(n_classes):
+            d = root / f"n{c:08d}"
+            d.mkdir(parents=True)
+            for i in range(per_class):
+                arr = rng.integers(0, 255, size=(40 + 8 * c, 64, 3),
+                                   dtype=np.uint8)
+                Image.fromarray(arr).save(d / f"img_{i}.JPEG")
+
+    def test_prepare_and_load_roundtrip(self, tmp_path):
+        from tpuframe.data import prepare_imagenet
+        from tpuframe.data.datasets import imagenet
+
+        src, out = tmp_path / "raw", tmp_path / "out"
+        self._make_tree(src)
+        n = prepare_imagenet.prepare(str(src), str(out), image_size=32,
+                                     shard_size=4, workers=1)
+        assert n == 2  # 6 examples, shard_size 4 -> 2 shards
+        names = sorted(p.name for p in out.iterdir())
+        assert "images_00000.npy" in names and "labels_00001.npy" in names
+        assert "classes.txt" in names
+
+        train, test = imagenet(str(out), image_size=32)
+        total = len(train) + len(test)
+        assert total == 6
+        img = train[:1]["image"]
+        assert img.dtype == np.float32 and img.shape[1:] == (32, 32, 3)
+        # normalized: values centered near 0, not 0..255
+        assert abs(float(img.mean())) < 3.0
+
+    def test_decode_geometry(self, tmp_path):
+        from PIL import Image
+
+        from tpuframe.data import prepare_imagenet
+
+        p = tmp_path / "x.jpg"
+        Image.fromarray(np.zeros((100, 300, 3), np.uint8)).save(p)
+        arr = prepare_imagenet.decode_one((str(p), 64, 0))
+        assert arr.shape == (64, 64, 3) and arr.dtype == np.uint8

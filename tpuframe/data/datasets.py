@@ -102,6 +102,9 @@ def mnist(data_dir: str | None = None, *, synthetic_size: int = 2048):
 CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
 CIFAR_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
 
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
 
 def cifar10(data_dir: str | None = None, *, synthetic_size: int = 2048):
     """[B, 32, 32, 3] float32 normalized, int32 labels.  Reads the python
@@ -161,6 +164,9 @@ def imagenet(data_dir: str | None = None, *, image_size: int = 224,
               for n in names]
         x = np.concatenate(xs)
         y = np.concatenate(ys).astype(np.int32)
+        if x.dtype == np.uint8:
+            # prepare_imagenet stores uint8 (4x less IO); normalize here.
+            x = ((x.astype(np.float32) / 255.0) - IMAGENET_MEAN) / IMAGENET_STD
         split = int(0.99 * len(x))
         train = ArrayDataset({"image": x[:split], "label": y[:split]})
         test = ArrayDataset({"image": x[split:], "label": y[split:]})

@@ -1,0 +1,144 @@
+"""Offline ImageNet preparation: class-folder JPEGs → per-host npy shards.
+
+SURVEY.md §7 hard part 2: decoding JPEGs on the training hosts would
+bottleneck the input pipeline at pod scale, so decode/resize happens offline
+(once), and training hosts stream dense arrays.  Output layout consumed by
+``tpuframe.data.datasets.imagenet``:
+
+    <out>/images_00000.npy   # uint8 [N, S, S, 3]
+    <out>/labels_00000.npy   # int32 [N]
+    ...
+
+Shard count should be a multiple of the training host count (the loader
+assigns whole files to hosts).  ``--out gs://bucket/path`` writes straight
+to GCS via tpuframe.data.gcs.
+
+CLI:
+    python -m tpuframe.data.prepare_imagenet \\
+        --src /data/imagenet/train --out gs://bucket/imagenet/train \\
+        --image-size 224 --shard-size 8192 --workers 16
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+from concurrent.futures import ProcessPoolExecutor
+
+import numpy as np
+
+from tpuframe.data import gcs
+
+
+def _require_pil():
+    try:
+        from PIL import Image  # noqa: F401
+
+        return Image
+    except ImportError as e:  # pragma: no cover - PIL present in this image
+        raise RuntimeError(
+            "prepare_imagenet needs Pillow for JPEG decode; install it or "
+            "pre-decode to npy shards with your own tooling") from e
+
+
+def list_examples(src: str) -> tuple[list[tuple[str, int]], list[str]]:
+    """[(path, label)] over a class-folder tree; labels follow sorted wnids
+    (the torchvision ImageFolder convention the reference relies on)."""
+    classes = sorted(
+        d for d in os.listdir(src) if os.path.isdir(os.path.join(src, d)))
+    if not classes:
+        raise ValueError(f"no class folders under {src}")
+    examples = []
+    for label, wnid in enumerate(classes):
+        folder = os.path.join(src, wnid)
+        for name in sorted(os.listdir(folder)):
+            if name.lower().endswith((".jpeg", ".jpg", ".png")):
+                examples.append((os.path.join(folder, name), label))
+    return examples, classes
+
+
+def decode_one(args: tuple[str, int, int]) -> np.ndarray:
+    """Resize shorter side to 1.14*size, center-crop size×size, uint8 RGB
+    (the standard ResNet eval geometry; training-time augmentation is the
+    loader's job, not storage's)."""
+    path, size, _label = args
+    Image = _require_pil()
+    with Image.open(path) as im:
+        im = im.convert("RGB")
+        w, h = im.size
+        scale = (int(size * 1.14) + 1) / min(w, h)
+        im = im.resize((max(size, round(w * scale)),
+                        max(size, round(h * scale))), Image.BILINEAR)
+        w, h = im.size
+        lo_x, lo_y = (w - size) // 2, (h - size) // 2
+        im = im.crop((lo_x, lo_y, lo_x + size, lo_y + size))
+        return np.asarray(im, np.uint8)
+
+
+def prepare(src: str, out: str, *, image_size: int = 224,
+            shard_size: int = 8192, workers: int = 8,
+            limit: int | None = None) -> int:
+    """Returns the number of shards written."""
+    examples, classes = list_examples(src)
+    if limit:
+        examples = examples[:limit]
+    gcs.makedirs(out)
+    gcs.write_bytes(gcs.join(out, "classes.txt"),
+                    "\n".join(classes).encode())
+
+    n_shards = 0
+    buf_img: list[np.ndarray] = []
+    buf_lbl: list[int] = []
+
+    def flush():
+        nonlocal n_shards
+        if not buf_img:
+            return
+        img = np.stack(buf_img)
+        lbl = np.asarray(buf_lbl, np.int32)
+        for prefix, arr in (("images", img), ("labels", lbl)):
+            b = io.BytesIO()
+            np.save(b, arr)
+            gcs.write_bytes(gcs.join(out, f"{prefix}_{n_shards:05d}.npy"),
+                            b.getvalue())
+        n_shards += 1
+        buf_img.clear()
+        buf_lbl.clear()
+
+    tasks = [(path, image_size, label) for path, label in examples]
+    if workers > 1:
+        with ProcessPoolExecutor(max_workers=workers) as pool:
+            for (path, _s, label), arr in zip(
+                    tasks, pool.map(decode_one, tasks, chunksize=64)):
+                buf_img.append(arr)
+                buf_lbl.append(label)
+                if len(buf_img) >= shard_size:
+                    flush()
+    else:
+        for t in tasks:
+            buf_img.append(decode_one(t))
+            buf_lbl.append(t[2])
+            if len(buf_img) >= shard_size:
+                flush()
+    flush()
+    return n_shards
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--src", required=True, help="class-folder JPEG tree")
+    p.add_argument("--out", required=True, help="output dir (may be gs://)")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--shard-size", type=int, default=8192)
+    p.add_argument("--workers", type=int, default=8)
+    p.add_argument("--limit", type=int, default=None)
+    a = p.parse_args(argv)
+    n = prepare(a.src, a.out, image_size=a.image_size,
+                shard_size=a.shard_size, workers=a.workers, limit=a.limit)
+    print(f"wrote {n} shards to {a.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

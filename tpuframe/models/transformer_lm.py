@@ -39,6 +39,13 @@ class LMConfig:
     seq_axis: str = "seq"
     seq_mode: str = "none"          # none | ring | ulysses
     remat: bool = False             # jax.checkpoint each block (long-context)
+    # Mixture of experts (expert parallelism over the ``expert`` mesh axis;
+    # weights placed by tpuframe.parallel.tp rules). 0 experts = dense.
+    moe_experts: int = 0
+    moe_every: int = 2              # every Nth block swaps MLP for MoE
+    moe_k: int = 2                  # experts per token
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01    # load-balance loss weight (harness adds)
 
     @property
     def jnp_dtype(self):
@@ -111,9 +118,45 @@ class CausalSelfAttention(nn.Module):
                                dtype=c.jnp_dtype, name="out")(y)
 
 
+class MoEMLP(nn.Module):
+    """Top-k routed expert FFN (tpuframe.ops.moe). Dropped-token residual
+    semantics: overflow tokens pass through with zero MLP contribution."""
+
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from tpuframe.ops import moe as moe_ops
+
+        c = self.cfg
+        b, s, h = x.shape
+        e, inter = c.moe_experts, c.intermediate_size
+        tokens = x.reshape(b * s, h)
+        gate_logits = nn.Dense(e, use_bias=False, name="router")(
+            tokens.astype(jnp.float32))
+        cap = moe_ops.capacity_for(b * s, e, c.moe_k, c.moe_capacity_factor)
+        dispatch, combine, aux = moe_ops.route_topk(gate_logits, k=c.moe_k,
+                                                    capacity=cap)
+        self.sow("aux_loss", "load_balance", aux)
+
+        up = self.param("up_experts", nn.initializers.lecun_normal(),
+                        (e, h, inter))
+        down = self.param("down_experts", nn.initializers.lecun_normal(),
+                          (e, inter, h))
+        dtype = c.jnp_dtype
+        expert_in = jnp.einsum("tec,th->ech", dispatch.astype(dtype),
+                               tokens.astype(dtype))
+        hmid = nn.gelu(jnp.einsum("ech,ehi->eci", expert_in,
+                                  up.astype(dtype)))
+        expert_out = jnp.einsum("eci,eih->ech", hmid, down.astype(dtype))
+        y = jnp.einsum("tec,ech->th", combine.astype(dtype), expert_out)
+        return y.reshape(b, s, h)
+
+
 class Block(nn.Module):
     cfg: LMConfig
     train: bool = False  # attribute (not call arg) so nn.remat sees only arrays
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -124,11 +167,14 @@ class Block(nn.Module):
         h = nn.Dropout(c.dropout, deterministic=not train)(h)
         x = x + h
         h = nn.LayerNorm(use_bias=False, name="mlp_ln")(x)
-        h = nn.Dense(c.intermediate_size, use_bias=False, dtype=c.jnp_dtype,
-                     name="up")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(c.hidden_size, use_bias=False, dtype=c.jnp_dtype,
-                     name="down")(h)
+        if self.use_moe:
+            h = MoEMLP(c, name="moe")(h)
+        else:
+            h = nn.Dense(c.intermediate_size, use_bias=False,
+                         dtype=c.jnp_dtype, name="up")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(c.hidden_size, use_bias=False, dtype=c.jnp_dtype,
+                         name="down")(h)
         h = nn.Dropout(c.dropout, deterministic=not train)(h)
         return x + h
 
@@ -153,7 +199,8 @@ class TransformerLM(nn.Module):
         x = x.astype(c.jnp_dtype)
         block = nn.remat(Block) if c.remat else Block
         for i in range(c.num_layers):
-            x = block(c, train, name=f"block_{i}")(x, positions)
+            use_moe = c.moe_experts > 0 and (i + 1) % c.moe_every == 0
+            x = block(c, train, use_moe, name=f"block_{i}")(x, positions)
         x = nn.LayerNorm(use_bias=False, name="final_ln")(x)
         logits = nn.Dense(c.vocab_size, use_bias=False, name="lm_head")(x)
         return logits.astype(jnp.float32)

@@ -1,0 +1,73 @@
+"""Mixture-of-experts routing — top-k gating with capacity (Switch/GShard
+formulation), built for expert parallelism over the ``expert`` mesh axis.
+
+Not a reference capability (SURVEY.md §3c: no MoE workload); included
+because expert parallelism is a first-class mesh axis in this framework.
+The dispatch/combine are dense einsums over a one-hot token→(expert, slot)
+tensor — static shapes, MXU-friendly, and under auto-SPMD with the expert
+dim of the weights sharded over ``expert``, GSPMD lowers the dispatch
+einsum to the same all-to-all a hand-written MoE runtime performs.
+
+All routing math runs in float32 regardless of activation dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def route_topk(gate_logits: jax.Array, *, k: int, capacity: int):
+    """Top-k token→expert assignment with per-expert capacity.
+
+    gate_logits: ``[T, E]`` (f32 recommended).
+    Returns ``(dispatch [T, E, C] f32 0/1, combine [T, E, C] f32,
+    aux_loss scalar)``.  Tokens overflowing an expert's capacity are
+    dropped for that expert (their combine weights are 0 — the residual
+    connection carries them, standard Switch behavior).
+    """
+    t, e = gate_logits.shape
+    gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)  # [T, E]
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    masked_gates = gates
+    prior_count = jnp.zeros((e,), jnp.float32)   # slots used per expert
+    chosen_masks = []
+    chosen_weights = []
+
+    for _ in range(k):
+        choice = jnp.argmax(masked_gates, axis=-1)              # [T]
+        mask = jax.nn.one_hot(choice, e, dtype=jnp.float32)     # [T, E]
+        # position of each token in its chosen expert's queue
+        pos_in_expert = (jnp.cumsum(mask, axis=0) - mask) + prior_count[None]
+        keep = mask * (pos_in_expert < capacity)
+        slot = jax.nn.one_hot((pos_in_expert * keep).astype(jnp.int32),
+                              capacity, dtype=jnp.float32)      # [T, E, C]
+        dispatch = dispatch + keep[..., None] * slot
+        weight = jnp.sum(gates * keep, axis=-1, keepdims=True)  # [T, 1]
+        combine = combine + (keep * weight)[..., None] * slot
+        chosen_masks.append(mask)
+        chosen_weights.append(weight)
+        prior_count = prior_count + jnp.sum(keep, axis=0)
+        masked_gates = masked_gates * (1.0 - mask)
+
+    # Renormalize the k gate weights so kept tokens' weights sum to ~1.
+    denom = sum(chosen_weights)
+    denom = jnp.where(denom > 0, denom, 1.0)
+    combine = combine / denom[..., None]
+
+    # Load-balance aux loss (Switch): E * sum_e mean_gates_e * frac_routed_e,
+    # computed on the FIRST choice (standard) before capacity dropping.
+    me = jnp.mean(gates, axis=0)                   # [E]
+    ce = jnp.mean(chosen_masks[0], axis=0)         # [E]
+    aux = e * jnp.sum(me * ce)
+    return dispatch, combine, aux
+
+
+def capacity_for(tokens: int, num_experts: int, k: int,
+                 capacity_factor: float) -> int:
+    """Static per-expert capacity: ceil(k*T/E * factor), min 1, multiple of
+    4 to keep the slot dim tile-friendly."""
+    raw = int(tokens * k / num_experts * capacity_factor) + 1
+    return max(4, (raw + 3) // 4 * 4)

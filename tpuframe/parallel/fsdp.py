@@ -65,7 +65,7 @@ def state_shardings(state: PyTree, mesh: Mesh, axis: str = "fsdp",
     ZeRO × TP from placement alone.
     """
     size = mesh.shape[axis]
-    tp_size = mesh.shape.get(tp_axis, 1) if tp_rules else 1
+    axis_sizes = dict(mesh.shape) if tp_rules else None
     amesh = auto_mesh(mesh)
     flat, treedef = jax.tree_util.tree_flatten_with_path(state)
 
@@ -84,10 +84,11 @@ def state_shardings(state: PyTree, mesh: Mesh, axis: str = "fsdp",
     for path, x in flat:
         shape = tuple(getattr(x, "shape", ()))
         base = None
-        if tp_size > 1:
+        if axis_sizes is not None:
             from tpuframe.parallel import tp as tp_lib
 
-            base = tp_lib.match_spec(path_str(path), shape, tp_size, tp_rules)
+            base = tp_lib.match_spec(path_str(path), shape, axis_sizes,
+                                     tp_rules)
         spec = _add_fsdp(shape, base, size, axis)
         out.append(NamedSharding(amesh, spec))
     return jax.tree_util.tree_unflatten(treedef, out)

@@ -36,6 +36,11 @@ TRANSFORMER_LM_RULES: tuple[tuple[str, P], ...] = (
     (r"down/kernel$", P("model", None)),
     (r"lm_head/kernel$", P(None, "model")),
     (r"embed/embedding$", P(None, "model")),
+    # MoE experts: leading expert dim over the expert axis; the expert's
+    # intermediate dim additionally over model (TP inside each expert).
+    (r"moe/up_experts$", P("expert", None, "model")),
+    (r"moe/down_experts$", P("expert", "model", None)),
+    (r"moe/router/kernel$", P()),
 )
 
 BERT_RULES: tuple[tuple[str, P], ...] = (
@@ -62,17 +67,26 @@ def rules_for_model(name: str) -> tuple[tuple[str, P], ...]:
     return RULES_BY_MODEL[name]
 
 
-def match_spec(path: str, shape: tuple[int, ...], tp_size: int,
+def match_spec(path: str, shape: tuple[int, ...],
+               axis_sizes: dict[str, int] | int,
                rules: tuple[tuple[str, P], ...]) -> P | None:
-    """The TP spec for a param path, or None when no rule applies/divides."""
-    if tp_size <= 1:
-        return None
+    """The placement spec for a param path, or None when no rule
+    applies or the named mesh axes don't divide the dims (replicate
+    rather than crash).  ``axis_sizes``: mesh axis → size (an int means
+    "every named axis has this size" — legacy TP-only call shape)."""
     for pattern, spec in rules:
         if re.search(pattern, path):
             if len(spec) > len(shape):
                 return None
             for dim, entry in zip(shape, spec):
-                if entry is not None and dim % tp_size != 0:
-                    return None  # indivisible → replicate rather than crash
+                if entry is None:
+                    continue
+                axes = entry if isinstance(entry, tuple) else (entry,)
+                size = 1
+                for ax in axes:
+                    size *= (axis_sizes if isinstance(axis_sizes, int)
+                             else axis_sizes.get(ax, 1))
+                if size > 1 and dim % size != 0:
+                    return None
             return spec
     return None

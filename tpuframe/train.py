@@ -94,7 +94,8 @@ def build_harness(cfg: TrainConfig) -> Harness:
     # Megatron-style TP over the model axis — both are placement decisions
     # living on the Auto-typed mesh twin (tpuframe.parallel.fsdp.auto_mesh).
     use_sharded_state = mesh is not None and (
-        mesh.shape["fsdp"] > 1 or mesh.shape["model"] > 1)
+        mesh.shape["fsdp"] > 1 or mesh.shape["model"] > 1
+        or mesh.shape["expert"] > 1)
     data_mesh = mesh
     if use_sharded_state:
         from tpuframe.parallel import fsdp as fsdp_lib
@@ -128,7 +129,7 @@ def build_harness(cfg: TrainConfig) -> Harness:
         from tpuframe.parallel import fsdp as fsdp_lib
 
         tp_rules = None
-        if mesh.shape["model"] > 1:
+        if mesh.shape["model"] > 1 or mesh.shape["expert"] > 1:
             from tpuframe.parallel import tp as tp_lib
 
             tp_rules = tp_lib.rules_for_model(cfg.model)
@@ -167,13 +168,21 @@ def build_harness(cfg: TrainConfig) -> Harness:
 
 def make_loss_fn(cfg: TrainConfig, model) -> step_lib.LossFn:
     if _is_lm_task(cfg):
+        aux_w = float(cfg.model_kwargs.get("moe_aux_weight", 0.01))
+
         def loss_fn(params, model_state, batch, rng):
-            logits = model.apply({"params": params, **model_state},
-                                 batch["input_ids"], train=True,
-                                 rngs={"dropout": rng})
+            logits, sown = model.apply({"params": params, **model_state},
+                                       batch["input_ids"], train=True,
+                                       rngs={"dropout": rng},
+                                       mutable=["aux_loss"])
             loss = losses.softmax_cross_entropy(logits, batch["labels"])
-            return loss, (model_state,
-                          {"accuracy": losses.accuracy(logits, batch["labels"])})
+            metrics = {"accuracy": losses.accuracy(logits, batch["labels"])}
+            aux_leaves = jax.tree.leaves(sown)
+            if aux_leaves:  # MoE load-balance penalty (tpuframe.ops.moe)
+                aux = sum(aux_leaves) / len(aux_leaves)
+                loss = loss + aux_w * aux
+                metrics["moe_aux"] = aux
+            return loss, (model_state, metrics)
 
         return loss_fn
 
