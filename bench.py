@@ -2,8 +2,8 @@
 
 The driver-defined metric (BASELINE.json:2): ResNet-50 images/sec/chip.
 This runs the flagship model's full training step (fwd+bwd+update, bf16
-compute, batch 128/chip) on the available chip(s) with synthetic ImageNet
-shapes, which isolates accelerator throughput from input-pipeline effects.
+compute) on the available chip(s) with synthetic ImageNet shapes, which
+isolates accelerator throughput from input-pipeline effects.
 
 ``vs_baseline``: the reference's own numbers are unpublished (BASELINE.md —
 `"published": {}` and the source mount was empty), so the anchor is the
@@ -12,46 +12,109 @@ V100 with standard fp16/32 ResNet-50 training (MLPerf v0.6-era single-GPU
 throughput; the Horovod paper's hardware class, PAPERS.md:8).
 vs_baseline = value / 360.0.
 
-Output: one JSON line
+Robustness (round-1 lesson — BENCH_r01.json was rc=124/parsed=null): progress
+goes to stderr at every stage, batch/steps are env-tunable
+(TPUFRAME_BENCH_BATCH / _STEPS / _WARMUP / _BUDGET_S), the persistent XLA
+compile cache is enabled, a watchdog emits the JSON line even if the remote
+TPU relay hangs, and any mid-run failure still prints a (degraded) JSON line.
+
+Output: one JSON line on stdout
   {"metric": "resnet50_images_per_sec_per_chip", "value": N,
-   "unit": "images/sec/chip", "vs_baseline": N}
+   "unit": "images/sec/chip", "vs_baseline": N, ...}
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
+import threading
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
 
 V100_HOROVOD_ANCHOR = 360.0  # images/sec/chip, see module docstring
 
 # Batch 512/chip measured fastest on the v5e bench chip (sweep 2026-07-29:
 # 128->1083, 256->1454, 512->1824, 1024->1797 images/sec/chip); large batches
 # keep the MXU fed through the small-spatial late stages.
-BATCH_PER_CHIP = 512
+BATCH_PER_CHIP = int(os.environ.get("TPUFRAME_BENCH_BATCH", "512"))
 IMAGE_SIZE = 224
-WARMUP_STEPS = 3
-MEASURE_STEPS = 8
+WARMUP_STEPS = int(os.environ.get("TPUFRAME_BENCH_WARMUP", "3"))
+MEASURE_STEPS = int(os.environ.get("TPUFRAME_BENCH_STEPS", "8"))
+BUDGET_S = float(os.environ.get("TPUFRAME_BENCH_BUDGET_S", "1500"))
+
+# fwd ~4.1 GFLOP/img at 224x224 + bwd ~2x fwd.
+RESNET50_FLOPS_PER_IMAGE = 12.3e9
+BF16_PEAK_FLOPS = {  # per chip, from public TPU spec sheets
+    "v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12,
+}
+
+_T0 = time.time()
+_RESULT: dict = {}  # mutated in place so the watchdog sees partial progress
+_DONE = threading.Event()  # set before the final emit; silences the watchdog
 
 
-def main() -> None:
+def _log(msg: str) -> None:
+    print(f"[bench +{time.time() - _T0:6.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def _emit(value: float, n_chips: int, **extra) -> None:
+    _DONE.set()
+    line = {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / V100_HOROVOD_ANCHOR, 4),
+    }
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    peak = BF16_PEAK_FLOPS.get(gen)
+    if peak and value > 0 and _RESULT.get("backend") != "cpu":
+        line["mfu"] = round(value * RESNET50_FLOPS_PER_IMAGE / peak, 4)
+        line["chip"] = gen
+    if n_chips:
+        line["n_chips"] = n_chips
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def _watchdog() -> None:
+    """Emit a (degraded) JSON line and hard-exit if the run overruns its
+    budget — a hung TPU relay must not turn into a silent driver timeout."""
+    if _DONE.wait(BUDGET_S) or _DONE.is_set():
+        return  # main thread emitted the real result
+    _log(f"WATCHDOG: exceeded {BUDGET_S}s at stage "
+         f"{_RESULT.get('stage', 'unknown')!r}; emitting degraded result")
+    _emit(_RESULT.get("best_value", 0.0), _RESULT.get("n_chips", 0),
+          degraded=True, stage=_RESULT.get("stage", "unknown"))
+    os._exit(0)
+
+
+def run(batch_per_chip: int, warmup: int, measure: int) -> float:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
     from tpuframe import models
     from tpuframe.models import losses
     from tpuframe.parallel import mesh as mesh_lib
     from tpuframe.parallel import step as step_lib
 
     n_chips = jax.device_count()
+    _RESULT["n_chips"] = n_chips
+    _RESULT["backend"] = jax.default_backend()
+    _RESULT["stage"] = "build"
+    _log(f"devices: {n_chips} x {jax.devices()[0].device_kind} "
+         f"(backend={jax.default_backend()})")
+
     mesh = mesh_lib.make_mesh() if n_chips > 1 else None
-    global_batch = BATCH_PER_CHIP * n_chips
+    global_batch = batch_per_chip * n_chips
 
     model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     rng = np.random.default_rng(0)
+    # bf16 on the host: halves infeed bytes and skips the on-device cast.
     x = rng.normal(0.5, 0.25, size=(global_batch, IMAGE_SIZE, IMAGE_SIZE, 3)
-                   ).astype(np.float32)
+                   ).astype(jnp.bfloat16)
     y = rng.integers(0, 1000, size=(global_batch,)).astype(np.int32)
     variables = model.init(jax.random.key(0), jnp.asarray(x[:2]))
 
@@ -86,22 +149,60 @@ def main() -> None:
         float(metrics["loss"])
         return state
 
-    for _ in range(WARMUP_STEPS):
+    _RESULT["stage"] = "compile+warmup"
+    _log(f"compiling + warmup ({warmup} steps, batch {batch_per_chip}/chip, "
+         f"global {global_batch})...")
+    for i in range(warmup):
         state = synced_step(state)
+        _log(f"warmup step {i + 1}/{warmup} done")
 
+    _RESULT["stage"] = "measure"
+    _log(f"measuring {measure} steps...")
     t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
+    done = 0
+    for i in range(measure):
         state = synced_step(state)
+        done = i + 1
+        # Keep a live partial estimate for the watchdog.
+        dt_so_far = time.perf_counter() - t0
+        _RESULT["best_value"] = done * global_batch / dt_so_far / n_chips
     dt = time.perf_counter() - t0
 
-    images_per_sec = MEASURE_STEPS * global_batch / dt
-    per_chip = images_per_sec / n_chips
-    print(json.dumps({
-        "metric": "resnet50_images_per_sec_per_chip",
-        "value": round(per_chip, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / V100_HOROVOD_ANCHOR, 4),
-    }))
+    per_chip = measure * global_batch / dt / n_chips
+    _log(f"measured {per_chip:.1f} images/sec/chip "
+         f"({dt / measure * 1e3:.1f} ms/step)")
+    return per_chip
+
+
+def main() -> None:
+    threading.Thread(target=_watchdog, daemon=True).start()
+    _RESULT["stage"] = "import-jax"
+    _log("importing jax (remote TPU relay init can be slow)...")
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".xla_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    n_chips = 0
+    try:
+        per_chip = run(BATCH_PER_CHIP, WARMUP_STEPS, MEASURE_STEPS)
+        n_chips = _RESULT["n_chips"]
+    except Exception as e:  # degraded path: smaller batch, fewer steps
+        _log(f"primary config failed ({type(e).__name__}: {e}); "
+             f"retrying degraded (batch 128, 2+4 steps)")
+        try:
+            per_chip = run(128, 2, 4)
+            n_chips = _RESULT["n_chips"]
+            _emit(per_chip, n_chips, degraded=True)
+            return
+        except Exception as e2:
+            _log(f"degraded config also failed ({type(e2).__name__}: {e2})")
+            _emit(_RESULT.get("best_value", 0.0), _RESULT.get("n_chips", 0),
+                  degraded=True, error=f"{type(e2).__name__}: {e2}"[:200])
+            return
+    _emit(per_chip, n_chips)
 
 
 if __name__ == "__main__":

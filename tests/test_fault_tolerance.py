@@ -102,6 +102,36 @@ def test_spmd_divergence_detected_across_hosts():
 
 
 @pytest.mark.slow
+def test_stall_becomes_clean_abort(tmp_path):
+    """Collective-timeout surfacing (SURVEY.md §5.3): wedge one rank mid-run;
+    every rank's heartbeat watchdog must turn the resulting pod-wide stall
+    into a clean exit-13 (not an indefinite hang), leaving the last committed
+    checkpoint for auto-resume.  Rank 1 stalls in its host loop; rank 0
+    stalls inside the collective waiting for it — both paths must abort."""
+    with pytest.raises(RuntimeError) as excinfo:
+        LocalCluster(
+            2, 2, timeout=400,
+            extra_env={
+                "TPUFRAME_HANG_STEP": "3",        # only rank 1 hangs
+                "TPUFRAME_HANG_RANK": "1",
+                "TPUFRAME_STALL_TIMEOUT_S": "20",
+            },
+        ).launch([
+            sys.executable, "-m", "tpuframe.train", "--config", "smoke",
+            "--set", "total_steps=30", "--set", "log_every=5",
+            "--set", "eval_every=1000", "--set", "global_batch=16",
+            "--set", "ckpt_every=2", "--ckpt-dir", str(tmp_path / "ck"),
+        ])
+    msg = str(excinfo.value)
+    assert "exit 13" in msg, msg
+    assert "STALL" in msg, msg
+    # a committed checkpoint exists for the restart to resume from
+    committed = sorted(p.name for p in (tmp_path / "ck").iterdir()
+                       if p.is_dir())
+    assert any(n.startswith("step_") for n in committed), committed
+
+
+@pytest.mark.slow
 def test_spmd_check_enabled_in_harness():
     """TPUFRAME_CHECK_SPMD=1 through the real train.py on 2 hosts."""
     results = LocalCluster(

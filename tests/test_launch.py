@@ -20,7 +20,9 @@ def test_slice_commands():
     assert "--project=proj" in create
     assert "--labels=team=ml" in create
     assert cfg.delete_cmd()[-1] == "--quiet"
-    assert cfg.num_workers == 8  # v4-32: 32 chips / 4 per host
+    # v4-32: suffix counts TensorCores → 16 chips → 4 hosts (4 chips/host).
+    assert cfg.num_chips == 16
+    assert cfg.num_workers == 4
 
     ssh = cfg.ssh_cmd("python train.py", env={"A": "b c"})
     assert ssh[4] == "ssh" and "--worker=all" in ssh
@@ -31,9 +33,17 @@ def test_slice_commands():
 
 
 def test_worker_counts():
-    assert SliceConfig("a", accelerator="v4-8").num_workers == 2
+    # v2/v3/v4/v5p accelerator suffixes count TensorCores (2/chip, 8/host);
+    # v5e/v6e suffixes count chips (8/host).  See SliceConfig comments.
+    assert SliceConfig("a", accelerator="v4-8").num_chips == 4
+    assert SliceConfig("a", accelerator="v4-8").num_workers == 1
     assert SliceConfig("a", accelerator="v3-8").num_workers == 1
+    assert SliceConfig("a", accelerator="v3-32").num_workers == 4
+    assert SliceConfig("a", accelerator="v5p-16").num_chips == 8
+    assert SliceConfig("a", accelerator="v5p-16").num_workers == 2
+    assert SliceConfig("a", accelerator="v5litepod-16").num_chips == 16
     assert SliceConfig("a", accelerator="v5litepod-16").num_workers == 2
+    assert SliceConfig("a", accelerator="v6e-8").num_workers == 1
 
 
 def test_emit_scripts(tmp_path):
@@ -72,6 +82,12 @@ def test_local_cluster_spmd():
                         out_shardings=NamedSharding(mesh, P()))(arr)
         # ranks contribute 1s and 2s: sum = 2*3*1 + 2*3*2 = 18
         assert float(total) == 18.0, float(total)
+        # cross_replica_mean: genuinely per-process values -> global mean
+        # (the hvd.allreduce(metric) eval path, SURVEY.md §4.5).
+        from tpuframe.parallel import collectives
+        m = collectives.cross_replica_mean(
+            {"acc": 1.0 + jax.process_index()})
+        assert abs(float(m["acc"]) - 1.5) < 1e-6, float(m["acc"])
         print("rank", jax.process_index(), "OK")
     """)
     results = LocalCluster(2, 2, timeout=300).launch(

@@ -31,6 +31,53 @@ def test_from_env_disabled_by_default(monkeypatch):
     assert StepTimeline.from_env() is None
 
 
+def test_tensorboard_events_stock_readable(tmp_path):
+    """Our hand-encoded event files must parse with tensorboard's OWN loader
+    (SURVEY.md §5.5 'event files a stock TensorBoard can read')."""
+    from tpuframe.obs.tensorboard import SummaryWriter
+
+    w = SummaryWriter(str(tmp_path))
+    w.add_scalars(1, {"loss": 2.5, "skip_me": "str"}, prefix="train")
+    w.add_scalars(2, {"loss": 1.25}, prefix="train")
+    w.add_scalar("eval/acc", 0.75, 2)
+    w.close()
+
+    files = [f for f in os.listdir(tmp_path) if "tfevents" in f]
+    assert len(files) == 1
+    from tensorboard.backend.event_processing.event_file_loader import (
+        EventFileLoader)
+
+    events = list(EventFileLoader(str(tmp_path / files[0])).Load())
+    assert events[0].file_version == "brain.Event:2"
+    # TB's loader migrates simple_value -> rank-0 tensor (data_compat);
+    # handle both, as a stock TB frontend does.
+    scalars = [(v.tag, e.step,
+                v.simple_value if v.WhichOneof("value") == "simple_value"
+                else v.tensor.float_val[0])
+               for e in events for v in e.summary.value]
+    assert ("train/loss", 1, 2.5) in scalars
+    assert ("train/loss", 2, 1.25) in scalars
+    assert ("eval/acc", 2, 0.75) in scalars
+    assert not any(t == "train/skip_me" for t, _, _ in scalars)
+
+
+def test_metric_logger_tb_sink(tmp_path):
+    from tpuframe.obs.metrics import MetricLogger
+
+    logger = MetricLogger(None, stdout=False, tb_dir=str(tmp_path / "tb"))
+    logger.log(3, {"loss": 0.5, "accuracy": 0.9})
+    logger.log(3, {"accuracy": 0.8}, prefix="eval")
+    logger.close()
+    files = [f for f in os.listdir(tmp_path / "tb") if "tfevents" in f]
+    assert len(files) == 1
+    from tensorboard.backend.event_processing.event_file_loader import (
+        EventFileLoader)
+
+    tags = {v.tag for e in EventFileLoader(
+        str(tmp_path / "tb" / files[0])).Load() for v in e.summary.value}
+    assert {"train/loss", "train/accuracy", "eval/accuracy"} <= tags
+
+
 def test_fusion_flags_shape():
     flags = tuning.fusion_flags(64 * 1024 * 1024)
     assert any("all_reduce_combine_threshold_bytes=67108864" in f

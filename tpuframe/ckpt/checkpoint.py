@@ -43,7 +43,13 @@ _MANIFEST = "manifest.json"
 _COMMIT = "COMMIT"
 
 
-def _crc32(data: bytes) -> int:
+def _crc32(data: bytes, algo: str = "crc32c") -> int:
+    if algo == "crc32":  # honored if a manifest ever records zlib crc32
+        import zlib
+
+        return zlib.crc32(data)
+    if algo != "crc32c":
+        raise ValueError(f"unknown checkpoint checksum algorithm {algo!r}")
     from tpuframe import native
 
     return native.crc32c(data)
@@ -96,6 +102,9 @@ def save(directory: str, step: int, tree: PyTree) -> str:
         "leaf_order": names,
         "leaves": {},
         "crc": {},
+        # Algorithm versioning: absent == legacy zlib crc32; restore verifies
+        # with whatever the writer recorded.
+        "crc_algo": "crc32c",
     }
 
     crc_local: dict[str, int] = {}
@@ -202,6 +211,10 @@ def restore(directory: str, step: int, *, mesh: Mesh | None = None,
         raise FileNotFoundError(f"no committed checkpoint at {path}")
     manifest = json.loads(gcs.read_bytes(gcs.join(path, _MANIFEST)))
     saved_names = manifest["leaf_order"]
+    # Default for manifests without the key: crc32c — every committed version
+    # of this writer used crc32c; the explicit key exists so a future
+    # algorithm change can't silently mis-verify old checkpoints.
+    crc_algo = manifest.get("crc_algo", "crc32c")
 
     def _placed(name: str, tgt) -> Any:
         entry = manifest["leaves"][name]
@@ -217,13 +230,13 @@ def restore(directory: str, step: int, *, mesh: Mesh | None = None,
             pieces = [
                 jax.device_put(
                     _assemble_region(path, entry, idx, manifest["crc"],
-                                     verify_crc, cache),
+                                     verify_crc, cache, crc_algo),
                     device)
                 for device, idx in idx_map.items()
             ]
             return jax.make_array_from_single_device_arrays(
                 shape, tgt_sharding, pieces)
-        arr = _assemble(path, entry, manifest["crc"], verify_crc)
+        arr = _assemble(path, entry, manifest["crc"], verify_crc, crc_algo)
         arr = arr.astype(np.dtype(entry["dtype"]), copy=False)
         if "prng_impl" in entry:
             key = jax.random.wrap_key_data(jnp_asarray(arr),
@@ -264,18 +277,20 @@ def restore(directory: str, step: int, *, mesh: Mesh | None = None,
     return out
 
 
-def _assemble(path: str, entry: dict, crcs: dict, verify_crc: bool) -> np.ndarray:
+def _assemble(path: str, entry: dict, crcs: dict, verify_crc: bool,
+              algo: str = "crc32c") -> np.ndarray:
     shape = tuple(entry["shape"])
     dtype = np.dtype(entry["dtype"])
     shards = entry["shards"] if entry["shards"] else []
     if not shards:
         raise FileNotFoundError(f"manifest entry has no shard files: {entry}")
-    first = _load_shard(path, shards[0]["file"], crcs, verify_crc, dtype)
+    first = _load_shard(path, shards[0]["file"], crcs, verify_crc, dtype,
+                        algo)
     if shards[0]["index"] is None or first.shape == shape:
         return first
     out = np.empty(shape, dtype)
     for sh in shards:
-        data = _load_shard(path, sh["file"], crcs, verify_crc, dtype)
+        data = _load_shard(path, sh["file"], crcs, verify_crc, dtype, algo)
         slices = tuple(slice(lo, hi) for lo, hi in sh["index"])
         out[slices] = data
     return out
@@ -283,7 +298,7 @@ def _assemble(path: str, entry: dict, crcs: dict, verify_crc: bool) -> np.ndarra
 
 def _assemble_region(path: str, entry: dict, region: tuple[slice, ...],
                      crcs: dict, verify_crc: bool,
-                     file_cache: dict) -> np.ndarray:
+                     file_cache: dict, algo: str = "crc32c") -> np.ndarray:
     """Materialize only ``region`` of a saved leaf, reading just the shard
     files that overlap it — the per-device restore path that avoids every
     host reading the whole checkpoint (SURVEY.md §4.4's no-rank-0-bottleneck
@@ -302,7 +317,7 @@ def _assemble_region(path: str, entry: dict, region: tuple[slice, ...],
             continue
         if sh["file"] not in file_cache:
             file_cache[sh["file"]] = _load_shard(path, sh["file"], crcs,
-                                                 verify_crc, dtype)
+                                                 verify_crc, dtype, algo)
         data = file_cache[sh["file"]]
         src = tuple(slice(lo - slo, hi - slo)
                     for (lo, hi), (slo, _) in zip(overlap, idx))
@@ -313,9 +328,10 @@ def _assemble_region(path: str, entry: dict, region: tuple[slice, ...],
 
 
 def _load_shard(path: str, fname: str, crcs: dict, verify_crc: bool,
-                dtype: np.dtype | None = None) -> np.ndarray:
+                dtype: np.dtype | None = None,
+                algo: str = "crc32c") -> np.ndarray:
     raw = gcs.read_bytes(gcs.join(path, fname))
-    if verify_crc and fname in crcs and _crc32(raw) != crcs[fname]:
+    if verify_crc and fname in crcs and _crc32(raw, algo) != crcs[fname]:
         raise IOError(f"CRC mismatch in checkpoint shard {fname} — corrupt file")
     arr = np.load(io.BytesIO(raw), allow_pickle=False)
     if arr.dtype.kind == "V" and dtype is not None:

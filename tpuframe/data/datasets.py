@@ -44,7 +44,11 @@ class ArrayDataset:
         return len(next(iter(self.columns.values())))
 
     def __getitem__(self, idx) -> dict[str, np.ndarray]:
-        if isinstance(idx, np.ndarray) and idx.ndim == 1:
+        if (isinstance(idx, np.ndarray) and idx.ndim == 1
+                and idx.dtype != np.bool_):
+            # (bool masks stay on the numpy fancy-indexing path below — the
+            # native gather casts indices to int64 and would silently read
+            # rows 0/1 instead of selecting masked rows.)
             # Batch assembly: multi-threaded native gather (tpuframe.native)
             # — the loader's per-step host work, off the GIL.
             from tpuframe import native

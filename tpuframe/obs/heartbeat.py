@@ -21,10 +21,16 @@ logger = logging.getLogger(__name__)
 
 class Heartbeat:
     def __init__(self, *, timeout_s: float = 120.0, poll_s: float = 5.0,
-                 on_stall: Callable[[float], None] | None = None):
+                 on_stall: Callable[[float], None] | None = None,
+                 arm_after_first_beat: bool = False):
         self.timeout_s = timeout_s
         self.poll_s = poll_s
         self.on_stall = on_stall
+        # When True, the watchdog only arms once a first step has completed —
+        # first-step latency includes XLA compilation, which is legitimate
+        # and unbounded (the harness uses this mode).
+        self.arm_after_first_beat = arm_after_first_beat
+        self._beats = 0
         self._last_beat = time.monotonic()
         self._step = 0
         self._stalled = False
@@ -34,6 +40,7 @@ class Heartbeat:
     def beat(self, step: int) -> None:
         """Call once per completed training step."""
         self._step = step
+        self._beats += 1
         self._last_beat = time.monotonic()
         self._stalled = False
 
@@ -54,6 +61,8 @@ class Heartbeat:
 
     def _watch(self) -> None:
         while not self._stop.wait(self.poll_s):
+            if self.arm_after_first_beat and self._beats == 0:
+                continue
             idle = time.monotonic() - self._last_beat
             if idle > self.timeout_s and not self._stalled:
                 self._stalled = True

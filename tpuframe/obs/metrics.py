@@ -64,7 +64,7 @@ class MetricLogger:
     loses at most one flush window and resumes never overwrite history)."""
 
     def __init__(self, log_file: str | None = None, *, stdout: bool = True,
-                 gcs_flush_every: int = 50):
+                 gcs_flush_every: int = 50, tb_dir: str | None = None):
         from tpuframe.data import gcs
 
         self.primary = jax.process_index() == 0
@@ -74,6 +74,12 @@ class MetricLogger:
         self._gcs_buf: list[str] = []
         self._gcs_segment = 0
         self._gcs_flush_every = gcs_flush_every
+        self._tb = None
+        if self.primary and tb_dir:
+            # TensorBoard event-file sink (SURVEY.md §5.5) — local or gs://.
+            from tpuframe.obs.tensorboard import SummaryWriter
+
+            self._tb = SummaryWriter(tb_dir)
         if self.primary and log_file:
             if gcs.is_gcs_path(log_file):
                 self._gcs_path = log_file
@@ -91,6 +97,8 @@ class MetricLogger:
                      else v) for k, v in metrics.items()}
         record = {"step": step, "prefix": prefix, "time": time.time(), **clean}
         line = json.dumps(record)
+        if self._tb is not None:
+            self._tb.add_scalars(step, clean, prefix=prefix)
         if self._fh:
             self._fh.write(line + "\n")
         elif self._gcs_path is not None:
@@ -116,6 +124,9 @@ class MetricLogger:
         self._gcs_buf = []
 
     def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
         if self._fh:
             self._fh.close()
             self._fh = None

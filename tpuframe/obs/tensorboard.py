@@ -1,0 +1,145 @@
+"""TensorBoard event-file sink — scalars in stock-TensorBoard format.
+
+Reference parity (SURVEY.md §5.5): "TensorBoard event files written to GCS".
+No tensorflow/tensorboard import needed to *write*: a scalar event is a tiny
+``Event``/``Summary`` protobuf (the wire format is frozen) framed as a
+TFRecord whose checksum is CRC32C — the same kernel checkpoint integrity uses
+(``tpuframe.native``).  Everything is hand-encoded here, ~60 lines, so the
+sink works on a bare TPU-VM image.
+
+Files land as ``<log_dir>/events.out.tfevents.<ts>.<host>.<pid>`` — exactly
+the glob stock TensorBoard scans — on local disk or GCS (``gs://`` paths go
+through ``tpuframe.data.gcs``; the whole accumulated record stream is
+rewritten per flush, which is cheap for scalar-only files).
+
+Verified readable by tensorboard's own ``EventFileLoader`` in
+``tests/test_observability.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+
+from tpuframe.data import gcs
+
+
+# --- minimal protobuf wire encoding (only what Event/Summary need) ---------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, data: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(data)) + data
+
+
+def _double_field(field: int, v: float) -> bytes:
+    return _key(field, 1) + struct.pack("<d", v)
+
+
+def _float_field(field: int, v: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", v)
+
+
+def _varint_field(field: int, n: int) -> bytes:
+    return _key(field, 0) + _varint(n)
+
+
+def _scalar_event(step: int, scalars: dict[str, float],
+                  wall_time: float) -> bytes:
+    """Event{wall_time=1, step=2, summary=5{value=1{tag=1, simple_value=2}*}}"""
+    summary = b"".join(
+        _len_field(1, _len_field(1, tag.encode()) + _float_field(2, float(v)))
+        for tag, v in scalars.items())
+    return (_double_field(1, wall_time) + _varint_field(2, step)
+            + _len_field(5, summary))
+
+
+def _file_version_event() -> bytes:
+    """Event{wall_time=1, file_version=3} — TB requires this first record."""
+    return _double_field(1, time.time()) + _len_field(3, b"brain.Event:2")
+
+
+# --- TFRecord framing ------------------------------------------------------
+
+def _masked_crc(data: bytes) -> int:
+    from tpuframe import native
+
+    crc = native.crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def _tfrecord(data: bytes) -> bytes:
+    header = struct.pack("<Q", len(data))
+    return (header + struct.pack("<I", _masked_crc(header))
+            + data + struct.pack("<I", _masked_crc(data)))
+
+
+# --- the writer ------------------------------------------------------------
+
+class SummaryWriter:
+    """Append-only scalar event writer for one run directory.
+
+    ``add_scalars(step, {"loss": 0.3, "acc": 0.9}, prefix="train")`` writes
+    tags ``train/loss``, ``train/acc``.  Buffers in memory; ``flush()``
+    persists (rewrite-whole-object semantics, GCS-safe).
+    """
+
+    def __init__(self, log_dir: str, *, flush_every: int = 20):
+        self.log_dir = log_dir
+        fname = (f"events.out.tfevents.{int(time.time())}."
+                 f"{socket.gethostname()}.{os.getpid()}")
+        self.path = gcs.join(log_dir, fname)
+        self._gcs = gcs.is_gcs_path(self.path)
+        self._buf = bytearray(_tfrecord(_file_version_event()))
+        self._pending = 0
+        self._flush_every = flush_every
+        gcs.makedirs(log_dir)
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        self.add_scalars(step, {tag: value})
+
+    def add_scalars(self, step: int, scalars: dict, *,
+                    prefix: str = "") -> None:
+        clean = {(f"{prefix}/{k}" if prefix else k): float(v)
+                 for k, v in scalars.items()
+                 if isinstance(v, (int, float)) or hasattr(v, "item")}
+        if not clean:
+            return
+        self._buf += _tfrecord(_scalar_event(step, clean, time.time()))
+        self._pending += 1
+        if self._pending >= self._flush_every:
+            self.flush()
+
+    def flush(self) -> None:
+        if not (self._pending or not gcs.exists(self.path)):
+            return
+        if self._gcs:
+            # GCS objects are immutable: rewrite the whole record stream
+            # (scalar event files stay small).
+            gcs.write_bytes(self.path, bytes(self._buf))
+        else:
+            # Local disk: append only what's new — O(new data); flushed
+            # history lives on disk, not in memory.
+            with open(self.path, "ab") as f:
+                f.write(bytes(self._buf))
+            del self._buf[:]
+        self._pending = 0
+
+    def close(self) -> None:
+        self.flush()

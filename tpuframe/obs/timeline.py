@@ -54,6 +54,12 @@ class StepTimeline:
     """
 
     def __init__(self, path: str):
+        # On a multi-host slice with a shared filesystem, every process
+        # writing the same path would clobber each other's full-file dump;
+        # suffix with the process index so each host's timeline survives.
+        if jax.process_count() > 1:
+            root, ext = os.path.splitext(path)
+            path = f"{root}.proc{jax.process_index()}{ext or '.json'}"
         self.path = path
         self._events: list[dict] = []
         self._t0 = time.perf_counter()

@@ -107,7 +107,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     # Fresh accumulators are unvarying; mark them varying over the same mesh
     # axes as q so the scan carry type is stable under shard_map's vma checks.
-    vary = lambda x: lax.pvary(x, tuple(jax.typeof(q).vma))  # noqa: E731
+    vary = lambda x: lax.pcast(  # noqa: E731
+        x, tuple(jax.typeof(q).vma), to="varying")
     init = (
         vary(jnp.zeros((b, c, heads, d), jnp.float32)),
         vary(jnp.full((b, heads, c), NEG_INF, jnp.float32)),

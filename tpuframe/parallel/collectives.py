@@ -203,30 +203,40 @@ def reduce_scatter(x: jax.Array, axis: AxisName = "data", *, scatter_axis: int =
 # Host-level (outside shard_map) collectives over a mesh
 # ---------------------------------------------------------------------------
 
-@jax.jit
-def _stacked_mean(x: PyTree) -> PyTree:
-    return jax.tree.map(lambda t: jnp.mean(t, axis=0), x)
-
-
-def cross_replica_mean(tree: PyTree, mesh: Mesh) -> PyTree:
-    """Average per-host scalar metrics across the data axis of ``mesh``.
+def cross_replica_mean(tree: PyTree, mesh: Mesh | None = None) -> PyTree:
+    """Average genuinely per-process host values across all processes.
 
     Reference parity: the eval-loop ``hvd.allreduce(metric_tensor)`` one-shot
-    collective (SURVEY.md §4.5).  Values are placed sharded over the batch
-    axes and mean-reduced inside a tiny jitted program.
+    collective (SURVEY.md §4.5).  Every process calls this with its OWN local
+    value (e.g. a per-host eval accuracy); the result is the cross-process
+    mean, identical on every process.  Single-process: identity (Horovod's
+    size()==1 no-op contract).  ``mesh`` is accepted for signature
+    compatibility but unused — the reduction runs over a one-device-per-
+    process mesh built here, so it works regardless of the caller's mesh.
     """
-    axes = mesh_lib.BATCH_AXES
-    dp = mesh_lib.data_parallel_size(mesh)
-    sharding = NamedSharding(mesh, P(axes))
+    del mesh
+    nproc = jax.process_count()
+    if nproc == 1:
+        return jax.tree.map(lambda t: jnp.asarray(t, jnp.float32), tree)
 
-    def _stack(leaf):
-        leaf = jnp.asarray(leaf)
-        stacked = jnp.broadcast_to(leaf[None], (dp, *leaf.shape))
-        return jax.device_put(stacked, sharding)
+    import numpy as np
 
-    # NOTE: each host contributes identical replicas here; for genuinely
-    # per-host values use `multihost_utils` style gather (launch layer).
-    return _stacked_mean(jax.tree.map(_stack, tree))
+    # One device per process, in process order — each process contributes one
+    # row of the stacked array via make_array_from_process_local_data.
+    per_proc: dict[int, Any] = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    devs = [per_proc[i] for i in sorted(per_proc)]
+    pmesh = Mesh(np.asarray(devs), ("proc",))
+    sharding = NamedSharding(pmesh, P("proc"))
+
+    def _mean(leaf):
+        local = np.asarray(leaf, np.float32)[None]
+        garr = jax.make_array_from_process_local_data(
+            sharding, local, (nproc, *local.shape[1:]))
+        return jnp.mean(garr, axis=0)
+
+    return jax.tree.map(_mean, tree)
 
 
 def host_broadcast(tree: PyTree, mesh: Mesh) -> PyTree:

@@ -265,10 +265,37 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
           log_file: str | None = None) -> dict:
     """Run the workload; returns final metrics (the driver/test surface)."""
     h = build_harness(cfg)
-    logger = MetricLogger(log_file)
+    logger = MetricLogger(
+        log_file, tb_dir=cfg.tb_dir or os.environ.get("TPUFRAME_TB_DIR"))
     rate = RateMeter()
-    heartbeat = Heartbeat(timeout_s=300.0).start()
     timeline = StepTimeline.from_env()  # HOROVOD_TIMELINE parity (§5.1)
+
+    # Collective-timeout surfacing (SURVEY.md §5.3): a hung step — peer host
+    # dead mid-collective, wedged infeed, dead coordinator — becomes a clean
+    # nonzero exit instead of an indefinite hang, so the slice launcher can
+    # restart the job and it auto-resumes from the last committed checkpoint.
+    # The watchdog arms after the first completed step (compile is unbounded).
+    stall_timeout = float(os.environ.get("TPUFRAME_STALL_TIMEOUT_S", "300"))
+    stall_abort = os.environ.get("TPUFRAME_STALL_ABORT", "1") == "1"
+
+    def _on_stall(idle: float) -> None:
+        if not stall_abort:
+            return
+        import sys
+
+        print(f"[tpuframe] STALL: no step completed in {idle:.0f}s — "
+              f"aborting for clean restart + checkpoint resume (exit 13)",
+              file=sys.stderr, flush=True)
+        try:
+            logger.close()
+            if timeline is not None:
+                timeline.instant("stall_abort", idle_s=idle)
+                timeline.close()
+        finally:
+            os._exit(13)
+
+    heartbeat = Heartbeat(timeout_s=stall_timeout, on_stall=_on_stall,
+                          arm_after_first_beat=True).start()
     examples_per_step = cfg.global_batch
 
     if bootstrap.is_primary():
@@ -279,22 +306,35 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
               f"global_batch={cfg.global_batch} steps={cfg.total_steps}",
               flush=True)
 
-    if os.environ.get("TPUFRAME_CHECK_SPMD") == "1":
-        # Debug mode (SURVEY.md §5.2): every host verifies it built the same
-        # config + step program before any collective runs.
-        from tpuframe.obs import spmd_check
-
-        spmd_check.assert_uniform_across_hosts("config", repr(cfg))
-
     # Test-only fault injection (SURVEY.md §5.3): simulate a host crash at an
     # exact step — os._exit skips all cleanup, so resume must cope with torn
-    # trailing state (uncommitted checkpoints, open logs).
+    # trailing state (uncommitted checkpoints, open logs).  HANG_STEP instead
+    # simulates a wedged host (the peer-stall class the watchdog must catch).
     fault_step = int(os.environ.get("TPUFRAME_FAULT_STEP", "0") or "0")
+    hang_step = int(os.environ.get("TPUFRAME_HANG_STEP", "0") or "0")
+    hang_rank = int(os.environ.get("TPUFRAME_HANG_RANK", "-1") or "-1")
+    if hang_rank >= 0 and jax.process_index() != hang_rank:
+        hang_step = 0
 
     state = h.state
     step = h.start_step
     final_train_metrics: dict = {}
     data_iter: Iterator = h.train_loader.from_step(step)
+
+    if os.environ.get("TPUFRAME_CHECK_SPMD") == "1":
+        # Debug mode (SURVEY.md §5.2): every host verifies it built the same
+        # config AND the same lowered step program before any collective runs
+        # — the host-dependent-trace divergence class.
+        import itertools
+
+        from tpuframe.obs import spmd_check
+
+        spmd_check.assert_uniform_across_hosts("config", repr(cfg))
+        if step < cfg.total_steps:
+            first = next(data_iter)
+            spmd_check.check_step_program(h.train_step, "train_step",
+                                          state, first)
+            data_iter = itertools.chain([first], data_iter)
     t_trace = None
     while step < cfg.total_steps:
         if trace_dir is not None and step == h.start_step + 5:
@@ -317,6 +357,10 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             print(f"[tpuframe] FAULT INJECTION: dying at step {step}",
                   flush=True)
             os._exit(42)
+        if hang_step and step == hang_step:
+            print(f"[tpuframe] FAULT INJECTION: hanging at step {step}",
+                  flush=True)
+            time.sleep(10 ** 6)
         rate.update(examples_per_step)
         heartbeat.beat(step)
 
@@ -340,6 +384,7 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             logger.log(step, eval_metrics, prefix="eval")
             final_train_metrics.update(
                 {f"eval_{k}": v for k, v in eval_metrics.items()})
+            heartbeat.beat(step)  # eval (incl. its first compile) is progress
 
         if h.manager is not None:
             with rate.paused():
@@ -348,6 +393,7 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
                         h.manager.maybe_save(step, state)
                 else:
                     h.manager.maybe_save(step, state)
+                heartbeat.beat(step)  # a long blocking save is progress too
 
     if t_trace is not None:
         t_trace.__exit__(None, None, None)
@@ -357,8 +403,8 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
     if timeline is not None:
         timeline.close()
         if bootstrap.is_primary():
-            print(f"[tpuframe] step timeline written to "
-                  f"{os.environ['TPUFRAME_TIMELINE']}", flush=True)
+            print(f"[tpuframe] step timeline written to {timeline.path}",
+                  flush=True)
     logger.close()
     final_train_metrics["step"] = step
     return final_train_metrics

@@ -54,6 +54,9 @@ class TrainConfig:
     # precision
     compute_dtype: str = "float32"  # bfloat16 on real TPU runs
 
+    # observability (SURVEY.md §5.5): TensorBoard event-file dir (gs:// ok)
+    tb_dir: str | None = None
+
     # checkpoint (SURVEY.md §4.4)
     ckpt_dir: str | None = None
     ckpt_every: int = 500
