@@ -1,0 +1,95 @@
+"""WordPiece tokenizer: token-for-token parity with HF BertTokenizer on the
+same vocab (the reference's GLUE tokenization, SURVEY.md §3a), plus the
+glue_sst2 wiring that makes it the default when a vocab.txt is present."""
+
+import numpy as np
+import pytest
+
+from tpuframe.data.wordpiece import WordPieceTokenizer
+
+VOCAB = [
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+    "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over", "a",
+    "lazy", "dog", "un", "##believ", "##able", "!", ",", ".", "'", "cafe",
+    "it", "was", "good", "bad", "movie", "this", "film", "is",
+]
+
+SENTENCES = [
+    "The quick brown fox jumped over a lazy dog!",
+    "unbelievable, it was GOOD.",
+    "café dog",              # accent strip: café -> cafe
+    "it's a movie",               # punctuation split on the apostrophe
+    "xyzzyplugh dog",             # unknown word -> [UNK]
+    "this film is unbelievable",
+]
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("wp") / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n")
+    return str(p)
+
+
+@pytest.fixture(scope="module")
+def hf_tokenizer(vocab_file):
+    from transformers import BertTokenizer
+
+    return BertTokenizer(vocab_file, do_lower_case=True)
+
+
+def test_tokenize_matches_hf(vocab_file, hf_tokenizer):
+    tok = WordPieceTokenizer(vocab_file)
+    for s in SENTENCES:
+        assert tok.tokenize(s) == hf_tokenizer.tokenize(s), s
+
+
+def test_encode_matches_hf(vocab_file, hf_tokenizer):
+    tok = WordPieceTokenizer(vocab_file)
+    enc = tok.encode_batch(SENTENCES, max_len=16)
+    ref = hf_tokenizer(SENTENCES, padding="max_length", truncation=True,
+                       max_length=16, return_tensors="np")
+    np.testing.assert_array_equal(enc["input_ids"], ref["input_ids"])
+    np.testing.assert_array_equal(enc["attention_mask"],
+                                  ref["attention_mask"])
+    np.testing.assert_array_equal(enc["token_type_ids"],
+                                  ref["token_type_ids"])
+
+
+def test_pair_encoding_matches_hf(vocab_file, hf_tokenizer):
+    tok = WordPieceTokenizer(vocab_file)
+    pairs = [("the quick fox", "a lazy dog"),
+             ("this film is unbelievable", "it was good")]
+    enc = tok.encode_batch(pairs, max_len=12)
+    ref = hf_tokenizer([p[0] for p in pairs], [p[1] for p in pairs],
+                       padding="max_length", truncation="longest_first",
+                       max_length=12, return_tensors="np")
+    np.testing.assert_array_equal(enc["input_ids"], ref["input_ids"])
+    np.testing.assert_array_equal(enc["token_type_ids"],
+                                  ref["token_type_ids"])
+
+
+def test_glue_sst2_uses_vocab_when_present(tmp_path):
+    from tpuframe.data import datasets
+
+    tsv = "sentence\tlabel\n" + "\n".join(
+        f"{s}\t{i % 2}" for i, s in enumerate(SENTENCES))
+    (tmp_path / "train.tsv").write_text(tsv)
+    (tmp_path / "dev.tsv").write_text(tsv)
+    (tmp_path / "vocab.txt").write_text("\n".join(VOCAB) + "\n")
+
+    train, dev = datasets.glue_sst2(str(tmp_path), seq_len=16)
+    tok = WordPieceTokenizer(str(tmp_path / "vocab.txt"))
+    ref = tok.encode_batch(SENTENCES, max_len=16)
+    np.testing.assert_array_equal(train[:len(SENTENCES)]["input_ids"],
+                                  ref["input_ids"])
+    assert train[:2]["label"].dtype == np.int32
+    # [CLS] leads every row; padding rows end in pad_id
+    assert (train[:len(SENTENCES)]["input_ids"][:, 0] == tok.cls_id).all()
+
+
+def test_unknown_and_long_words(vocab_file):
+    tok = WordPieceTokenizer(vocab_file)
+    assert tok.tokenize("zzz") == ["[UNK]"]
+    assert tok.tokenize("x" * 200) == ["[UNK]"]
+    assert tok.tokenize("") == []

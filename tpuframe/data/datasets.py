@@ -190,15 +190,24 @@ def imagenet(data_dir: str | None = None, *, image_size: int = 224,
 
 def glue_sst2(data_dir: str | None = None, *, seq_len: int = 128,
               vocab_size: int = 30522, synthetic_size: int = 1024,
-              tokenizer=None):
+              tokenizer=None, vocab_file: str | None = None):
     """Tokenized sentence-classification batches: input_ids / attention_mask /
     token_type_ids int32 [B, S], label int32.
 
-    With ``data_dir``: reads GLUE's SST-2 tsv files; tokenization uses the
-    provided HF tokenizer (the reference's path) or a hash-based fallback
-    that needs no vocab download.
+    With ``data_dir``: reads GLUE's SST-2 tsv files.  Tokenization, in
+    preference order: a caller-supplied tokenizer (HF-compatible callable);
+    the built-in WordPiece tokenizer (tpuframe.data.wordpiece) when
+    ``vocab_file`` is given or ``<data_dir>/vocab.txt`` exists — the real
+    SST-2 accuracy path, no HF needed; else a hash-based fallback (vocab-free,
+    fine for allreduce-stress benchmarking only).
     """
     if data_dir is not None:
+        if tokenizer is None:
+            vpath = vocab_file or gcs.join(data_dir, "vocab.txt")
+            if gcs.exists(vpath):
+                from tpuframe.data.wordpiece import WordPieceTokenizer
+
+                tokenizer = WordPieceTokenizer(vpath)
         def load(name):
             text = gcs.read_bytes(gcs.join(data_dir, name)).decode()
             lines = text.strip().split("\n")[1:]  # header
