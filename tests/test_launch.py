@@ -105,6 +105,42 @@ def test_local_cluster_failure_surfaces():
 
 
 @pytest.mark.slow
+def test_pod_config_multihost_kill_and_reshard_resume(tmp_path):
+    """Config 5's actual shape, rehearsed multi-host (SURVEY.md §7 hard
+    part 3): ``imagenet_resnet50_pod`` (scaled-down steps/shapes, synthetic
+    data) on a 4-host x 2-device cluster, hard-killed mid-run, then resumed
+    on a 2-host x 2-device cluster — a checkpoint written by 4 processes
+    restored by 2 (cross-process reshard-on-restore), continuing to the
+    exact final step."""
+    overrides = [
+        "--set", "total_steps=8", "--set", "ckpt_every=4",
+        "--set", "global_batch=32", "--set", "log_every=4",
+        "--set", "eval_every=1000", "--set", "warmup_steps=2",
+        "--set", "compute_dtype='float32'",
+        "--set", "dataset_kwargs={'image_size': 32, 'synthetic_size': 64}",
+        "--set", "model_kwargs={'cifar_stem': True, 'num_classes': 100}",
+        "--ckpt-dir", str(tmp_path / "ck"),
+    ]
+    argv = [sys.executable, "-m", "tpuframe.train",
+            "--config", "imagenet_resnet50_pod"] + overrides
+
+    # Phase 1: the whole 4-host pod dies as a unit at step 6 (after the
+    # step-4 checkpoint committed).
+    with pytest.raises(RuntimeError, match="exit 42"):
+        LocalCluster(4, 2, timeout=600,
+                     extra_env={"TPUFRAME_FAULT_STEP": "6"}).launch(argv)
+    committed = sorted(p.name for p in (tmp_path / "ck").iterdir()
+                       if p.is_dir() and (p / "COMMIT").exists())
+    assert "step_00000004" in committed, committed
+
+    # Phase 2: restart on HALF the hosts — resume must reshard and finish.
+    results = LocalCluster(2, 2, timeout=600).launch(argv)
+    assert "resumed from step 4" in results[0].stdout, \
+        results[0].stdout[-1500:]
+    assert "[train 8]" in results[0].stdout
+
+
+@pytest.mark.slow
 def test_local_cluster_harness_end_to_end():
     """The full train.py on a 2-host x 2-device fake cluster — config 5's
     launch shape (SURVEY.md §4.2) without a pod."""

@@ -88,6 +88,35 @@ def test_glue_sst2_uses_vocab_when_present(tmp_path):
     assert (train[:len(SENTENCES)]["input_ids"][:, 0] == tok.cls_id).all()
 
 
+def test_pair_truncation_tiebreak_matches_hf(vocab_file, hf_tokenizer):
+    """Equal-length pairs force the tie-break: HF's longest_first removes
+    from the SECOND sequence on ties."""
+    tok = WordPieceTokenizer(vocab_file)
+    pairs = [("the quick fox", "a lazy dog")]  # 3 vs 3 tokens
+    for max_len in (8, 7, 6, 5):
+        enc = tok.encode_batch(pairs, max_len=max_len)
+        ref = hf_tokenizer([p[0] for p in pairs], [p[1] for p in pairs],
+                           padding="max_length", truncation="longest_first",
+                           max_length=max_len, return_tensors="np")
+        np.testing.assert_array_equal(enc["input_ids"], ref["input_ids"],
+                                      err_msg=f"max_len={max_len}")
+
+
+def test_empty_batch(vocab_file):
+    tok = WordPieceTokenizer(vocab_file)
+    enc = tok.encode_batch([], max_len=16)
+    assert enc["input_ids"].shape == (0, 16)
+
+
+def test_explicit_missing_vocab_raises(tmp_path):
+    from tpuframe.data import datasets
+
+    (tmp_path / "train.tsv").write_text("sentence\tlabel\nhi\t0")
+    (tmp_path / "dev.tsv").write_text("sentence\tlabel\nhi\t0")
+    with pytest.raises(FileNotFoundError, match="vocab_file"):
+        datasets.glue_sst2(str(tmp_path), vocab_file=str(tmp_path / "no.txt"))
+
+
 def test_unknown_and_long_words(vocab_file):
     tok = WordPieceTokenizer(vocab_file)
     assert tok.tokenize("zzz") == ["[UNK]"]

@@ -236,21 +236,26 @@ def restore(directory: str, step: int, *, mesh: Mesh | None = None,
             ]
             return jax.make_array_from_single_device_arrays(
                 shape, tgt_sharding, pieces)
+        # Multi-host-safe placement: device_put rejects shardings spanning
+        # non-addressable devices (the restore-on-a-different-host-count
+        # path), so all global placement goes through host_device_put.
+        from tpuframe.parallel.mesh import host_device_put
+
         arr = _assemble(path, entry, manifest["crc"], verify_crc, crc_algo)
         arr = arr.astype(np.dtype(entry["dtype"]), copy=False)
         if "prng_impl" in entry:
             key = jax.random.wrap_key_data(jnp_asarray(arr),
                                            impl=entry["prng_impl"])
             if tgt_sharding is not None:
-                key = jax.device_put(key, tgt_sharding)
+                key = host_device_put(key, tgt_sharding)
             return key
         if tgt_sharding is not None:
-            # Replicated target: full assemble + device_put.
-            return jax.device_put(arr, tgt_sharding)
+            # Replicated target: full assemble + global placement.
+            return host_device_put(arr, tgt_sharding)
         if mesh is not None:
             spec = P(*[tuple(e) if e else None for e in entry["spec"]]) \
                 if entry["spec"] else P()
-            return jax.device_put(arr, NamedSharding(mesh, spec))
+            return host_device_put(arr, NamedSharding(mesh, spec))
         return arr
 
     if target is not None:

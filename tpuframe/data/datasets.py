@@ -208,6 +208,11 @@ def glue_sst2(data_dir: str | None = None, *, seq_len: int = 128,
                 from tpuframe.data.wordpiece import WordPieceTokenizer
 
                 tokenizer = WordPieceTokenizer(vpath)
+            elif vocab_file is not None:
+                # An explicit vocab path that doesn't exist is a config error
+                # — silently hash-tokenizing would just show up as
+                # mysteriously bad accuracy.
+                raise FileNotFoundError(f"vocab_file not found: {vocab_file}")
         def load(name):
             text = gcs.read_bytes(gcs.join(data_dir, name)).decode()
             lines = text.strip().split("\n")[1:]  # header

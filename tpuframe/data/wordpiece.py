@@ -9,7 +9,7 @@ with ``##`` continuation pieces — driven by a standard ``vocab.txt`` (one
 token per line, local path or ``gs://``).
 
 Output matches ``transformers.BertTokenizer`` token-for-token on the same
-vocab (asserted in ``tests/test_data_ckpt.py``), so checkpoints/datasets are
+vocab (asserted in ``tests/test_wordpiece.py``), so checkpoints/datasets are
 interchangeable with the reference's pipeline.
 """
 
@@ -154,10 +154,11 @@ class WordPieceTokenizer:
         ids_a = [self.vocab[t] for t in self.tokenize(text_a)]
         ids_b = [self.vocab[t] for t in self.tokenize(text_b)] if text_b else []
         if ids_b:
-            # pair truncation: trim the longer side first (HF's
-            # 'longest_first' strategy)
+            # pair truncation: trim the longer side first; on ties HF's
+            # 'longest_first' removes from the SECOND sequence (its condition
+            # is strictly len(a) > len(b)), so match that exactly.
             while len(ids_a) + len(ids_b) > max_len - 3:
-                (ids_a if len(ids_a) >= len(ids_b) else ids_b).pop()
+                (ids_a if len(ids_a) > len(ids_b) else ids_b).pop()
             ids = [self.cls_id] + ids_a + [self.sep_id] + ids_b + [self.sep_id]
             types = [0] * (len(ids_a) + 2) + [1] * (len(ids_b) + 1)
         else:
@@ -176,6 +177,10 @@ class WordPieceTokenizer:
         """Batch encode; each item is a string or an (a, b) pair."""
         encs = [self.encode(*((t,) if isinstance(t, str) else tuple(t)),
                             max_len=max_len) for t in texts]
+        if not encs:
+            empty = np.zeros((0, max_len), np.int32)
+            return {"input_ids": empty, "attention_mask": empty.copy(),
+                    "token_type_ids": empty.copy()}
         return {k: np.stack([e[k] for e in encs]) for k in encs[0]}
 
     def __call__(self, texts, **kwargs):
