@@ -1,0 +1,135 @@
+"""Perf experiment: where does the ResNet-50 step time go?
+
+Device tracing is unavailable on the axon relay (jax.profiler.start_trace
+hangs before returning — see PERF.md), so this decomposes the step cost by
+compiling and timing nested sub-programs:
+
+  fwd            : inference forward (train=False)
+  fwd_train      : forward with batch-stat mutation
+  grad           : value_and_grad (fwd+bwd), no optimizer
+  full           : the real train step (grad + pmean-less update)
+
+and prints XLA cost analysis (flops / bytes accessed) for each, which gives
+an analytic roofline: t_mxu = flops / 197e12, t_hbm = bytes / 8.1e11 (v5e).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".xla_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from tpuframe import models
+from tpuframe.models import losses
+from tpuframe.parallel import step as step_lib
+
+BATCH = int(os.environ.get("B", "512"))
+STEPS = int(os.environ.get("N", "8"))
+
+
+def log(m):
+    print(f"[exp] {m}", file=sys.stderr, flush=True)
+
+
+def time_fn(fn, *args, steps=STEPS):
+    """Time `fn` with async chained dispatch + one final fetch."""
+    out = fn(*args)
+    jax.block_until_ready(out)  # warmup/compile
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def cost(compiled):
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        return ca.get("flops", 0), ca.get("bytes accessed", 0)
+    except Exception:
+        return 0, 0
+
+
+def main():
+    model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0.5, 0.25, size=(BATCH, 224, 224, 3)),
+                    jnp.bfloat16)
+    y = jnp.asarray(rng.integers(0, 1000, size=(BATCH,)), jnp.int32)
+    variables = model.init(jax.random.key(0), x[:2])
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    params, bstats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(params, model_state, batch, step_rng):
+        logits, mutated = model.apply(
+            {"params": params, **model_state}, batch["image"], train=True,
+            mutable=["batch_stats"])
+        loss = losses.softmax_cross_entropy(logits, batch["label"],
+                                            label_smoothing=0.1)
+        return loss, (dict(mutated), {})
+
+    state = step_lib.TrainState.create(
+        params, tx, model_state={"batch_stats": bstats})
+    train_step = step_lib.make_train_step(loss_fn, tx, None, donate=False)
+    batch = {"image": x, "label": y}
+
+    # -- fwd (inference) --
+    fwd = jax.jit(lambda p, s, im: model.apply(
+        {"params": p, **s}, im, train=False))
+    log("timing fwd(infer)...")
+    t = time_fn(fwd, params, {"batch_stats": bstats}, x)
+    log("cost-analysis fwd(infer)...")
+    c = cost(fwd.lower(params, {"batch_stats": bstats}, x).compile())
+    log(f"fwd(infer)  : {t*1e3:7.1f} ms  flops={c[0]:.3e} bytes={c[1]:.3e}")
+
+    # -- fwd train (batch stats) --
+    fwd_t = jax.jit(lambda p, s, im: model.apply(
+        {"params": p, **s}, im, train=True, mutable=["batch_stats"]))
+    log("timing fwd(train)...")
+    t = time_fn(fwd_t, params, {"batch_stats": bstats}, x)
+    log("cost-analysis fwd(train)...")
+    c = cost(fwd_t.lower(params, {"batch_stats": bstats}, x).compile())
+    log(f"fwd(train)  : {t*1e3:7.1f} ms  flops={c[0]:.3e} bytes={c[1]:.3e}")
+
+    # -- grad --
+    def just_grad(p, s, b, r):
+        return jax.value_and_grad(loss_fn, has_aux=True)(p, s, b, r)
+    gr = jax.jit(just_grad)
+    r = jax.random.key(1)
+    log("timing grad...")
+    t = time_fn(gr, params, {"batch_stats": bstats}, batch, r)
+    log("cost-analysis grad...")
+    c = cost(gr.lower(params, {"batch_stats": bstats}, batch, r).compile())
+    log(f"grad(f+b)   : {t*1e3:7.1f} ms  flops={c[0]:.3e} bytes={c[1]:.3e}")
+
+    # -- full step --
+    log("timing full step...")
+    new, m = train_step(state, batch)
+    jax.block_until_ready(m)
+    t0 = time.perf_counter()
+    cur = state
+    for _ in range(STEPS):
+        cur, m = train_step(cur, batch)
+    jax.block_until_ready(m)
+    t = (time.perf_counter() - t0) / STEPS
+    c = cost(train_step.lower(state, batch).compile())
+    log(f"full step   : {t*1e3:7.1f} ms  flops={c[0]:.3e} bytes={c[1]:.3e}")
+    log(f"roofline: t_mxu(full)={c[0]/197e12*1e3:.1f} ms  "
+        f"t_hbm(full)={c[1]/8.1e11*1e3:.1f} ms")
+    log(f"imgs/s at full: {BATCH/t:.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,202 @@
+"""MoE routing (tpuframe.ops.moe) + expert-parallel train step.
+
+Covers the contract items of ``route_topk``: hand-computable dispatch and
+combine tensors, capacity-overflow dropping with residual pass-through,
+top-k combine renormalization, the Switch load-balance aux loss value on a
+hand-checked case, and the golden invariants: MoEMLP with E=k=1 equals the
+plain dense FFN computed from the same expert weights, and an
+``moe_experts>0`` LM train step on a dp×expert mesh matches the unsharded
+single-device run (SURVEY.md §7 golden-loss strategy extended to the
+``expert`` axis)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpuframe.models import losses
+from tpuframe.models.transformer_lm import LMConfig, MoEMLP, TransformerLM
+from tpuframe.ops import moe
+from tpuframe.parallel import mesh as mesh_lib
+from tpuframe.parallel import step as step_lib
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestCapacityFor:
+    def test_covers_even_load(self):
+        # 100 tokens, 4 experts, k=1, factor 1.0 → ≥ 25 slots/expert.
+        assert moe.capacity_for(100, 4, 1, 1.0) >= 25
+
+    def test_multiple_of_four_and_min(self):
+        for t, e, k, f in [(8, 8, 1, 0.1), (100, 4, 2, 1.25), (7, 3, 2, 1.0)]:
+            c = moe.capacity_for(t, e, k, f)
+            assert c % 4 == 0 and c >= 4
+
+    def test_scales_with_k(self):
+        assert moe.capacity_for(64, 4, 2, 1.0) >= 2 * moe.capacity_for(
+            64, 4, 1, 1.0) - 4
+
+
+class TestRouteTopK:
+    def test_k1_dispatch_slots_in_order(self):
+        # Tokens 0,1 prefer expert 0; tokens 2,3 prefer expert 1.
+        logits = jnp.asarray([[4.0, 0.0], [4.0, 0.0],
+                              [0.0, 4.0], [0.0, 4.0]], jnp.float32)
+        dispatch, combine, _ = moe.route_topk(logits, k=1, capacity=4)
+        d = np.asarray(dispatch)
+        # (token, expert, slot): queue positions assigned in token order.
+        assert d[0, 0, 0] == 1 and d[1, 0, 1] == 1
+        assert d[2, 1, 0] == 1 and d[3, 1, 1] == 1
+        assert d.sum() == 4  # exactly one slot per token
+        # k=1 combine weight renormalizes to 1 on the dispatched slot.
+        np.testing.assert_allclose(np.asarray(combine).sum(axis=(1, 2)),
+                                   np.ones(4), atol=1e-6)
+
+    def test_capacity_overflow_drops_with_residual_semantics(self):
+        # All 6 tokens prefer expert 0; capacity 4 → tokens 4,5 dropped
+        # (all-zero combine row — the residual connection carries them).
+        logits = jnp.tile(jnp.asarray([[9.0, 0.0]], jnp.float32), (6, 1))
+        dispatch, combine, _ = moe.route_topk(logits, k=1, capacity=4)
+        d, c = np.asarray(dispatch), np.asarray(combine)
+        assert d[:4, 0].sum() == 4          # first four tokens seated
+        assert d[4:].sum() == 0             # overflow: no slot anywhere
+        assert np.all(c[4:] == 0.0)         # zero combine → pass-through
+        np.testing.assert_allclose(c[:4].sum(axis=(1, 2)), np.ones(4),
+                                   atol=1e-6)
+
+    def test_topk_combine_renormalization(self):
+        rng = np.random.default_rng(3)
+        logits = rng.normal(size=(16, 4)).astype(np.float32)
+        dispatch, combine, _ = moe.route_topk(jnp.asarray(logits), k=2,
+                                              capacity=16)
+        gates = _softmax(logits)
+        c = np.asarray(combine)
+        for t in range(16):
+            top2 = np.argsort(gates[t])[::-1][:2]
+            g1, g2 = gates[t, top2[0]], gates[t, top2[1]]
+            # Each token's two combine weights are its two gates
+            # renormalized to sum to 1, placed on its chosen experts.
+            np.testing.assert_allclose(c[t, top2[0]].sum(), g1 / (g1 + g2),
+                                       atol=1e-5)
+            np.testing.assert_allclose(c[t, top2[1]].sum(), g2 / (g1 + g2),
+                                       atol=1e-5)
+            np.testing.assert_allclose(c[t].sum(), 1.0, atol=1e-5)
+
+    def test_switch_aux_loss_hand_value(self):
+        # Hand case: 4 tokens, 2 experts. Three route to expert 0, one to
+        # expert 1 (first choice, pre-capacity): ce = [0.75, 0.25].
+        logits = np.asarray([[2.0, 0.0], [2.0, 0.0], [2.0, 0.0], [0.0, 2.0]],
+                            np.float32)
+        gates = _softmax(logits)
+        me = gates.mean(axis=0)
+        expected = 2.0 * (me[0] * 0.75 + me[1] * 0.25)
+        _, _, aux = moe.route_topk(jnp.asarray(logits), k=1, capacity=4)
+        np.testing.assert_allclose(float(aux), expected, atol=1e-6)
+
+    def test_aux_loss_balanced_is_lower(self):
+        balanced = jnp.asarray([[3.0, 0.0], [0.0, 3.0]] * 4, jnp.float32)
+        skewed = jnp.tile(jnp.asarray([[3.0, 0.0]], jnp.float32), (8, 1))
+        _, _, aux_b = moe.route_topk(balanced, k=1, capacity=8)
+        _, _, aux_s = moe.route_topk(skewed, k=1, capacity=8)
+        assert float(aux_b) < float(aux_s)
+
+
+class TestMoEMLP:
+    def test_e1_k1_equals_dense_ffn(self):
+        # With one expert and k=1 the routed path must reduce exactly to
+        # gelu(x @ up) @ down with combine weight 1 — the golden-vs-dense
+        # invariant at the layer level.
+        cfg = LMConfig.tiny(moe_experts=1, moe_k=1, hidden_size=16,
+                            intermediate_size=32)
+        layer = MoEMLP(cfg)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 8, 16)), jnp.float32)
+        variables = layer.init(jax.random.key(0), x)
+        y, _ = layer.apply(variables, x, mutable=["aux_loss"])
+        up = variables["params"]["up_experts"][0]
+        down = variables["params"]["down_experts"][0]
+        tokens = np.asarray(x).reshape(-1, 16)
+        expected = jax.nn.gelu(tokens @ np.asarray(up)) @ np.asarray(down)
+        np.testing.assert_allclose(np.asarray(y).reshape(-1, 16),
+                                   np.asarray(expected), atol=1e-5)
+
+    def test_aux_loss_sown(self):
+        cfg = LMConfig.tiny(moe_experts=4, moe_k=2, hidden_size=16,
+                            intermediate_size=32)
+        layer = MoEMLP(cfg)
+        x = jnp.ones((1, 8, 16), jnp.float32)
+        variables = layer.init(jax.random.key(0), x)
+        _, sown = layer.apply({"params": variables["params"]}, x,
+                              mutable=["aux_loss"])
+        aux = jax.tree.leaves(sown)
+        assert len(aux) == 1 and np.asarray(aux[0]).shape == ()
+
+
+def _moe_losses(mesh_spec, n_steps=3, aux_weight=0.0):
+    """Train a tiny MoE LM for a few steps; ample capacity so no tokens are
+    dropped (local-vs-global routing then agrees between shardings).
+
+    ``aux_weight`` defaults to 0 for the golden comparison: the Switch aux
+    loss is a product of per-routing-group means (me·ce), so its value under
+    per-shard routing is mathematically different from the unsharded global
+    value — expected behavior, not a defect; the aux metric itself is
+    compared loosely in the test."""
+    cfg = LMConfig.tiny(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, intermediate_size=64, max_seq=32,
+                        moe_experts=4, moe_k=2, moe_every=2,
+                        moe_capacity_factor=4.0)
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 64, size=(8, 33)).astype(np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    variables = model.init(jax.random.key(0),
+                           jnp.asarray(batch["input_ids"][:1]))
+    tx = optax.adam(1e-3)
+
+    def loss_fn(params, model_state, batch, rng):
+        logits, sown = model.apply({"params": params}, batch["input_ids"],
+                                   train=True, rngs={"dropout": rng},
+                                   mutable=["aux_loss"])
+        loss = losses.softmax_cross_entropy(logits, batch["labels"])
+        aux = sum(jax.tree.leaves(sown)) / max(len(jax.tree.leaves(sown)), 1)
+        return loss + aux_weight * aux, ({}, {"moe_aux": aux})
+
+    mesh = mesh_lib.make_mesh(mesh_spec) if mesh_spec else None
+    train_step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False)
+    state = step_lib.TrainState.create(variables["params"], tx)
+    if mesh is not None:
+        state = step_lib.replicate_state(state, mesh)
+        batch = jax.tree.map(
+            lambda x: jax.device_put(x, mesh_lib.batch_sharding(mesh)), batch)
+
+    out = []
+    for _ in range(n_steps):
+        state, metrics = train_step(state, batch)
+        out.append((float(metrics["loss"]), float(metrics["moe_aux"])))
+    return out
+
+
+def test_moe_train_step_dp_expert_mesh_golden():
+    ref = _moe_losses(None)
+    got = _moe_losses(mesh_lib.MeshSpec(data=4, expert=2))
+    np.testing.assert_allclose([l for l, _ in got], [l for l, _ in ref],
+                               rtol=2e-5, atol=2e-5)
+    assert ref[-1][0] < ref[0][0]  # learning
+    assert all(a > 0 for _, a in ref)  # aux loss active
+    # Aux is a per-routing-group statistic (see _moe_losses docstring):
+    # pmean of per-shard values tracks the global value only approximately.
+    for (_, a_got), (_, a_ref) in zip(got, ref):
+        np.testing.assert_allclose(a_got, a_ref, rtol=0.2)
+
+
+def test_moe_train_step_with_aux_weight_runs():
+    # The full harness path (aux folded into the differentiated loss) on the
+    # dp×expert mesh: must run and learn; exact golden equality is covered
+    # by the aux_weight=0 test above.
+    out = _moe_losses(mesh_lib.MeshSpec(data=4, expert=2), aux_weight=0.01)
+    assert out[-1][0] < out[0][0]

@@ -33,9 +33,9 @@ def vocab_file(tmp_path_factory):
 
 @pytest.fixture(scope="module")
 def hf_tokenizer(vocab_file):
-    from transformers import BertTokenizer
+    transformers = pytest.importorskip("transformers")
 
-    return BertTokenizer(vocab_file, do_lower_case=True)
+    return transformers.BertTokenizer(vocab_file, do_lower_case=True)
 
 
 def test_tokenize_matches_hf(vocab_file, hf_tokenizer):

@@ -7,9 +7,10 @@ device_puts batches pre-sharded over the mesh's batch axes, one step ahead of
 compute (double buffering) so infeed overlaps the running step — the role
 Horovod leaves to DataLoader prefetch + CUDA streams.
 
-A C++ prefetch ring (tpuframe.ops.native) backs the ``native_prefetch`` mode
-for the ImageNet-rate pipelines; the pure-Python path is the default and the
-fallback.
+Batch assembly inside the prefetch thread uses the multi-threaded C++ row
+gather from ``tpuframe.native`` (GIL-released; see ArrayDataset.__getitem__),
+with numpy fancy-indexing as the fallback when the native library is
+unavailable.
 """
 
 from __future__ import annotations

@@ -249,16 +249,28 @@ def make_metric_fn(cfg: TrainConfig, model):
 
 
 def evaluate(h: Harness, max_batches: int) -> dict:
-    agg: dict[str, float] = {}
+    # Accumulate on device: per-batch metric dicts are summed as device
+    # arrays (async dispatch, no host sync), and the ONE device_get at the
+    # end fetches the whole pass — the reference's eval loop does one small
+    # allreduce per metric per batch and a host read each time (SURVEY.md
+    # §4.5); here host↔device traffic is a single transfer per eval.
+    agg: dict | None = None
     n = 0
     for i, batch in enumerate(h.eval_loader.epoch(0)):
         if i >= max_batches:
             break
-        m = jax.device_get(h.eval_step(h.state, batch))
-        for k, v in m.items():
-            agg[k] = agg.get(k, 0.0) + float(v)
+        m = h.eval_step(h.state, batch)
+        agg = m if agg is None else jax.tree.map(jnp.add, agg, m)
         n += 1
-    return {k: v / max(n, 1) for k, v in agg.items()}
+        if n % 8 == 0:
+            # Bound device-memory run-ahead: without a sync the loader can
+            # device_put batches faster than eval consumes them and in-flight
+            # buffers pile up in HBM.  block_until_ready is a sync, not a
+            # transfer — the one-device_get-per-eval contract holds.
+            jax.block_until_ready(agg)
+    if agg is None:
+        return {}
+    return {k: float(v) / n for k, v in jax.device_get(agg).items()}
 
 
 def train(cfg: TrainConfig, *, trace_dir: str | None = None,
