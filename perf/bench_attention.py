@@ -1,0 +1,90 @@
+"""Pallas flash attention vs the XLA einsum path on the real chip.
+
+VERDICT r2 #2's measurement half: tokens/s fwd and fwd+bwd at seq 2k-8k,
+causal, bf16 — the long-context shape class.  Results go into BASELINE.md.
+
+    python perf/bench_attention.py            # all seqs, both impls
+    SEQS=2048 python perf/bench_attention.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".xla_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from tpuframe.ops import attention as attn_ops
+from tpuframe.ops.flash_attention import flash_mha
+
+SEQS = [int(s) for s in os.environ.get("SEQS", "2048,4096,8192").split(",")]
+HEADS = int(os.environ.get("HEADS", "8"))
+HEAD_DIM = int(os.environ.get("HEAD_DIM", "64"))
+BATCH = int(os.environ.get("B", "4"))
+STEPS = int(os.environ.get("N", "10"))
+
+
+def log(m):
+    print(f"[attn-bench] {m}", file=sys.stderr, flush=True)
+
+
+def timeit(fn, *args, steps=STEPS):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    log(f"backend={jax.default_backend()} b={BATCH} h={HEADS} d={HEAD_DIM}")
+    rows = []
+    for s in SEQS:
+        rng = np.random.default_rng(0)
+        mk = lambda: jnp.asarray(  # noqa: E731
+            rng.normal(0, 0.5, size=(BATCH, s, HEADS, HEAD_DIM)), jnp.bfloat16)
+        q, k, v = mk(), mk(), mk()
+        tokens = BATCH * s
+
+        impls = {
+            "pallas": jax.jit(lambda q, k, v: flash_mha(
+                q, k, v, causal=True, interpret=False)),
+            "xla": jax.jit(lambda q, k, v: attn_ops.multihead_attention(
+                q, k, v, causal=True, impl="xla")),
+        }
+        grads = {
+            name: jax.jit(jax.grad(
+                lambda q, k, v, f=f: jnp.sum(f(q, k, v) ** 2).astype(jnp.float32),
+                argnums=(0, 1, 2)))
+            for name, f in impls.items()
+        }
+        for name in impls:
+            try:
+                t_f = timeit(impls[name], q, k, v)
+                t_fb = timeit(grads[name], q, k, v)
+                row = {"seq": s, "impl": name,
+                       "fwd_ms": round(t_f * 1e3, 2),
+                       "fwd_tokens_per_s": round(tokens / t_f),
+                       "fwdbwd_ms": round(t_fb * 1e3, 2),
+                       "fwdbwd_tokens_per_s": round(tokens / t_fb)}
+            except Exception as e:  # noqa: BLE001 — record and continue
+                row = {"seq": s, "impl": name,
+                       "error": f"{type(e).__name__}: {e}"[:300]}
+            rows.append(row)
+            log(str(row))
+    import json
+    print(json.dumps(rows, indent=1), flush=True)
+
+
+if __name__ == "__main__":
+    main()

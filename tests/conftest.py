@@ -9,14 +9,22 @@ no backend has been initialized yet at collection time.
 
 import os
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# TPUFRAME_TPU_TESTS=1 keeps the real backend so the TPU-gated tests
+# (tests/test_flash_attention_tpu.py) can run on the bench chip:
+#   TPUFRAME_TPU_TESTS=1 python -m pytest tests/test_flash_attention_tpu.py
+_USE_TPU = os.environ.get("TPUFRAME_TPU_TESTS") == "1"
+
+if not _USE_TPU:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _USE_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -105,6 +105,64 @@ def test_local_cluster_failure_surfaces():
 
 
 @pytest.mark.slow
+def test_replicated_restore_reads_storage_only_on_primary(tmp_path):
+    """Primary-read + interconnect-broadcast restore (SURVEY.md §4.4 parity
+    with rank-0 torch.load + hvd.broadcast_parameters): for fully-replicated
+    leaves only process 0 may touch the checkpoint files; every other
+    process must receive the bytes via collectives.primary_device_put and
+    still reconstruct identical values (incl. a PRNG key leaf)."""
+    script = textwrap.dedent("""
+        import jax, numpy as np
+        from tpuframe.parallel import bootstrap, mesh as mesh_lib
+        bootstrap.initialize()
+        mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=4))
+        from tpuframe.ckpt import checkpoint as ck
+        repl = mesh_lib.replicated_sharding(mesh)
+        w = np.arange(12, dtype=np.float32).reshape(3, 4)
+        flags = np.array([True, False, True])
+        state = {
+            "w": mesh_lib.host_device_put(w, repl),
+            "flags": mesh_lib.host_device_put(flags, repl),
+            "rng": mesh_lib.host_device_put(jax.random.key(7), repl),
+        }
+        ck.save(%(d)r, 1, state)
+        ck._barrier()  # COMMIT is written by process 0 after save's barrier
+
+        calls = {"n": 0}
+        orig = ck._load_shard
+        def counting(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+        ck._load_shard = counting
+        out = ck.restore(%(d)r, 1, mesh=mesh, target=state)
+        np.testing.assert_array_equal(np.asarray(out["w"]), w)
+        np.testing.assert_array_equal(np.asarray(out["flags"]), flags)
+        assert np.asarray(jax.random.key_data(out["rng"])).tolist() == \\
+            np.asarray(jax.random.key_data(jax.random.key(7))).tolist()
+        if jax.process_index() == 0:
+            assert calls["n"] > 0, "primary must read the checkpoint"
+        else:
+            assert calls["n"] == 0, \\
+                f"non-primary hit storage {calls['n']} times"
+
+        # Device-order robustness: real TPU meshes reorder devices to the
+        # ICI torus, so the broadcast must work when the target mesh's
+        # order differs from jax.devices() order.
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from tpuframe.parallel import collectives
+        rev = Mesh(np.asarray(jax.devices()[::-1]), ("data",))
+        payload = w if jax.process_index() == 0 else np.zeros_like(w)
+        got = collectives.primary_device_put(
+            payload, NamedSharding(rev, P()))
+        np.testing.assert_array_equal(np.asarray(got), w)
+        print("rank", jax.process_index(), "BCAST_OK")
+    """) % {"d": str(tmp_path / "bck")}
+    results = LocalCluster(2, 2, timeout=600).launch(
+        [sys.executable, "-c", script])
+    assert all("BCAST_OK" in r.stdout for r in results)
+
+
+@pytest.mark.slow
 def test_pod_config_multihost_kill_and_reshard_resume(tmp_path):
     """Config 5's actual shape, rehearsed multi-host (SURVEY.md §7 hard
     part 3): ``imagenet_resnet50_pod`` (scaled-down steps/shapes, synthetic

@@ -207,3 +207,40 @@ class TestModelTrainIntegration:
         b1 = jax.tree.leaves(state2.model_state["batch_stats"])
         assert any(not np.allclose(np.asarray(u), np.asarray(v))
                    for u, v in zip(b0, b1))
+
+
+class TestSpaceToDepthStem:
+    def test_exact_equivalence_to_conv_stem(self):
+        """The s2d stem computes the SAME function as the 7x7/stride-2 stem
+        when its kernel is the s2d_stem_kernel rearrangement — the
+        function-preserving claim in models/resnet.py."""
+        from jax import lax
+
+        from tpuframe.models.resnet import s2d_stem_kernel, space_to_depth
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 224, 224, 3)), jnp.float32)
+        w7 = jnp.asarray(rng.normal(size=(7, 7, 3, 16)) * 0.1, jnp.float32)
+
+        ref = lax.conv_general_dilated(
+            x, w7, window_strides=(2, 2), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        got = lax.conv_general_dilated(
+            space_to_depth(x, 2), s2d_stem_kernel(w7), window_strides=(1, 1),
+            padding=((1, 2), (1, 2)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        assert got.shape == ref.shape == (2, 112, 112, 16)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_resnet50_s2d_forward_shape_and_params(self):
+        m_std = ResNet50(num_classes=10)
+        m_s2d = ResNet50(num_classes=10, stem="space_to_depth")
+        x = jnp.zeros((1, 224, 224, 3), jnp.float32)
+        v_std = m_std.init(jax.random.key(0), x)
+        v_s2d = m_s2d.init(jax.random.key(0), x)
+        assert m_s2d.apply(v_s2d, x).shape == (1, 10)
+        # Only the stem kernel differs: 4*4*12 taps (8x8 receptive field,
+        # a superset of the padded 7x7) vs 7*7*3.
+        n = lambda v: sum(a.size for a in jax.tree.leaves(v["params"]))  # noqa: E731
+        assert n(v_s2d) - n(v_std) == (4 * 4 * 12 - 7 * 7 * 3) * 64

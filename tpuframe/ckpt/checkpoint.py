@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import re
 from typing import Any
 
@@ -240,6 +241,42 @@ def restore(directory: str, step: int, *, mesh: Mesh | None = None,
         # non-addressable devices (the restore-on-a-different-host-count
         # path), so all global placement goes through host_device_put.
         from tpuframe.parallel.mesh import host_device_put
+
+        def _use_broadcast(sharding) -> bool:
+            # Fully-replicated leaves on a multi-host run: only the primary
+            # touches storage; bytes fan out over the interconnect
+            # (collectives.primary_device_put) — kills the O(hosts × ckpt
+            # bytes) storage read amplification of everyone re-assembling.
+            # CRC is verified by the one process that reads.
+            return (jax.process_count() > 1
+                    and isinstance(sharding, NamedSharding)
+                    and sharding.is_fully_replicated
+                    and os.environ.get("TPUFRAME_RESTORE_BROADCAST", "1") == "1"
+                    and {d.id for d in sharding.mesh.devices.flat}
+                    == {d.id for d in jax.devices()})
+
+        def _broadcast_restore(sharding):
+            from tpuframe.parallel import collectives
+
+            dtype = np.dtype(entry["dtype"])
+            if jax.process_index() == 0:
+                a = _assemble(path, entry, manifest["crc"], verify_crc,
+                              crc_algo).astype(dtype, copy=False)
+            else:  # placeholder; payload arrives over the fabric
+                a = np.zeros(tuple(entry["shape"]), dtype)
+            data = collectives.primary_device_put(a, sharding)
+            if "prng_impl" in entry:
+                return jax.random.wrap_key_data(data, impl=entry["prng_impl"])
+            return data
+
+        if tgt_sharding is not None and _use_broadcast(tgt_sharding):
+            return _broadcast_restore(tgt_sharding)
+        if tgt_sharding is None and mesh is not None:
+            spec = P(*[tuple(e) if e else None for e in entry["spec"]]) \
+                if entry["spec"] else P()
+            mesh_sharding = NamedSharding(mesh, spec)
+            if _use_broadcast(mesh_sharding):
+                return _broadcast_restore(mesh_sharding)
 
         arr = _assemble(path, entry, manifest["crc"], verify_crc, crc_algo)
         arr = arr.astype(np.dtype(entry["dtype"]), copy=False)

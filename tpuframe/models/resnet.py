@@ -21,6 +21,7 @@ from functools import partial
 from typing import Callable, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 ModuleDef = Callable[..., nn.Module]
@@ -77,6 +78,28 @@ class Bottleneck(nn.Module):
         return nn.relu(residual + y)
 
 
+def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
+    """[B, H, W, C] → [B, H/b, W/b, b*b*C]; channel order (row-off, col-off,
+    C) to match :func:`s2d_stem_kernel`'s weight layout."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, h // block, w // block, block * block * c)
+
+
+def s2d_stem_kernel(w7: jax.Array) -> jax.Array:
+    """Rearrange a [7,7,C,O] stride-2 stem kernel into the equivalent
+    [4,4,4C,O] kernel for the space-to-depth stem (pad to 8×8 at the end,
+    split even/odd taps into the depth dim).  With flax SAME padding on
+    224 input (pad (2,3)) the s2d conv needs padding ((1,2),(1,2)); the two
+    formulations then compute bit-identical outputs (tests/test_models.py)."""
+    c, o = w7.shape[2], w7.shape[3]
+    w8 = jnp.pad(w7, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    #  [8,8,C,O] → [4,p=2,4,q=2,C,O] → [4,4,(p,q,C),O]
+    w8 = w8.reshape(4, 2, 4, 2, c, o).transpose(0, 2, 1, 3, 4, 5)
+    return w8.reshape(4, 4, 4 * c, o)
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
@@ -84,6 +107,11 @@ class ResNet(nn.Module):
     width: int = 64
     cifar_stem: bool = False
     dtype: jnp.dtype = jnp.float32
+    # "conv" = classic 7x7/stride-2; "space_to_depth" = the MXU-friendly
+    # reformulation (4x4/stride-1 on 12-channel 112x112 input — a 3-channel
+    # stride-2 conv wastes the systolic array's reduction dim; this is the
+    # MLPerf-style recipe, exactly function-preserving per s2d_stem_kernel).
+    stem: str = "conv"
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -94,9 +122,16 @@ class ResNet(nn.Module):
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                        param_dtype=jnp.float32)
 
+        if self.stem not in ("conv", "space_to_depth"):
+            raise ValueError(f"unknown stem {self.stem!r}; "
+                             f"expected 'conv' or 'space_to_depth'")
         x = x.astype(self.dtype)
         if self.cifar_stem:
             x = conv(self.width, (3, 3), name="stem_conv")(x)
+        elif self.stem == "space_to_depth":
+            x = space_to_depth(x, 2)
+            x = conv(self.width, (4, 4), padding=((1, 2), (1, 2)),
+                     name="stem_conv")(x)
         else:
             x = conv(self.width, (7, 7), (2, 2), name="stem_conv")(x)
         x = norm(name="stem_bn")(x)
@@ -123,7 +158,8 @@ def ResNet18(num_classes: int = 10, *, cifar_stem: bool = True,
 
 
 def ResNet50(num_classes: int = 1000, *, cifar_stem: bool = False,
-             dtype: jnp.dtype = jnp.float32) -> ResNet:
+             dtype: jnp.dtype = jnp.float32, stem: str = "conv") -> ResNet:
     """Configs 3/5: ResNet-50 v1.5 for ImageNet ([B:9][B:11])."""
     return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck,
-                  num_classes=num_classes, cifar_stem=cifar_stem, dtype=dtype)
+                  num_classes=num_classes, cifar_stem=cifar_stem, dtype=dtype,
+                  stem=stem)

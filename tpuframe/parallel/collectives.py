@@ -21,8 +21,11 @@ from __future__ import annotations
 
 from typing import Any, Sequence
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -237,6 +240,65 @@ def cross_replica_mean(tree: PyTree, mesh: Mesh | None = None) -> PyTree:
         return jnp.mean(garr, axis=0)
 
     return jax.tree.map(_mean, tree)
+
+
+def primary_device_put(x, sharding: NamedSharding) -> jax.Array:
+    """Replicate process-0's host value onto every device, shipping the bytes
+    over the device interconnect (ICI/DCN) instead of having each host supply
+    its own copy.
+
+    The checkpoint-restore counterpart of the reference's rank-0
+    ``torch.load`` + ``hvd.broadcast_parameters`` (SURVEY.md §4.4): the
+    primary host reads from storage once and the fabric fans the data out —
+    storage traffic is O(bytes), not O(hosts × bytes).  Non-primary
+    processes pass a same-shape/dtype placeholder (contents ignored).
+
+    ``sharding`` must be fully replicated over a mesh spanning all devices.
+    Mechanism: one row per device, process-0's first-device row carries the
+    payload and every other row is zero, then an on-device sum over the row
+    axis replicates the payload everywhere (one all-reduce-shaped transfer).
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    if not sharding.is_fully_replicated:
+        raise ValueError("primary_device_put needs a fully-replicated "
+                         f"sharding, got {sharding}")
+    if hasattr(x, "dtype") and jax.dtypes.issubdtype(x.dtype, jax.dtypes.extended):
+        data = primary_device_put(jax.random.key_data(x), sharding)
+        return jax.random.wrap_key_data(data, impl=jax.random.key_impl(x))
+
+    arr = np.asarray(x)
+    as_bool = arr.dtype == np.bool_
+    if as_bool:
+        arr = arr.view(np.uint8)
+    # Row mesh built from the TARGET sharding's own device order — on real
+    # TPU slices jax.make_mesh reorders devices to the ICI torus, so
+    # jax.devices() order and the caller's mesh order differ; deriving both
+    # sides from one order keeps the jit's input and output compatible.
+    devs = list(sharding.mesh.devices.flat)
+    pmesh = Mesh(np.asarray(devs), ("bcast",))
+    rows = NamedSharding(pmesh, P("bcast"))
+    payload_row = min(i for i, d in enumerate(devs) if d.process_index == 0)
+    # One shared zero row (not a local_devices×leaf buffer): host RAM stays
+    # O(leaf), and only the payload row carries real data.
+    zero_row = np.zeros((1, *arr.shape), arr.dtype)
+    pieces = [
+        jax.device_put(arr[None] if i == payload_row else zero_row, d)
+        for i, d in enumerate(devs)
+        if d.process_index == jax.process_index()
+    ]
+    garr = jax.make_array_from_single_device_arrays(
+        (len(devs), *arr.shape), rows, pieces)
+    out = _bcast_sum(sharding)(garr)
+    return out.astype(jnp.bool_) if as_bool else out
+
+
+@functools.lru_cache(maxsize=64)
+def _bcast_sum(sharding: NamedSharding):
+    """One jitted sum-over-rows program per target sharding — restore calls
+    primary_device_put once per leaf; a fresh jit per call would recompile
+    the same trivial program hundreds of times per restart."""
+    return jax.jit(lambda a: a.sum(axis=0), out_shardings=sharding)
 
 
 def host_broadcast(tree: PyTree, mesh: Mesh) -> PyTree:

@@ -1,0 +1,105 @@
+"""Explicit gradient-fusion buffers — the guaranteed HOROVOD_FUSION_THRESHOLD
+mechanism (SURVEY.md §3b, tensor-fusion-buffer row).
+
+Horovod packs many small gradient tensors into one 64–128 MB buffer per
+cycle so each NCCL ring pays its latency once (key for the BERT workload's
+~200 small tensors, SURVEY.md §1 config 4 [B:10]).  Under XLA the same role
+is normally played by the compiler's all-reduce combiner, but that pass is
+backend-internal: the GPU pipeline honors the DebugOptions threshold
+(tpuframe.parallel.tuning maps the env knob onto it), the CPU pipeline does
+not run it at all, and libtpu's combiner is tuned by private flags.  This
+module therefore implements the fusion buffer *in the program itself*, where
+it is visible, testable and backend-independent:
+
+  grads are flattened leaf-by-leaf in deterministic tree order, greedily
+  packed into same-dtype buckets of up to ``threshold_bytes``, each bucket
+  concatenated into one 1-D buffer, ONE ``lax.psum`` issued per bucket, and
+  the results split/reshaped back.
+
+``threshold_bytes <= 0`` disables packing (one collective per leaf — the
+HOROVOD_FUSION_THRESHOLD=0 semantics).  The compiled-HLO effect is directly
+assertable: the all-reduce op count drops from n_leaves to n_buckets
+(tests/test_observability.py).  Semantics are unchanged — psum is linear, so
+psum(concat(gs)) == concat(psum(g) for g in gs) — which the golden-loss test
+asserts against the implicit pmean-of-loss path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+def _leaf_kind(leaf) -> tuple:
+    """Bucket compatibility key: dtype + vma (concat needs both to match)."""
+    ty = jax.typeof(leaf)
+    return (ty.dtype, tuple(sorted(getattr(ty, "vma", ()))))
+
+
+def _bucketize(leaves: Sequence[jax.Array],
+               threshold_bytes: int) -> list[list[int]]:
+    """Greedy same-kind packing in leaf order; returns index buckets."""
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    cur_kind = None
+    for i, leaf in enumerate(leaves):
+        nbytes = leaf.size * leaf.dtype.itemsize
+        if cur and (_leaf_kind(leaf) != cur_kind
+                    or cur_bytes + nbytes > threshold_bytes):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+        cur_kind = _leaf_kind(leaf)
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def fused_psum(tree: PyTree, axes, *, threshold_bytes: int,
+               mean: bool = False) -> PyTree:
+    """Cross-replica sum (or mean) of every leaf with Horovod-style fusion.
+
+    ``axes``: mesh axis name or tuple of names (as for ``lax.psum``); must be
+    bound (inside ``shard_map``).  Leaves are packed into ≤``threshold_bytes``
+    same-dtype buffers, one collective per buffer.  ``threshold_bytes <= 0``
+    → one collective per leaf.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    denom = 1
+    if mean:
+        ax_tuple = (axes,) if isinstance(axes, str) else tuple(axes)
+        for a in ax_tuple:
+            denom *= lax.axis_size(a)
+
+    if threshold_bytes <= 0:
+        out = [lax.psum(l, axes) for l in leaves]
+    else:
+        out = [None] * len(leaves)
+        for bucket in _bucketize(leaves, threshold_bytes):
+            if len(bucket) == 1:
+                i = bucket[0]
+                out[i] = lax.psum(leaves[i], axes)
+                continue
+            flat = jnp.concatenate([leaves[i].reshape(-1) for i in bucket])
+            flat = lax.psum(flat, axes)
+            off = 0
+            for i in bucket:
+                n = leaves[i].size
+                out[i] = flat[off:off + n].reshape(leaves[i].shape)
+                off += n
+    if mean:
+        out = [o / denom for o in out]
+    return jax.tree.unflatten(treedef, out)
+
+
+def fused_pmean(tree: PyTree, axes, *, threshold_bytes: int) -> PyTree:
+    return fused_psum(tree, axes, threshold_bytes=threshold_bytes, mean=True)

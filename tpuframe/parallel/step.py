@@ -61,7 +61,9 @@ class TrainState:
 
 
 def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
-               axes: tuple[str, ...] | None, state: TrainState, batch: PyTree):
+               axes: tuple[str, ...] | None,
+               fusion_threshold: int | None,
+               state: TrainState, batch: PyTree):
     """Shared body for both modes. ``axes`` bound ⇒ explicit collectives."""
     step_rng = jax.random.fold_in(state.rng, state.step)
     if axes:
@@ -77,15 +79,36 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     # exactly Horovod's averaged allreduce).  XLA's all-reduce combiner fuses
     # the per-leaf reductions and the scheduler overlaps them with remaining
     # backward compute (SURVEY.md §3b).
+    #
+    # ``fusion_threshold`` set (TPUFRAME_FUSION_THRESHOLD) selects the
+    # explicit Horovod-parity path instead: params are pcast to per-replica
+    # ("varying") so the backward produces LOCAL gradients with NO implicit
+    # reduction (the transpose of replicated params would otherwise insert
+    # its own psum), and the framework's fusion buffers
+    # (tpuframe.parallel.fusion) perform the only cross-replica averaging —
+    # one psum per ≤threshold-byte bucket, 0 → one per leaf.  Same math
+    # (psum is linear); observable in the compiled HLO's all-reduce count.
+    explicit = bool(axes) and fusion_threshold is not None
+    diff_params = state.params
+    if explicit:
+        diff_params = jax.tree.map(
+            lambda p: lax.pcast(p, axes, to="varying"), state.params)
+
     def global_loss(params, model_state, batch, rng):
         loss, aux = loss_fn(params, model_state, batch, rng)
-        if axes:
+        if axes and not explicit:
             loss = lax.pmean(loss, axes)
         return loss, aux
 
     (loss, (model_state, metrics)), grads = jax.value_and_grad(
-        global_loss, has_aux=True)(state.params, state.model_state, batch, step_rng)
+        global_loss, has_aux=True)(diff_params, state.model_state, batch, step_rng)
 
+    if explicit:
+        from tpuframe.parallel import fusion
+
+        grads = fusion.fused_pmean(grads, axes,
+                                   threshold_bytes=fusion_threshold)
+        loss = lax.pmean(loss, axes)
     if axes:
         metrics = jax.tree.map(lambda m: lax.pmean(m, axes), metrics)
         # BatchNorm running stats: cross-replica averaged so the replicated
@@ -114,8 +137,15 @@ def make_train_step(
     batch_partition: P | None = None,
     reduce_axes: tuple[str, ...] | None = None,
     state_shardings: PyTree | None = None,
+    fusion_threshold: int | None = None,
 ):
     """Build the compiled train step.
+
+    ``fusion_threshold``: byte size of the explicit gradient-fusion buffers
+    (HOROVOD_FUSION_THRESHOLD parity, tpuframe.parallel.fusion); ``None``
+    (default) leaves gradient reduction to the autodiff transpose + XLA's
+    combiner.  Only meaningful in ``shard_map`` mode — auto-SPMD programs
+    have no explicit collectives to pack.
 
     ``batch_partition``/``reduce_axes``: sequence-parallel configs pass
     ``P(('data','fsdp'), 'seq')`` and ``('data','fsdp','seq')`` so batches
@@ -133,7 +163,7 @@ def make_train_step(
     size()==1 no-op mode.
     """
     if mesh is None:
-        body = functools.partial(_grad_step, loss_fn, tx, None)
+        body = functools.partial(_grad_step, loss_fn, tx, None, None)
         return jax.jit(body, donate_argnums=(0,) if donate else ())
 
     # Reduce over every batch-like axis, including size-1 ones: a size-1 pmean
@@ -156,7 +186,7 @@ def make_train_step(
         batch_sh = NamedSharding(any_leaf.mesh, batch_part)
     if mode == "jit":
         # Auto-SPMD: annotate shardings, let the partitioner insert collectives.
-        body = functools.partial(_grad_step, loss_fn, tx, None)
+        body = functools.partial(_grad_step, loss_fn, tx, None, None)
         state_sh = repl if state_shardings is None else state_shardings
         return jax.jit(
             body,
@@ -168,7 +198,7 @@ def make_train_step(
     if mode != "shard_map":
         raise ValueError(f"unknown step mode {mode!r}")
 
-    body = functools.partial(_grad_step, loss_fn, tx, axes)
+    body = functools.partial(_grad_step, loss_fn, tx, axes, fusion_threshold)
     mapped = jax.shard_map(
         body, mesh=mesh,
         in_specs=(P(), batch_part),

@@ -70,3 +70,13 @@ def apply_from_env() -> int | None:
 
 def current() -> int | None:
     return _APPLIED["threshold"]
+
+
+def step_threshold() -> int | None:
+    """The threshold the *train step* should use for explicit program-level
+    fusion buffers (tpuframe.parallel.fusion) — read directly from the env so
+    it works even after backend init (unlike the XLA-flag path above, which
+    is best-effort and backend-dependent).  None → knob unset → leave
+    gradient reduction to the autodiff transpose + XLA combiner."""
+    raw = os.environ.get(ENV_KNOB)
+    return int(raw) if raw else None

@@ -140,9 +140,11 @@ def build_harness(cfg: TrainConfig) -> Harness:
         state = step_lib.replicate_state(state, mesh)
 
     loss_fn = make_loss_fn(cfg, model)
+    from tpuframe.parallel import tuning
     train_step = step_lib.make_train_step(
         loss_fn, tx, mesh, batch_partition=step_part, reduce_axes=reduce_axes,
-        state_shardings=state_shardings)
+        state_shardings=state_shardings,
+        fusion_threshold=tuning.step_threshold())
     eval_step = step_lib.make_eval_step(
         make_metric_fn(cfg, model), mesh, batch_partition=step_part,
         reduce_axes=reduce_axes, state_shardings=state_shardings)
