@@ -1,0 +1,44 @@
+"""Shared setup for the perf/ scripts: repo-root import path, persistent XLA
+compile cache, stderr logging, and chained-async timing."""
+
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def setup():
+    """Import-path + compile-cache config; call before importing tpuframe."""
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".xla_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return jax
+
+
+def make_log(tag: str):
+    def log(m):
+        print(f"[{tag}] {m}", file=sys.stderr, flush=True)
+
+    return log
+
+
+def timeit(fn, *args, steps: int = 10):
+    """Async chained dispatch timing: warm twice, then `steps` dispatches and
+    one final block (each call is independent here, so the block waits for
+    the last dispatched program; see PERF.md §1 for the validation)."""
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps

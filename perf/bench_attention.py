@@ -8,17 +8,13 @@ causal, bf16 — the long-context shape class.  Results go into BASELINE.md.
 """
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import make_log, setup, timeit
 
-import jax
+jax = setup()
 import jax.numpy as jnp
 import numpy as np
-
-jax.config.update("jax_compilation_cache_dir", os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".xla_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 from tpuframe.ops import attention as attn_ops
 from tpuframe.ops.flash_attention import flash_mha
@@ -30,20 +26,7 @@ BATCH = int(os.environ.get("B", "4"))
 STEPS = int(os.environ.get("N", "10"))
 
 
-def log(m):
-    print(f"[attn-bench] {m}", file=sys.stderr, flush=True)
-
-
-def timeit(fn, *args, steps=STEPS):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / steps
+log = make_log("attn-bench")
 
 
 def main():
@@ -70,8 +53,8 @@ def main():
         }
         for name in impls:
             try:
-                t_f = timeit(impls[name], q, k, v)
-                t_fb = timeit(grads[name], q, k, v)
+                t_f = timeit(impls[name], q, k, v, steps=STEPS)
+                t_fb = timeit(grads[name], q, k, v, steps=STEPS)
                 row = {"seq": s, "impl": name,
                        "fwd_ms": round(t_f * 1e3, 2),
                        "fwd_tokens_per_s": round(tokens / t_f),

@@ -12,16 +12,13 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import make_log, setup
 
-import jax
+jax = setup()
 import jax.numpy as jnp
 import numpy as np
 import optax
-
-jax.config.update("jax_compilation_cache_dir", os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".xla_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 from tpuframe.models import losses
 from tpuframe.parallel import step as step_lib
@@ -34,8 +31,7 @@ LM_BATCH = int(os.environ.get("LM_BATCH", "8"))
 LM_SEQ = int(os.environ.get("LM_SEQ", "2048"))
 
 
-def log(m):
-    print(f"[tf-bench] {m}", file=sys.stderr, flush=True)
+log = make_log("tf-bench")
 
 
 def run_chain(step, state, batch, steps=STEPS):

@@ -20,8 +20,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpuframe.parallel import fusion, mesh as mesh_lib, step as step_lib
 
-pytestmark = []
-
 
 def _bucket_sizes(shapes_dtypes, threshold):
     leaves = [jnp.zeros(s, d) for s, d in shapes_dtypes]

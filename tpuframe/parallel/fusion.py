@@ -19,7 +19,7 @@ it is visible, testable and backend-independent:
 ``threshold_bytes <= 0`` disables packing (one collective per leaf — the
 HOROVOD_FUSION_THRESHOLD=0 semantics).  The compiled-HLO effect is directly
 assertable: the all-reduce op count drops from n_leaves to n_buckets
-(tests/test_observability.py).  Semantics are unchanged — psum is linear, so
+(tests/test_fusion.py).  Semantics are unchanged — psum is linear, so
 psum(concat(gs)) == concat(psum(g) for g in gs) — which the golden-loss test
 asserts against the implicit pmean-of-loss path.
 """

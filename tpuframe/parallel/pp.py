@@ -1,0 +1,117 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis — GPipe-style microbatch
+pipelining, SPMD-formulated.
+
+Not a reference capability (SURVEY.md §3c: PP absent); this closes the last
+reserved mesh axis so every axis the framework names is a real strategy.
+
+TPU-native design (no per-stage processes, no send/recv runtime): all
+stages run the SAME compiled program under ``shard_map``; stage s holds its
+slice of the layer-stacked parameters (``P('pipe')`` on the leading stage
+dim), and activations advance one stage per tick through a single
+``lax.ppermute`` inside a ``lax.scan``:
+
+  tick t: every stage applies its layers to the activation it holds, then
+  the ring rotates outputs forward.  Stage s computes microbatch m at tick
+  t = m + s; with M microbatches and S stages the scan runs M + S - 1
+  ticks — the classic GPipe bubble of (S-1)/(M+S-1) idle fraction.
+
+The whole pipeline is one differentiable program: ``ppermute`` transposes
+to the reverse ``ppermute``, ``scan`` transposes to the reverse-order scan,
+so ``jax.grad`` through :func:`pipeline_apply` IS the backward pipeline —
+no hand-written schedule.  XLA overlaps the permute DMAs with stage compute
+(the collective rides ICI between neighbor chips).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = jax.Array | dict | tuple | list
+
+
+def microbatch(x: jax.Array, n_micro: int) -> jax.Array:
+    """[B, ...] → [n_micro, B/n_micro, ...]."""
+    if x.shape[0] % n_micro:
+        raise ValueError(f"batch {x.shape[0]} not divisible by {n_micro}")
+    return x.reshape(n_micro, x.shape[0] // n_micro, *x.shape[1:])
+
+
+def pipeline_apply(
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    stage_params: PyTree,
+    micro_x: jax.Array,
+    *,
+    axis: str = "pipe",
+) -> jax.Array:
+    """Run the microbatched pipeline; call INSIDE ``shard_map``.
+
+    Args:
+      stage_fn: ``(params_for_this_stage, x) -> y`` with ``y.shape ==
+        x.shape`` (equal-width stages — the transformer-block case).
+      stage_params: this stage's parameter slice.  Callers stack per-stage
+        params on a leading dim and pass ``in_specs=P('pipe')`` so shard_map
+        delivers stage s its ``[1, ...]`` slice; ``stage_fn`` receives the
+        slice with that leading 1 intact (squeeze inside if needed).
+      micro_x: ``[n_micro, mb, ...]`` microbatches, replicated over the pipe
+        axis (only stage 0 consumes them; replication keeps the SPMD program
+        identical on every device).
+
+    Returns ``[n_micro, mb, ...]`` outputs, valid on the LAST stage and
+    zeros elsewhere — combine with :func:`last_stage_value` or reduce with a
+    ``where``-gated ``psum`` (see tpuframe.parallel.step's pp loss path).
+    """
+    s = lax.axis_index(axis)
+    n_stages = lax.axis_size(axis)
+    n_micro = micro_x.shape[0]
+    ticks = n_micro + n_stages - 1
+    fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    mb_shape = micro_x.shape[1:]
+    zero = jnp.zeros(mb_shape, micro_x.dtype)
+    # Scan carries must be varying over the pipe axis from the start (each
+    # stage holds different activations after one tick, and scan requires a
+    # stable carry type) plus whatever axes micro_x already varies over.
+    full_vma = dict.fromkeys((*jax.typeof(micro_x).vma, axis))
+
+    def vary(a):
+        need = tuple(n for n in full_vma if n not in jax.typeof(a).vma)
+        return lax.pcast(a, need, to="varying") if need else a
+
+    def tick(carry, t):
+        held, out = carry
+        # Stage 0 ingests microbatch t (zeros once the feed is exhausted);
+        # everyone else works on what the ring delivered last tick.
+        feed = lax.dynamic_index_in_dim(
+            micro_x, jnp.minimum(t, n_micro - 1), keepdims=False)
+        feed = jnp.where(t < n_micro, feed, zero)
+        x = jnp.where(s == 0, feed, held)
+        y = stage_fn(stage_params, x)
+        # Micro index this stage just finished: m = t - s (valid window
+        # 0 <= m < n_micro; the bubble ticks compute on zeros and are
+        # discarded by the where below).
+        m = t - s
+        valid = jnp.logical_and(m >= 0, m < n_micro)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(valid, y, lax.dynamic_index_in_dim(
+                out, jnp.clip(m, 0, n_micro - 1), keepdims=False)),
+            jnp.clip(m, 0, n_micro - 1), axis=0)
+        held = lax.ppermute(y, axis, fwd)
+        return (held, out), None
+
+    out0 = vary(jnp.zeros_like(micro_x))
+    (_, out), _ = lax.scan(tick, (vary(zero), out0), jnp.arange(ticks))
+    return out
+
+
+def last_stage_value(value: jax.Array, *, axis: str = "pipe") -> jax.Array:
+    """Replicate the last pipeline stage's ``value`` to every stage (the
+    pipeline's outputs live on stage S-1; losses/metrics need them
+    everywhere).  select + psum — XLA lowers it to a broadcast from root."""
+    s = lax.axis_index(axis)
+    n_stages = lax.axis_size(axis)
+    masked = jnp.where(s == n_stages - 1, value, jnp.zeros_like(value))
+    return lax.psum(masked, axis)
