@@ -175,3 +175,37 @@ def test_env_knob_reaches_step_threshold(monkeypatch):
     assert tuning.step_threshold() == 32 << 20
     monkeypatch.delenv(tuning.ENV_KNOB)
     assert tuning.step_threshold() is None
+
+
+def test_hvd_average_gradients_honors_fusion_knob(mesh8, monkeypatch):
+    """The hvd facade's DistributedOptimizer routes through
+    collectives.average_gradients; with TPUFRAME_FUSION_THRESHOLD set the
+    varying leaves must reduce through the packed buffers with identical
+    values to the per-leaf path."""
+    from tpuframe.parallel import collectives, tuning
+
+    tree = {
+        "a": jnp.arange(24, dtype=jnp.float32).reshape(2, 12),
+        "b": jnp.full((5,), 3.0, jnp.float32),
+        "c": jnp.full((3, 2), 2.0, jnp.float32),
+    }
+
+    def body(x):
+        # pvary so leaves are genuinely per-replica (the hand-built-grads
+        # case in average_gradients' contract).
+        x = jax.tree.map(
+            lambda l: lax.pcast(l, ("data",), to="varying"), x)
+        return collectives.average_gradients(x, axis="data")
+
+    monkeypatch.delenv(tuning.ENV_KNOB, raising=False)
+    run = jax.jit(jax.shard_map(body, mesh=mesh8, in_specs=P(),
+                                out_specs=P()))
+    ref = run(tree)  # knob unset: per-leaf pmean
+
+    monkeypatch.setenv(tuning.ENV_KNOB, str(1 << 20))
+    run2 = jax.jit(jax.shard_map(body, mesh=mesh8, in_specs=P(),
+                                 out_specs=P()))
+    got = run2(tree)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(ref[k]))

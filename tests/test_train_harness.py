@@ -22,6 +22,23 @@ class TestConfigs:
         with pytest.raises(ValueError):
             cfg.with_overrides(nonsense=1)
 
+    def test_kwargs_overrides_merge_not_replace(self):
+        # `--set model_kwargs={"moe_experts": 4}` on a tiny config must keep
+        # the config's own kwargs (dropping them silently rebuilds the model
+        # at full default size — a 219M-param lm_smoke).
+        cfg = get_config("lm_smoke").with_overrides(
+            model_kwargs={"moe_experts": 4})
+        assert cfg.model_kwargs["moe_experts"] == 4
+        assert cfg.model_kwargs["tiny"] is True  # preserved
+        assert cfg.dataset_kwargs["seq_len"] == 64  # untouched field
+        # per-key override still wins
+        cfg2 = cfg.with_overrides(model_kwargs={"tiny": False})
+        assert cfg2.model_kwargs["tiny"] is False
+        assert cfg2.model_kwargs["moe_experts"] == 4
+        # None deletes a key — the replace escape hatch
+        cfg3 = cfg2.with_overrides(model_kwargs={"seq_mode": None})
+        assert "seq_mode" not in cfg3.model_kwargs
+
 
 class TestEndToEnd:
     def test_smoke_converges_single_process(self, tmp_path):

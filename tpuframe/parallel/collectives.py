@@ -103,7 +103,7 @@ def average_gradients(grads: PyTree, axis: AxisName = "data") -> PyTree:
             size_presummed *= lax.axis_size(name)
         return out / size_presummed if size_presummed > 1 else out
 
-    return jax.tree.map(_avg, grads)
+    return _maybe_fused_reduce(grads, names, _avg, mean=True)
 
 
 def sum_gradients(grads: PyTree, axis: AxisName = "data") -> PyTree:
@@ -119,7 +119,33 @@ def sum_gradients(grads: PyTree, axis: AxisName = "data") -> PyTree:
         varying = [a for a in names if a in vma]
         return lax.psum(g, varying) if varying else g
 
-    return jax.tree.map(_sum, grads)
+    return _maybe_fused_reduce(grads, names, _sum, mean=False)
+
+
+def _maybe_fused_reduce(grads: PyTree, names, per_leaf, *, mean: bool) -> PyTree:
+    """Knob routing shared by average_/sum_gradients: with
+    TPUFRAME_FUSION_THRESHOLD set, fully-varying leaves reduce through the
+    packed fusion buffers (tpuframe.parallel.fusion) so the hvd facade's
+    DistributedOptimizer has the same knob semantics as the step builder;
+    mixed/presummed leaves (and the knob-unset default) keep the per-leaf
+    vma-aware path."""
+    from tpuframe.parallel import tuning
+
+    threshold = tuning.step_threshold()
+    if not threshold or threshold <= 0:
+        return jax.tree.map(per_leaf, grads)
+    from tpuframe.parallel import fusion
+
+    leaves, treedef = jax.tree.flatten(grads)
+    fused_idx = [i for i, g in enumerate(leaves)
+                 if all(a in jax.typeof(g).vma for a in names)]
+    out = {i: per_leaf(leaves[i])
+           for i in set(range(len(leaves))) - set(fused_idx)}
+    if fused_idx:
+        reduced = fusion.fused_psum([leaves[i] for i in fused_idx], names,
+                                    threshold_bytes=threshold, mean=mean)
+        out.update(dict(zip(fused_idx, reduced)))
+    return jax.tree.unflatten(treedef, [out[i] for i in range(len(leaves))])
 
 
 def allgather(x: jax.Array, axis: AxisName = "data", *, tiled: bool = True) -> jax.Array:

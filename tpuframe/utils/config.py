@@ -70,6 +70,18 @@ class TrainConfig:
             raise ValueError(f"unknown config fields {sorted(bad)}")
         if "mesh" in kv and isinstance(kv["mesh"], dict):
             kv["mesh"] = MeshSpec(**kv["mesh"])
+        # Dict-valued fields MERGE instead of replace: `--set
+        # model_kwargs={"moe_experts": 4}` on a tiny config must not
+        # silently rebuild the model at full default size by dropping the
+        # config's own kwargs.  A None value DELETES that key, so
+        # `--set 'model_kwargs={"seq_mode": None}'` removes a base-config
+        # entry (the replace escape hatch).
+        for field_name in ("model_kwargs", "dataset_kwargs"):
+            if field_name in kv and isinstance(kv[field_name], dict):
+                merged = dict(getattr(self, field_name))
+                merged.update(kv[field_name])
+                kv[field_name] = {k: v for k, v in merged.items()
+                                  if v is not None}
         return dataclasses.replace(self, **kv)
 
 
