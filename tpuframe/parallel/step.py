@@ -63,6 +63,7 @@ class TrainState:
 def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
                axes: tuple[str, ...] | None,
                fusion_threshold: int | None,
+               accum_steps: int,
                state: TrainState, batch: PyTree):
     """Shared body for both modes. ``axes`` bound ⇒ explicit collectives."""
     step_rng = jax.random.fold_in(state.rng, state.step)
@@ -70,6 +71,10 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
         # Decorrelate per-replica dropout while keeping params in lockstep.
         for ax in axes:
             step_rng = jax.random.fold_in(step_rng, lax.axis_index(ax))
+
+    if accum_steps > 1:
+        return _accum_grad_step(loss_fn, tx, axes, fusion_threshold,
+                                accum_steps, state, batch, step_rng)
 
     # The reference's raison d'être: synchronous gradient averaging.
     # Horovod: per-tensor async NCCL ring-allreduce with fusion buffer.
@@ -103,11 +108,26 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     (loss, (model_state, metrics)), grads = jax.value_and_grad(
         global_loss, has_aux=True)(diff_params, state.model_state, batch, step_rng)
 
-    if explicit:
-        from tpuframe.parallel import fusion
+    return _reduce_and_apply(tx, axes, fusion_threshold, state,
+                             grads, loss, metrics, model_state,
+                             reduce_grads=explicit)
 
-        grads = fusion.fused_pmean(grads, axes,
-                                   threshold_bytes=fusion_threshold)
+
+def _reduce_and_apply(tx, axes, fusion_threshold, state, grads, loss,
+                      metrics, model_state, *, reduce_grads: bool):
+    """Shared step tail: cross-replica reductions + optimizer update.
+
+    ``reduce_grads``: True when ``grads``/``loss`` are still per-replica
+    (explicit-fusion and accumulation paths); False when the pmean-of-loss
+    transpose already reduced them (the implicit default)."""
+    if reduce_grads and axes:
+        if fusion_threshold is not None:
+            from tpuframe.parallel import fusion
+
+            grads = fusion.fused_pmean(grads, axes,
+                                       threshold_bytes=fusion_threshold)
+        else:
+            grads = jax.tree.map(lambda g: lax.pmean(g, axes), grads)
         loss = lax.pmean(loss, axes)
     if axes:
         metrics = jax.tree.map(lambda m: lax.pmean(m, axes), metrics)
@@ -116,6 +136,9 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
         # checkpointed rank 0's — averaging is the SPMD-correct equivalent).
         model_state = jax.tree.map(lambda s: lax.pmean(s, axes), model_state)
 
+    # No-op for same-dtype grads; the accumulation path accumulates in f32
+    # and casts back to the param dtype here.
+    grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, state.params)
     updates, opt_state = tx.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
     metrics = dict(metrics)
@@ -125,6 +148,76 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
                            opt_state=opt_state, model_state=model_state,
                            rng=state.rng)
     return new_state, metrics
+
+
+def _accum_grad_step(loss_fn, tx, axes, fusion_threshold, accum_steps,
+                     state, batch, step_rng):
+    """Gradient accumulation — Horovod's ``backward_passes_per_step``
+    (DistributedOptimizer option; the reference's recipe for batches that
+    exceed device memory).  The local batch is split into ``accum_steps``
+    microbatches, a ``lax.scan`` runs fwd+bwd per microbatch accumulating
+    f32 gradients and threading mutable model state (BN stats update
+    sequentially, matching N torch backward passes), and ONE optimizer
+    update + ONE cross-replica reduction happens at the end — collectives
+    per step stay constant as accum grows, exactly Horovod's semantics."""
+    for leaf in jax.tree.leaves(batch):
+        if leaf.shape[0] % accum_steps:
+            raise ValueError(
+                f"accum_steps={accum_steps} does not divide the per-device "
+                f"batch {leaf.shape[0]} (leaf shape {leaf.shape}); choose a "
+                f"global batch divisible by devices x accum_steps")
+    micro = jax.tree.map(
+        lambda a: a.reshape(accum_steps, a.shape[0] // accum_steps,
+                            *a.shape[1:]), batch)
+
+    # Differentiate w.r.t. per-replica ("varying") copies of the params:
+    # grads then stay LOCAL through the whole scan — zero collectives per
+    # microbatch — and the single reduction below is the step's only one
+    # (Horovod's backward_passes_per_step wire behavior).  Grads of
+    # replicated params would instead be psum'd inside every scan
+    # iteration by the autodiff transpose.
+    def vary(t):
+        if not axes:
+            return t
+        return jax.tree.map(
+            lambda a: a if all(x in jax.typeof(a).vma for x in axes)
+            else lax.pcast(a, tuple(x for x in axes
+                                    if x not in jax.typeof(a).vma),
+                           to="varying"), t)
+
+    diff_params = vary(state.params)
+
+    def one_micro(carry, xs):
+        mb_i, i = xs
+        model_state, g_acc, loss_acc, metrics_acc = carry
+        rng_i = jax.random.fold_in(step_rng, i)
+        (loss, (model_state, metrics)), g = jax.value_and_grad(
+            loss_fn, has_aux=True)(diff_params, model_state, mb_i, rng_i)
+        g_acc = jax.tree.map(
+            lambda a, b: a + b.astype(jnp.float32), g_acc, g)
+        metrics_acc = jax.tree.map(jnp.add, metrics_acc,
+                                   jax.tree.map(jnp.asarray, dict(metrics)))
+        return (model_state, g_acc, loss_acc + loss, metrics_acc), None
+
+    zeros_like_f32 = vary(jax.tree.map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), state.params))
+    mb0 = jax.tree.map(lambda a: a[0], micro)
+    _, (_, metrics0) = jax.eval_shape(
+        lambda: loss_fn(state.params, state.model_state, mb0, step_rng))
+    metrics_zero = vary(jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), dict(metrics0)))
+    (model_state, grads, loss, metrics), _ = lax.scan(
+        one_micro,
+        (vary(state.model_state), zeros_like_f32,
+         vary(jnp.zeros((), jnp.float32)), metrics_zero),
+        (micro, jnp.arange(accum_steps)))
+    grads = jax.tree.map(lambda g: g / accum_steps, grads)
+    loss = loss / accum_steps
+    metrics = jax.tree.map(lambda m: m / accum_steps, metrics)
+
+    return _reduce_and_apply(tx, axes, fusion_threshold, state,
+                             grads, loss, metrics, model_state,
+                             reduce_grads=True)
 
 
 def make_train_step(
@@ -138,6 +231,7 @@ def make_train_step(
     reduce_axes: tuple[str, ...] | None = None,
     state_shardings: PyTree | None = None,
     fusion_threshold: int | None = None,
+    accum_steps: int = 1,
 ):
     """Build the compiled train step.
 
@@ -146,6 +240,15 @@ def make_train_step(
     (default) leaves gradient reduction to the autodiff transpose + XLA's
     combiner.  Only meaningful in ``shard_map`` mode — auto-SPMD programs
     have no explicit collectives to pack.
+
+    ``accum_steps``: gradient accumulation (Horovod's
+    ``backward_passes_per_step``): the per-device batch is split into this
+    many microbatches scanned sequentially, f32 grad accumulation, one
+    optimizer update and one cross-replica reduction per step.  NOTE the
+    batching direction differs from Horovod: Horovod aggregates N loader
+    batches (effective batch grows Nx); here the configured batch is SPLIT
+    (effective batch unchanged, per-pass memory shrinks Nx) — to port a
+    Horovod recipe, multiply global_batch by N as well.
 
     ``batch_partition``/``reduce_axes``: sequence-parallel configs pass
     ``P(('data','fsdp'), 'seq')`` and ``('data','fsdp','seq')`` so batches
@@ -162,8 +265,11 @@ def make_train_step(
     body, no collectives — the property the reference gets from Horovod's
     size()==1 no-op mode.
     """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if mesh is None:
-        body = functools.partial(_grad_step, loss_fn, tx, None, None)
+        body = functools.partial(_grad_step, loss_fn, tx, None, None,
+                                 accum_steps)
         return jax.jit(body, donate_argnums=(0,) if donate else ())
 
     # Reduce over every batch-like axis, including size-1 ones: a size-1 pmean
@@ -186,7 +292,8 @@ def make_train_step(
         batch_sh = NamedSharding(any_leaf.mesh, batch_part)
     if mode == "jit":
         # Auto-SPMD: annotate shardings, let the partitioner insert collectives.
-        body = functools.partial(_grad_step, loss_fn, tx, None, None)
+        body = functools.partial(_grad_step, loss_fn, tx, None, None,
+                                 accum_steps)
         state_sh = repl if state_shardings is None else state_shardings
         return jax.jit(
             body,
@@ -198,7 +305,8 @@ def make_train_step(
     if mode != "shard_map":
         raise ValueError(f"unknown step mode {mode!r}")
 
-    body = functools.partial(_grad_step, loss_fn, tx, axes, fusion_threshold)
+    body = functools.partial(_grad_step, loss_fn, tx, axes, fusion_threshold,
+                             accum_steps)
     mapped = jax.shard_map(
         body, mesh=mesh,
         in_specs=(P(), batch_part),

@@ -144,7 +144,8 @@ def build_harness(cfg: TrainConfig) -> Harness:
     train_step = step_lib.make_train_step(
         loss_fn, tx, mesh, batch_partition=step_part, reduce_axes=reduce_axes,
         state_shardings=state_shardings,
-        fusion_threshold=tuning.step_threshold())
+        fusion_threshold=tuning.step_threshold(),
+        accum_steps=cfg.accum_steps)
     eval_step = step_lib.make_eval_step(
         make_metric_fn(cfg, model), mesh, batch_partition=step_part,
         reduce_axes=reduce_axes, state_shardings=state_shardings)

@@ -46,6 +46,9 @@ class TrainConfig:
     # loop
     global_batch: int = 64
     total_steps: int = 200
+    # Gradient accumulation (Horovod's backward_passes_per_step): microbatch
+    # count per optimizer step; global_batch is split by this on-device.
+    accum_steps: int = 1
     eval_every: int = 100
     eval_batches: int = 8
     log_every: int = 10
