@@ -1,0 +1,148 @@
+"""int8-quantized gradient reduction (collectives.quantized_mean +
+hvd.DistributedOptimizer(compression="int8")) — the EQuARX-style wire
+option (SURVEY.md §3b ring-allreduce row; PAPERS.md:7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpuframe.parallel import collectives, hvd, mesh as mesh_lib
+
+
+def _per_replica(mesh, fn, tree):
+    def body(t):
+        t = jax.tree.map(
+            lambda l: l * (1.0 + lax.axis_index("data").astype(jnp.float32)),
+            jax.tree.map(lambda l: lax.pcast(l, ("data",), to="varying"), t))
+        return fn(t)
+
+    return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(),
+                                 out_specs=P()))(tree)
+
+
+def test_quantized_mean_error_bound(mesh8):
+    rng = np.random.default_rng(0)
+    tree = {"w": jnp.asarray(rng.normal(size=(32, 16)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(16,)), jnp.float32)}
+
+    exact = _per_replica(
+        mesh8, lambda t: collectives.average_gradients(t, axis="data"), tree)
+    quant = _per_replica(
+        mesh8, lambda t: collectives.quantized_mean(t, axis="data"), tree)
+
+    for k in tree:
+        # replica r contributes g*(1+r); worst contribution magnitude 8|g|;
+        # shared scale s = max|contribution|/127, per-contribution error
+        # <= s/2, so |mean err| <= 8*max|g|/254 — the quantizer's hard
+        # bound (error is ABSOLUTE / scale-proportional, so no rtol check).
+        bound = 8 * float(jnp.max(jnp.abs(tree[k]))) / 254 + 1e-6
+        err = np.max(np.abs(np.asarray(quant[k]) - np.asarray(exact[k])))
+        assert err <= bound, (k, err, bound)
+        # direction preserved: gradients still point the same way
+        e, q = np.asarray(exact[k]).ravel(), np.asarray(quant[k]).ravel()
+        cos = float(e @ q / (np.linalg.norm(e) * np.linalg.norm(q)))
+        assert cos > 0.999, (k, cos)
+
+
+def test_quantized_mean_zero_and_sign(mesh8):
+    tree = {"z": jnp.zeros((8,), jnp.float32),
+            "s": jnp.asarray([-1.0, 1.0, -0.5, 0.5], jnp.float32)}
+    out = _per_replica(
+        mesh8, lambda t: collectives.quantized_mean(t, axis="data"), tree)
+    np.testing.assert_array_equal(np.asarray(out["z"]), np.zeros(8))
+    assert np.all(np.sign(np.asarray(out["s"]))
+                  == np.sign(np.asarray(tree["s"])))
+
+
+def test_quantized_mean_narrow_int_on_the_wire(mesh8):
+    """The compiled program must actually all-reduce int16 — the wire
+    compression claim, asserted in HLO."""
+    x = {"g": jnp.ones((64, 64), jnp.float32)}
+
+    def body(t):
+        t = jax.tree.map(
+            lambda l: lax.pcast(l, ("data",), to="varying"), t)
+        return collectives.quantized_mean(t, axis="data")
+
+    txt = jax.jit(jax.shard_map(
+        body, mesh=mesh8, in_specs=P(), out_specs=P())).lower(x).compile(
+        ).as_text()
+    assert any("all-reduce" in line and "s16[64,64]" in line
+               for line in txt.splitlines()), "no int16 all-reduce in HLO"
+
+
+def test_distributed_optimizer_int8_trains(mesh8):
+    import optax
+
+    from tpuframe.parallel import step as step_lib
+
+    rng = np.random.default_rng(1)
+    params = {"w": jnp.asarray(rng.normal(size=(16, 16)) * 0.3, jnp.float32)}
+    x = rng.normal(size=(16, 16)).astype(np.float32)
+    t = np.tanh(rng.normal(size=(16, 16))).astype(np.float32)
+    tx = hvd.DistributedOptimizer(optax.sgd(0.2), compression="int8")
+
+    def loss_fn(p, ms, b, r):
+        return jnp.mean((jnp.tanh(b["x"] @ p["w"]) - b["t"]) ** 2), ({}, {})
+
+    # hvd-style manual step: per-replica local grads (pcast-varying params),
+    # DistributedOptimizer's quantized mean is the only reduction.
+    def body(p, opt, b):
+        g = jax.grad(lambda p: loss_fn(
+            jax.tree.map(lambda a: lax.pcast(a, ("data",), to="varying"), p),
+            {}, b, None)[0])(p)
+        up, opt = tx.update(g, opt, p)
+        return jax.tree.map(lambda a, u: a + u, p, up), opt
+
+    mapped = jax.jit(jax.shard_map(
+        body, mesh=mesh8,
+        in_specs=(P(), P(), P(("data", "fsdp"))),
+        out_specs=(P(), P())))
+    batch = jax.tree.map(
+        lambda a: jax.device_put(a, mesh_lib.batch_sharding(mesh8)),
+        {"x": x, "t": t})
+    opt = tx.init(params)
+    losses = []
+    p = params
+    for _ in range(40):
+        loss = float(jnp.mean(
+            (jnp.tanh(jnp.asarray(x) @ p["w"]) - jnp.asarray(t)) ** 2))
+        losses.append(loss)
+        p, opt = mapped(p, opt, batch)
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    assert all(b <= a + 1e-4 for a, b in zip(losses, losses[1:]))  # monotone
+
+
+def test_int8_requires_average():
+    import optax
+
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1), compression="int8",
+                                  average=False)
+    with pytest.raises(ValueError, match="int8"):
+        tx.update({"w": jnp.ones(3)}, tx.init({"w": jnp.ones(3)}))
+
+
+def test_quantized_mean_mixed_vma_divides_presummed_axes():
+    """A leaf varying on 'data' but presummed over 'fsdp' must be divided
+    by BOTH axis sizes (average_gradients semantics) — switching
+    compression=None to "int8" must not change effective LR."""
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=4, fsdp=2))
+    g = jnp.full((8,), 4.0, jnp.float32)
+
+    def body(t):
+        t = jax.tree.map(
+            lambda l: lax.pcast(l, ("data",), to="varying"), t)
+        exact = collectives.average_gradients(t, axis=("data", "fsdp"))
+        quant = collectives.quantized_mean(t, axis=("data", "fsdp"))
+        return exact, quant
+
+    exact, quant = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=P(), out_specs=P()))({"g": g})
+    np.testing.assert_allclose(np.asarray(quant["g"]),
+                               np.asarray(exact["g"]), atol=0.05)
+    # value check: identical contributions of 4.0, mean over data=4 then
+    # /fsdp=2 presummed divisor -> 2.0
+    np.testing.assert_allclose(np.asarray(exact["g"]), 2.0)

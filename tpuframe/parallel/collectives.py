@@ -327,6 +327,63 @@ def _bcast_sum(sharding: NamedSharding):
     return jax.jit(lambda a: a.sum(axis=0), out_shardings=sharding)
 
 
+def quantized_mean(tree: PyTree, axis: AxisName = "data") -> PyTree:
+    """Cross-replica gradient mean with quantized wire traffic — the
+    EQuARX-style option for the ring-allreduce row (SURVEY.md §3b;
+    PAPERS.md:7).
+
+    XLA owns the ring's internals, so per-hop requantization is not
+    reachable from program level; the reachable sound formulation is a
+    shared-scale integer allreduce:
+
+      1. ``s = pmax(max|g|) / 127`` — one scalar f32 collective;
+      2. ``q = round(g / s)`` symmetric int8 per replica (local);
+      3. ``psum(q)`` accumulated in **int16** — 2 bytes per element on the
+         wire vs 4 for f32 (the ring's ~2x traffic factor applies to both
+         dtypes and cancels): 2x compression; int16 holds 127 x N exactly
+         for N <= 258 replicas (int32 beyond, parity with f32 bytes);
+      4. dequantize ``sum * s / N`` locally, cast back.
+
+    psum keeps the result invariant over the reduced axes (an all_gather
+    formulation would leave it vma-varying and unusable for replicated
+    params).  Error: one shared-scale quantization step per contribution,
+    |mean err| <= s/2 = global max|g| / 254 (pinned by test).  Presummed
+    (unvarying) leaves pass through like ``average_gradients``'s.
+    """
+    names = _bound_axes(axis)
+    if not names:
+        return tree
+
+    def _qmean(g):
+        vma = jax.typeof(g).vma
+        varying = tuple(a for a in names if a in vma)
+        if not varying:
+            size = 1
+            for n in names:
+                size *= lax.axis_size(n)
+            return g / size if size > 1 else g
+        n_total = 1
+        for a in varying:
+            n_total *= lax.axis_size(a)
+        # Bound-but-unvarying axes arrive presummed (average_gradients'
+        # contract): divide by their size too so the result is the mean
+        # over ALL bound axes regardless of each leaf's arrival state.
+        size_presummed = 1
+        for a in names:
+            if a not in vma:
+                size_presummed *= lax.axis_size(a)
+        acc_dtype = jnp.int16 if n_total <= 258 else jnp.int32
+        gf = g.astype(jnp.float32)
+        scale = lax.pmax(jnp.max(jnp.abs(gf)), varying) / 127.0
+        safe = jnp.where(scale == 0.0, 1.0, scale)
+        q = jnp.clip(jnp.round(gf / safe), -127, 127).astype(acc_dtype)
+        total = lax.psum(q, varying)            # narrow-int wire
+        return (total.astype(jnp.float32) * safe
+                / (n_total * size_presummed)).astype(g.dtype)
+
+    return jax.tree.map(_qmean, tree)
+
+
 def host_broadcast(tree: PyTree, mesh: Mesh) -> PyTree:
     """Replicate host-0-computed values onto every device of the mesh
     (reference parity: ``hvd.broadcast_parameters`` from rank 0 at start,

@@ -112,16 +112,28 @@ def DistributedOptimizer(
     program: the ``pmean`` below is scheduled/overlapped with backward compute
     by the compiler, which is the same overlap Horovod implements by hand.
 
-    ``compression``: None or "bf16", mirroring Horovod's fp16 gradient
-    compression option — gradients are cast down for the wire and restored
-    after reduction (EQuARX-style quantized allreduce is the further step,
-    PAPERS.md:7).
+    ``compression``: None, "bf16" or "int8".  "bf16" mirrors Horovod's fp16
+    gradient compression (cast down for the wire, restored after
+    reduction); "int8" is the EQuARX-style further step (PAPERS.md:7) —
+    shared-scale int8 quantization summed in int16 on the wire
+    (collectives.quantized_mean; requires ``average=True``).
     """
 
     def init_fn(params):
         return _DistState(inner=tx.init(params))
 
     def update_fn(grads, state, params=None, **extra):
+        if compression == "int8":
+            # Quantized wire path (EQuARX-style): shared-scale int8
+            # quantization psum'd in int16 (collectives.quantized_mean) —
+            # structurally different from the cast-reduce-cast flow, so it
+            # replaces the reduction outright.
+            if not average:
+                raise ValueError("compression='int8' implements a quantized "
+                                 "mean; use average=True")
+            grads = collectives.quantized_mean(grads, axis=axis)
+            updates, inner = tx.update(grads, state.inner, params, **extra)
+            return updates, _DistState(inner=inner)
         grads, orig_dtypes = _maybe_compress(grads, compression)
         # vma-aware: reduces varying leaves, passes through already-psum'd
         # ones (gradients of replicated params arrive pre-summed under jax's
