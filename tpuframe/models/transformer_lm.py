@@ -179,6 +179,87 @@ class Block(nn.Module):
         return x + h
 
 
+class ScanBlockLM(nn.Module):
+    """TransformerLM variant with the block stack as ONE ``nn.scan`` — the
+    layer-stacked parameterization pipeline parallelism shards.
+
+    Params: ``blocks`` holds every Block's weights stacked on a leading
+    layer dim ``[L, ...]`` (also O(1) compile time in depth — the scan-over-
+    layers idiom).  Three apply modes through the one compact method:
+
+      * default: full forward — embed → scan(L blocks) → final_ln → head;
+      * ``stage=True``: ONLY the block stack, with however many layers the
+        passed ``blocks`` param slice carries (shard_map slices the leading
+        dim over ``pipe``, so each stage runs its own L/S contiguous
+        layers) — the ``stage_fn`` for tpuframe.parallel.pp.pipeline_apply;
+      * ``embed_only=True`` / ``head_only=True``: the replicated ends,
+        computed on every stage (cheap vs the blocks; keeps the SPMD
+        program identical everywhere).
+
+    MoE and sequence-parallel attention are not composed with this variant
+    (``seq_mode="none"``, ``moe_experts=0`` enforced); use TransformerLM
+    for those.
+    """
+
+    cfg: LMConfig = field(default_factory=LMConfig)
+
+    @nn.compact
+    def __call__(self, inputs, *, train: bool = False, stage: bool = False,
+                 stage_layers: int | None = None,
+                 embed_only: bool = False, head_only: bool = False):
+        c = self.cfg
+        if c.seq_mode != "none" or c.moe_experts > 0:
+            raise ValueError("ScanBlockLM composes with pipeline parallelism"
+                             " only; seq_mode must be 'none' and moe off")
+
+        def block_stack(x, n_layers):
+            positions = jnp.arange(x.shape[1])
+            target = nn.remat(_ScanBlock) if c.remat else _ScanBlock
+            Scanned = nn.scan(
+                target,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=n_layers,
+            )
+            (x, _), _ = Scanned(c, train, name="blocks")((x, positions), None)
+            return x
+
+        if stage:
+            # inputs: hidden states [B, S, H]; the caller says how many of
+            # the stacked layers its ``blocks`` param slice carries.
+            if stage_layers is None:
+                raise ValueError("stage=True requires stage_layers")
+            return block_stack(inputs, stage_layers)
+        if head_only:
+            x = nn.LayerNorm(use_bias=False, name="final_ln")(inputs)
+            logits = nn.Dense(c.vocab_size, use_bias=False, name="lm_head")(x)
+            return logits.astype(jnp.float32)
+
+        x = nn.Embed(c.vocab_size, c.hidden_size, name="embed")(inputs)
+        x = x.astype(c.jnp_dtype)
+        if embed_only:
+            return x
+        x = block_stack(x, c.num_layers)
+        x = nn.LayerNorm(use_bias=False, name="final_ln")(x)
+        logits = nn.Dense(c.vocab_size, use_bias=False, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+class _ScanBlock(nn.Module):
+    """``Block`` wrapped for ``nn.scan``: carry = (hidden, positions).
+    Delegates to the one Block implementation so the dense architecture
+    cannot drift between the looped and the scanned/pipelined variants."""
+
+    cfg: LMConfig
+    train: bool = False
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, positions = carry
+        y = Block(self.cfg, self.train, name="block")(x, positions)
+        return (y, positions), None
+
+
 class TransformerLM(nn.Module):
     """input_ids [B, S_local] → logits [B, S_local, V] (f32)."""
 

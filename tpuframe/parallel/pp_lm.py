@@ -1,0 +1,119 @@
+"""Pipeline-parallel training step for the ScanBlockLM — the model-level
+integration of tpuframe.parallel.pp (GPipe over the ``pipe`` mesh axis).
+
+Layout: the model's layer-stacked ``blocks`` params (and their optimizer
+state) shard their leading layer dim over ``pipe`` — S stages each own
+``num_layers / S`` contiguous layers — while the embedding/head stay
+replicated and are computed on every stage (cheap relative to the blocks;
+keeps the SPMD program identical everywhere, and the ``where``-gating in
+pipeline_apply routes embed cotangents to stage 0 only).  Data parallelism
+composes on the ``data`` axis: the batch shards over it, gradients arrive
+data-presummed from the pmean-of-loss transpose.
+
+Constraints (documented, asserted): ``num_layers % pp_stages == 0``; the
+optimizer must not couple parameters across leaves with global statistics
+(per-leaf transforms like adam/adamw/sgd are fine; a global-norm clip would
+need an extra cross-stage psum).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuframe.models import losses
+from tpuframe.parallel import mesh as mesh_lib, pp
+from tpuframe.parallel.step import TrainState
+
+
+def state_partition(state: TrainState) -> TrainState:
+    """PartitionSpec tree over a ScanBlockLM TrainState: every leaf whose
+    tree path passes through ``blocks`` shards its leading (layer) dim over
+    ``pipe``; everything else is replicated."""
+
+    def spec_for(path, leaf) -> P:
+        in_blocks = any(getattr(k, "key", getattr(k, "name", None)) == "blocks"
+                        for k in path)
+        return P("pipe") if in_blocks else P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, state)
+
+
+def make_pp_lm_step(model, tx: optax.GradientTransformation, mesh: Mesh, *,
+                    n_micro: int):
+    """Compiled train step: ScanBlockLM forward through the microbatch
+    pipeline, CE loss, one optimizer update.  Returns ``(step_fn,
+    place_state, place_batch)`` where the placers put a host-built
+    TrainState / batch onto the mesh with the pp shardings."""
+    n_stages = int(mesh.shape["pipe"])
+    num_layers = model.cfg.num_layers
+    if num_layers % n_stages:
+        raise ValueError(f"num_layers={num_layers} not divisible by "
+                         f"pipe={n_stages}")
+    if model.cfg.dropout > 0:
+        # The pipeline step does not thread dropout rngs through the scan
+        # yet; refusing beats silently training unregularized.
+        raise ValueError("make_pp_lm_step does not support dropout>0 yet; "
+                         "set dropout=0.0 in the LMConfig")
+    layers_per_stage = num_layers // n_stages
+    data_axes = tuple(a for a in mesh_lib.BATCH_AXES)
+
+    def body(state: TrainState, batch):
+        def loss_fn(params):
+            x = model.apply({"params": params}, batch["input_ids"],
+                            embed_only=True)
+            micro = pp.microbatch(x, n_micro)
+            stage_fn = lambda blocks, xm: model.apply(  # noqa: E731
+                {"params": {"blocks": blocks}}, xm, stage=True,
+                stage_layers=layers_per_stage)
+            out = pp.pipeline_apply(stage_fn, params["blocks"], micro)
+            x_last = pp.last_stage_value(out).reshape(x.shape)
+            logits = model.apply({"params": params}, x_last, head_only=True)
+            loss = losses.softmax_cross_entropy(logits, batch["labels"])
+            acc = losses.accuracy(logits, batch["labels"])
+            return lax.pmean(loss, data_axes), acc
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, "accuracy": lax.pmean(acc, data_axes)}
+        new_state = TrainState(step=state.step + 1, params=params,
+                               opt_state=opt_state,
+                               model_state=state.model_state, rng=state.rng)
+        return new_state, metrics
+
+    spec_tree = None
+
+    def specs(state):
+        nonlocal spec_tree
+        if spec_tree is None:
+            spec_tree = state_partition(state)
+        return spec_tree
+
+    def step_fn_factory(state):
+        sp = specs(state)
+        batch_part = P(mesh_lib.BATCH_AXES)
+        mapped = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(sp, {"input_ids": batch_part, "labels": batch_part}),
+            out_specs=(sp, P()),
+        )
+        # Donate the TrainState like make_train_step: pipeline parallelism
+        # exists for models near the memory limit, so don't double-buffer
+        # params + optimizer state.
+        return jax.jit(mapped, donate_argnums=(0,))
+
+    def place_state(state: TrainState) -> TrainState:
+        return jax.tree.map(
+            lambda t, s: mesh_lib.host_device_put(t, NamedSharding(mesh, s)),
+            state, specs(state))
+
+    def place_batch(batch):
+        sh = NamedSharding(mesh, P(mesh_lib.BATCH_AXES))
+        return jax.tree.map(lambda a: jax.device_put(a, sh), batch)
+
+    return step_fn_factory, place_state, place_batch
