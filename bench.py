@@ -110,7 +110,10 @@ def run(batch_per_chip: int, warmup: int, measure: int) -> float:
     mesh = mesh_lib.make_mesh() if n_chips > 1 else None
     global_batch = batch_per_chip * n_chips
 
-    model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    # TPUFRAME_BENCH_STEM=space_to_depth A/Bs the MXU-friendly stem
+    # reformulation (models/resnet.py; exact-function-preserving).
+    stem = os.environ.get("TPUFRAME_BENCH_STEM", "conv")
+    model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem)
     rng = np.random.default_rng(0)
     # bf16 on the host: halves infeed bytes and skips the on-device cast.
     x = rng.normal(0.5, 0.25, size=(global_batch, IMAGE_SIZE, IMAGE_SIZE, 3)

@@ -112,3 +112,26 @@ def test_pp_lm_indivisible_layers_raises():
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(pipe=4, data=2))
     with pytest.raises(ValueError, match="not divisible"):
         pp_lm.make_pp_lm_step(model, optax.sgd(0.1), mesh, n_micro=2)
+
+
+@pytest.mark.slow
+def test_pp_harness_end_to_end_with_resume(tmp_path):
+    """lm_pp_smoke through the full harness: trains, evals, checkpoints the
+    pipe-sharded state, and a restarted run resumes to the same final loss
+    as a straight run."""
+    from tpuframe import train as train_mod
+    from tpuframe.utils import get_config
+
+    ck = str(tmp_path / "ck")
+    base = get_config("lm_pp_smoke").with_overrides(
+        total_steps=20, ckpt_every=10, log_every=10, eval_every=100,
+        ckpt_dir=ck)
+    straight = train_mod.train(base)
+    assert straight["step"] == 20
+    assert straight["loss"] < 3.0
+
+    part1 = train_mod.train(base.with_overrides(total_steps=10,
+                                                ckpt_dir=ck + "2"))
+    part2 = train_mod.train(base.with_overrides(ckpt_dir=ck + "2"))
+    assert part2["step"] == 20
+    np.testing.assert_allclose(straight["loss"], part2["loss"], rtol=1e-4)

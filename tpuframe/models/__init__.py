@@ -14,7 +14,8 @@ from typing import Any, Callable
 from tpuframe.models.convnet import ConvNet
 from tpuframe.models.resnet import ResNet, ResNet18, ResNet50
 from tpuframe.models.bert import BertConfig, BertForSequenceClassification
-from tpuframe.models.transformer_lm import LMConfig, TransformerLM
+from tpuframe.models.transformer_lm import (LMConfig, ScanBlockLM,
+                                             TransformerLM)
 
 def _bert_base(dtype=None, **kwargs):
     """Registry adapter: flag-style kwargs → BertConfig (so get_model's
@@ -27,13 +28,25 @@ def _bert_base(dtype=None, **kwargs):
     return BertForSequenceClassification(BertConfig.base(**kwargs))
 
 
-def _transformer_lm(dtype=None, tiny=False, **kwargs):
-    import numpy as np
+def _lm_adapter(cls):
+    """Registry adapter shared by the LM variants: flag-style kwargs →
+    LMConfig → the given module class."""
 
-    if dtype is not None:
-        kwargs.setdefault("dtype", str(np.dtype(dtype)))
-    cfg = LMConfig.tiny(**kwargs) if tiny else LMConfig(**kwargs)
-    return TransformerLM(cfg)
+    def build(dtype=None, tiny=False, **kwargs):
+        import numpy as np
+
+        if dtype is not None:
+            kwargs.setdefault("dtype", str(np.dtype(dtype)))
+        cfg = LMConfig.tiny(**kwargs) if tiny else LMConfig(**kwargs)
+        return cls(cfg)
+
+    return build
+
+
+# transformer-lm-pp: the pipeline-parallel variant (layer-stacked blocks;
+# trained via tpuframe.parallel.pp_lm on a data x pipe mesh).
+_transformer_lm = _lm_adapter(TransformerLM)
+_transformer_lm_pp = _lm_adapter(ScanBlockLM)
 
 
 _REGISTRY: dict[str, Callable[..., Any]] = {
@@ -42,6 +55,7 @@ _REGISTRY: dict[str, Callable[..., Any]] = {
     "resnet50": ResNet50,
     "bert-base": _bert_base,
     "transformer-lm": _transformer_lm,
+    "transformer-lm-pp": _transformer_lm_pp,
 }
 
 
@@ -55,6 +69,7 @@ def get_model(name: str, **kwargs):
 __all__ = [
     "ConvNet",
     "LMConfig",
+    "ScanBlockLM",
     "TransformerLM",
     "ResNet",
     "ResNet18",

@@ -117,3 +117,46 @@ def make_pp_lm_step(model, tx: optax.GradientTransformation, mesh: Mesh, *,
         return jax.tree.map(lambda a: jax.device_put(a, sh), batch)
 
     return step_fn_factory, place_state, place_batch
+
+
+def make_pp_lm_eval(model, mesh: Mesh, *, n_micro: int):
+    """Forward-only pipeline step returning mean-able eval metrics
+    (tpuframe.parallel.step.make_eval_step's contract), for the harness's
+    evaluate() loop on a pp-sharded state."""
+    n_stages = int(mesh.shape["pipe"])
+    layers_per_stage = model.cfg.num_layers // n_stages
+    data_axes = tuple(mesh_lib.BATCH_AXES)
+
+    def body(state: TrainState, batch):
+        params = state.params
+        x = model.apply({"params": params}, batch["input_ids"],
+                        embed_only=True)
+        micro = pp.microbatch(x, n_micro)
+        stage_fn = lambda blocks, xm: model.apply(  # noqa: E731
+            {"params": {"blocks": blocks}}, xm, stage=True,
+            stage_layers=layers_per_stage)
+        out = pp.pipeline_apply(stage_fn, params["blocks"], micro)
+        x_last = pp.last_stage_value(out).reshape(x.shape)
+        logits = model.apply({"params": params}, x_last, head_only=True)
+        loss = losses.softmax_cross_entropy(logits, batch["labels"])
+        metrics = {"loss": loss,
+                   "accuracy": losses.accuracy(logits, batch["labels"]),
+                   "perplexity": jnp.exp(loss)}
+        return jax.tree.map(lambda m: lax.pmean(m, data_axes), metrics)
+
+    spec_tree = None
+
+    def eval_fn_factory(state):
+        nonlocal spec_tree
+        if spec_tree is None:
+            spec_tree = state_partition(state)
+        batch_part = P(mesh_lib.BATCH_AXES)
+        mapped = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(spec_tree,
+                      {"input_ids": batch_part, "labels": batch_part}),
+            out_specs=P(),
+        )
+        return jax.jit(mapped)
+
+    return eval_fn_factory

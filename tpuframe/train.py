@@ -124,31 +124,67 @@ def build_harness(cfg: TrainConfig) -> Harness:
     tx = build_optimizer(cfg, params)
     state = step_lib.TrainState.create(params, tx, model_state=model_state,
                                        rng=jax.random.key(cfg.seed + 1))
-    state_shardings = None
-    if use_sharded_state:
-        from tpuframe.parallel import fsdp as fsdp_lib
 
-        tp_rules = None
-        if mesh.shape["model"] > 1 or mesh.shape["expert"] > 1:
-            from tpuframe.parallel import tp as tp_lib
+    use_pp = mesh is not None and mesh.shape["pipe"] > 1
+    if use_pp:
+        # Pipeline parallelism: ScanBlockLM blocks + opt state sharded over
+        # the pipe axis, GPipe microbatching (tpuframe.parallel.pp_lm).
+        if cfg.model != "transformer-lm-pp":
+            raise ValueError(
+                f"mesh pipe={mesh.shape['pipe']} needs model="
+                f"'transformer-lm-pp' (layer-stacked blocks); got "
+                f"{cfg.model!r}")
+        if use_sharded_state:
+            raise ValueError("pipe parallelism does not compose with "
+                             "fsdp/model/expert sharded-state axes yet")
+        if cfg.grad_clip_norm is not None:
+            # A global-norm clip computes per-stage norms over each stage's
+            # block shard — pipe-varying clip scales that crash the step at
+            # trace time with an opaque replication error.  Refuse clearly.
+            raise ValueError("pipe parallelism does not support "
+                             "grad_clip_norm (global statistic across "
+                             "pipe-sharded params); set it to None")
+        if cfg.accum_steps != 1:
+            raise ValueError("pipe parallelism has its own microbatching "
+                             "(pp_microbatches); accum_steps must be 1")
+        if cfg.shard_seq:
+            raise ValueError("pipe parallelism does not compose with "
+                             "shard_seq sequence parallelism yet")
+        from tpuframe.parallel import pp_lm
 
-            tp_rules = tp_lib.rules_for_model(cfg.model)
-        state_shardings = fsdp_lib.state_shardings(state, mesh,
-                                                   tp_rules=tp_rules)
-        state = jax.tree.map(mesh_lib.host_device_put, state, state_shardings)
-    elif mesh is not None:
-        state = step_lib.replicate_state(state, mesh)
+        factory, place_state, _ = pp_lm.make_pp_lm_step(
+            model, tx, mesh, n_micro=cfg.pp_microbatches)
+        state = place_state(state)
+        train_step = factory(state)
+        eval_step = pp_lm.make_pp_lm_eval(
+            model, mesh, n_micro=cfg.pp_microbatches)(state)
+    else:
+        state_shardings = None
+        if use_sharded_state:
+            from tpuframe.parallel import fsdp as fsdp_lib
 
-    loss_fn = make_loss_fn(cfg, model)
-    from tpuframe.parallel import tuning
-    train_step = step_lib.make_train_step(
-        loss_fn, tx, mesh, batch_partition=step_part, reduce_axes=reduce_axes,
-        state_shardings=state_shardings,
-        fusion_threshold=tuning.step_threshold(),
-        accum_steps=cfg.accum_steps)
-    eval_step = step_lib.make_eval_step(
-        make_metric_fn(cfg, model), mesh, batch_partition=step_part,
-        reduce_axes=reduce_axes, state_shardings=state_shardings)
+            tp_rules = None
+            if mesh.shape["model"] > 1 or mesh.shape["expert"] > 1:
+                from tpuframe.parallel import tp as tp_lib
+
+                tp_rules = tp_lib.rules_for_model(cfg.model)
+            state_shardings = fsdp_lib.state_shardings(state, mesh,
+                                                       tp_rules=tp_rules)
+            state = jax.tree.map(mesh_lib.host_device_put, state,
+                                 state_shardings)
+        elif mesh is not None:
+            state = step_lib.replicate_state(state, mesh)
+
+        loss_fn = make_loss_fn(cfg, model)
+        from tpuframe.parallel import tuning
+        train_step = step_lib.make_train_step(
+            loss_fn, tx, mesh, batch_partition=step_part,
+            reduce_axes=reduce_axes, state_shardings=state_shardings,
+            fusion_threshold=tuning.step_threshold(),
+            accum_steps=cfg.accum_steps)
+        eval_step = step_lib.make_eval_step(
+            make_metric_fn(cfg, model), mesh, batch_partition=step_part,
+            reduce_axes=reduce_axes, state_shardings=state_shardings)
 
     manager = None
     start_step = 0

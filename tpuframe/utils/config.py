@@ -49,6 +49,9 @@ class TrainConfig:
     # Gradient accumulation (Horovod's backward_passes_per_step): microbatch
     # count per optimizer step; global_batch is split by this on-device.
     accum_steps: int = 1
+    # GPipe microbatches per step when the mesh's pipe axis > 1
+    # (model='transformer-lm-pp'; tpuframe.parallel.pp_lm).
+    pp_microbatches: int = 4
     eval_every: int = 100
     eval_batches: int = 8
     log_every: int = 10
@@ -177,6 +180,21 @@ def _lm_smoke() -> TrainConfig:
     )
 
 
+def _lm_pp_smoke() -> TrainConfig:
+    """Tiny pipeline-parallel LM for tests/CI: 2-way data x 4-way pipe on
+    the 8-device virtual mesh (ScanBlockLM, beyond-reference capability)."""
+    return TrainConfig(
+        name="lm_pp_smoke", model="transformer-lm-pp",
+        model_kwargs={"tiny": True, "vocab_size": 64, "num_layers": 4},
+        dataset="lm_text",
+        dataset_kwargs={"seq_len": 64, "vocab_size": 64, "synthetic_size": 64},
+        mesh=MeshSpec(data=2, pipe=4), pp_microbatches=2,
+        optimizer="adamw", base_lr=3e-3, scale_lr_by_batch=False,
+        schedule="constant", global_batch=8, total_steps=40,
+        eval_every=20, eval_batches=2, log_every=10, ckpt_every=20,
+    )
+
+
 def _smoke() -> TrainConfig:
     """Tiny end-to-end config for tests/CI (not a reference workload)."""
     return TrainConfig(
@@ -196,6 +214,7 @@ WORKLOADS = {
     "imagenet_resnet50_pod": _imagenet_resnet50_pod,
     "lm_long": _lm_long,
     "lm_smoke": _lm_smoke,
+    "lm_pp_smoke": _lm_pp_smoke,
     "smoke": _smoke,
 }
 
