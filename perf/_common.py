@@ -27,18 +27,64 @@ def make_log(tag: str):
     return log
 
 
-def timeit(fn, *args, steps: int = 10):
-    """Async chained dispatch timing: warm twice, then `steps` dispatches and
-    one final block (each call is independent here, so the block waits for
-    the last dispatched program; see PERF.md §1 for the validation)."""
+# NOTE: there is deliberately no repeat-the-same-call timer here: repeating
+# an identical (program, inputs) pair on the axon relay is served by an
+# execution cache in ~20us regardless of true cost (PERF.md §0b).  Timing is
+# only valid through data-dependent chains (timeit_chain below) or loops
+# that consume their own output (bench.py's state-chained loop).
+
+
+def timeit_chain(make_chain, *args, chain: int = 16, reps: int = 3,
+                 log=None, min_delta: float = 0.4, max_chain: int = 4096):
+    """Execution-cache-proof timing for a pure function.
+
+    ``make_chain(n)`` must return a jitted function of ``*args`` that runs
+    the computation ``n`` times with a data dependence between iterations
+    (lax.scan feeding output into input).  Per-iteration cost is
+    (t_chainN - t_chain1) / (N - 1), best of ``reps``: the relay cannot
+    cache across iterations (inputs differ), and dispatch/infeed overhead
+    cancels in the difference.
+
+    The chain GROWS (4x steps, up to ``max_chain``) until the measured
+    difference clears ``min_delta`` seconds — the relay's round-trip jitter
+    is ~100ms-class, so a fixed chain that is safe for a 20ms program is
+    pure noise for a 0.2ms one.  Raw chain times go to ``log``."""
     import jax
 
-    out = fn(*args)
-    jax.block_until_ready(out)
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / steps
+    first, rest = args[0], args[1:]
+
+    def best(f, salt):
+        jax.block_until_ready(f(first, *rest))  # compile + settle
+        ts = []
+        for r in range(reps):
+            # Fresh first-arg per timed call — an identical (program, inputs)
+            # replay can be served by the relay's execution cache.  The
+            # perturbation must be PERCENT-level: bf16 has ~2 significant
+            # decimal digits, so an additive 1e-6 nudge rounds away and the
+            # buffer stays bit-identical.
+            a = jax.block_until_ready(first * (1.0 + 0.01 * (salt + r + 1)))
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(a, *rest))
+            ts.append(time.perf_counter() - t0)
+        return ts
+
+    t_1 = best(make_chain(1), 10)
+    n = chain
+    while True:
+        t_n = best(make_chain(n), 0)
+        delta = min(t_n) - min(t_1)
+        if log is not None:
+            log(f"  raw chain{n}: {[round(t * 1e3, 1) for t in t_n]} ms; "
+                f"chain1: {[round(t * 1e3, 1) for t in t_1]} ms "
+                f"(delta {delta * 1e3:.1f} ms)")
+        if delta >= min_delta:
+            return delta / (n - 1)
+        if n >= max_chain:
+            # Refuse to return jitter as data (the failure mode this timer
+            # exists to prevent); callers record the error row instead.
+            raise RuntimeError(
+                f"timeit_chain: delta {delta * 1e3:.1f} ms at chain {n} "
+                f"never cleared min_delta {min_delta * 1e3:.0f} ms "
+                f"(chain times {[round(t * 1e3, 1) for t in t_n]} ms vs "
+                f"chain1 {[round(t * 1e3, 1) for t in t_1]} ms)")
+        n = min(n * 4, max_chain)

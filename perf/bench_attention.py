@@ -3,6 +3,15 @@
 VERDICT r2 #2's measurement half: tokens/s fwd and fwd+bwd at seq 2k-8k,
 causal, bf16 — the long-context shape class.  Results go into BASELINE.md.
 
+Timing must be DATA-DEPENDENT on this relay platform: dispatching the same
+compiled program on the same input buffers repeatedly returns in ~20us
+regardless of the program's real cost (an execution cache somewhere in the
+remote-execution path — independent repeats of a seq-8192 attention "ran"
+1000x faster than its MXU roofline).  So each measurement jits a chain of
+``n`` attention calls whose output feeds the next call's query, and the
+per-call time is (t(n=N) - t(n=1)) / (N-1): execution-cache-proof (every
+call's input differs), dispatch-overhead-free, still one HBM-resident loop.
+
     python perf/bench_attention.py            # all seqs, both impls
     SEQS=2048 python perf/bench_attention.py
 """
@@ -10,11 +19,12 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _common import make_log, setup, timeit
+from _common import make_log, setup, timeit_chain
 
 jax = setup()
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from tpuframe.ops import attention as attn_ops
 from tpuframe.ops.flash_attention import flash_mha
@@ -23,14 +33,36 @@ SEQS = [int(s) for s in os.environ.get("SEQS", "2048,4096,8192").split(",")]
 HEADS = int(os.environ.get("HEADS", "8"))
 HEAD_DIM = int(os.environ.get("HEAD_DIM", "64"))
 BATCH = int(os.environ.get("B", "4"))
-STEPS = int(os.environ.get("N", "10"))
-
+# Starting chain length; timeit_chain grows it until the timing difference
+# clears the relay's round-trip jitter (perf/_common.py).
+CHAIN = int(os.environ.get("N", "32"))
 
 log = make_log("attn-bench")
 
 
+def fwd_chain(f, n):
+    """jit of n chained attention calls: out_i becomes query_{i+1}."""
+    def g(q, k, v):
+        def body(x, _):
+            return f(x, k, v).astype(q.dtype), None
+        x, _ = lax.scan(body, q, None, length=n)
+        return x
+    return jax.jit(g)
+
+
+def fwdbwd_chain(f, n):
+    """jit of grad-through-n-chained-calls: n forwards + n backwards."""
+    def loss(q, k, v):
+        def body(x, _):
+            return f(x, k, v).astype(q.dtype), None
+        x, _ = lax.scan(body, q, None, length=n)
+        return jnp.sum(x.astype(jnp.float32) ** 2)
+    return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+
 def main():
-    log(f"backend={jax.default_backend()} b={BATCH} h={HEADS} d={HEAD_DIM}")
+    log(f"backend={jax.default_backend()} b={BATCH} h={HEADS} d={HEAD_DIM} "
+        f"chain={CHAIN}")
     rows = []
     for s in SEQS:
         rng = np.random.default_rng(0)
@@ -40,25 +72,27 @@ def main():
         tokens = BATCH * s
 
         impls = {
-            "pallas": jax.jit(lambda q, k, v: flash_mha(
-                q, k, v, causal=True, interpret=False)),
-            "xla": jax.jit(lambda q, k, v: attn_ops.multihead_attention(
-                q, k, v, causal=True, impl="xla")),
+            "pallas": lambda q, k, v: flash_mha(
+                q, k, v, causal=True, interpret=False),
+            "xla": lambda q, k, v: attn_ops.multihead_attention(
+                q, k, v, causal=True, impl="xla"),
         }
-        grads = {
-            name: jax.jit(jax.grad(
-                lambda q, k, v, f=f: jnp.sum(f(q, k, v) ** 2).astype(jnp.float32),
-                argnums=(0, 1, 2)))
-            for name, f in impls.items()
-        }
-        for name in impls:
+        # grad-of-scan saves per-iteration residuals (~4 tensors of
+        # b*s*h*d bf16 each); cap the bwd chain so they fit in ~4 GB of
+        # HBM rather than letting the adaptive growth OOM the chip.
+        resid_bytes = 4 * BATCH * s * HEADS * HEAD_DIM * 2
+        max_bwd_chain = max(8, int(4e9 / resid_bytes))
+        for name, f in impls.items():
             try:
-                t_f = timeit(impls[name], q, k, v, steps=STEPS)
-                t_fb = timeit(grads[name], q, k, v, steps=STEPS)
+                t_f = timeit_chain(
+                    lambda n: fwd_chain(f, n), q, k, v, chain=CHAIN, log=log)
+                t_fb = timeit_chain(
+                    lambda n: fwdbwd_chain(f, n), q, k, v, chain=CHAIN,
+                    log=log, max_chain=max_bwd_chain, min_delta=0.25)
                 row = {"seq": s, "impl": name,
-                       "fwd_ms": round(t_f * 1e3, 2),
+                       "fwd_ms": round(t_f * 1e3, 3),
                        "fwd_tokens_per_s": round(tokens / t_f),
-                       "fwdbwd_ms": round(t_fb * 1e3, 2),
+                       "fwdbwd_ms": round(t_fb * 1e3, 3),
                        "fwdbwd_tokens_per_s": round(tokens / t_fb)}
             except Exception as e:  # noqa: BLE001 — record and continue
                 row = {"seq": s, "impl": name,

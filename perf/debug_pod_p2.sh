@@ -1,0 +1,27 @@
+#!/bin/bash
+# Phase-2-only repro for the pod resume hang: restore the 4-proc-written
+# checkpoint on a 2-proc cluster.  Unbuffered, faulthandler armed, SIGABRT
+# on timeout so every rank dumps thread stacks.
+set -u
+cd "$(dirname "$0")/.."
+D=${D:-/tmp/podtest}
+PORT=${PORT:-24561}
+TMO=${TMO:-240}
+for pid in 0 1; do
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+  TPUFRAME_COORDINATOR=127.0.0.1:$PORT \
+  TPUFRAME_NUM_PROCESSES=2 TPUFRAME_PROCESS_ID=$pid \
+  timeout -s ABRT "$TMO" python -u -X faulthandler -m tpuframe.train \
+    --config imagenet_resnet50_pod \
+    --set total_steps=8 --set ckpt_every=4 --set global_batch=32 \
+    --set log_every=4 --set eval_every=1000 --set warmup_steps=2 \
+    --set "compute_dtype='float32'" \
+    --set "dataset_kwargs={'image_size': 32, 'synthetic_size': 64}" \
+    --set "model_kwargs={'cifar_stem': True, 'num_classes': 100}" \
+    --ckpt-dir "$D/ck" > "$D/dbg.r$pid.out" 2> "$D/dbg.r$pid.err" &
+done
+wait
+echo "=== r0 out ==="; tail -8 "$D/dbg.r0.out"
+echo "=== r0 err ==="; tail -40 "$D/dbg.r0.err"
+echo "=== r1 err ==="; tail -40 "$D/dbg.r1.err"

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Debug driver for test_pod_config_multihost_kill_and_reshard_resume:
+# runs the two phases with rank output teed to files so a hang is visible.
+set -u
+cd "$(dirname "$0")/.."
+D=${D:-/tmp/podtest}
+rm -rf "$D"; mkdir -p "$D"
+PORT=$((20000 + RANDOM % 20000))
+
+COMMON_ARGS=(-m tpuframe.train --config imagenet_resnet50_pod
+  --set total_steps=8 --set ckpt_every=4 --set global_batch=32
+  --set log_every=4 --set eval_every=1000 --set warmup_steps=2
+  --set "compute_dtype='float32'"
+  --set "dataset_kwargs={'image_size': 32, 'synthetic_size': 64}"
+  --set "model_kwargs={'cifar_stem': True, 'num_classes': 100}"
+  --ckpt-dir "$D/ck")
+
+phase() { # name nprocs fault_step
+  local name=$1 np=$2 fault=$3
+  echo "=== phase $name: $np procs (fault=$fault) ==="
+  local pids=()
+  for pid in $(seq 0 $((np - 1))); do
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    TPUFRAME_COORDINATOR=127.0.0.1:$PORT \
+    TPUFRAME_NUM_PROCESSES=$np TPUFRAME_PROCESS_ID=$pid \
+    TPUFRAME_FAULT_STEP=$fault \
+    timeout 420 python "${COMMON_ARGS[@]}" \
+      > "$D/$name.r$pid.out" 2> "$D/$name.r$pid.err" &
+    pids+=($!)
+  done
+  local rc=0
+  for p in "${pids[@]}"; do wait "$p" || rc=$?; done
+  echo "phase $name done (last rc=$rc)"
+}
+
+phase p1 4 6
+ls "$D/ck" || true
+PORT=$((PORT + 1))
+phase p2 2 ""
+echo "=== p2 rank0 tail ==="; tail -5 "$D/p2.r0.out" "$D/p2.r0.err"

@@ -39,17 +39,15 @@ def log(m):
     print(f"[exp] {m}", file=sys.stderr, flush=True)
 
 
-def time_fn(fn, *args, steps=STEPS):
-    """Time `fn` with async chained dispatch + one final fetch."""
-    out = fn(*args)
-    jax.block_until_ready(out)  # warmup/compile
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / steps
+def time_fn(make_chain, *args):
+    """Per-iteration time of a data-dependent chain (perf/_common.py).
+
+    chain=16: the difference t_16 - t_1 must clear the relay's ~100ms-class
+    round-trip jitter even for the ~20ms fwd program (the first chain=8 run
+    got clamped to 0 for exactly that reason)."""
+    from _common import timeit_chain
+
+    return timeit_chain(make_chain, *args, chain=16, log=log)
 
 
 def cost(compiled):
@@ -85,31 +83,79 @@ def main():
     train_step = step_lib.make_train_step(loss_fn, tx, None, donate=False)
     batch = {"image": x, "label": y}
 
+    # Sub-program timings must be DATA-DEPENDENT chains (lax.scan feeding a
+    # 1e-30-scaled summary of iteration i's output into iteration i+1's
+    # input): repeating an identical (program, inputs) dispatch is served by
+    # the relay's execution cache in ~20us regardless of true cost (PERF.md
+    # §0b).  1e-30 keeps the carry numerically unchanged in bf16 while
+    # remaining opaque to XLA's simplifier.  Per-iteration time comes from
+    # timeit_chain's (t_N - t_1)/(N-1) difference.
+
     # -- fwd (inference) --
+    def fwd_chain(n):
+        def g(im, p, s):
+            def body(xc, _):
+                logits = model.apply({"params": p, **s}, xc, train=False)
+                dep = (1e-30 * jnp.sum(logits)).astype(xc.dtype)
+                return xc + dep, None
+            xc, _ = jax.lax.scan(body, im, None, length=n)
+            return xc
+        return jax.jit(g)
+
+    log("timing fwd(infer)...")
+    t = time_fn(fwd_chain, x, params, {"batch_stats": bstats})
     fwd = jax.jit(lambda p, s, im: model.apply(
         {"params": p, **s}, im, train=False))
-    log("timing fwd(infer)...")
-    t = time_fn(fwd, params, {"batch_stats": bstats}, x)
     log("cost-analysis fwd(infer)...")
     c = cost(fwd.lower(params, {"batch_stats": bstats}, x).compile())
     log(f"fwd(infer)  : {t*1e3:7.1f} ms  flops={c[0]:.3e} bytes={c[1]:.3e}")
 
     # -- fwd train (batch stats) --
+    def fwd_t_chain(n):
+        def g(im, p, s):
+            def body(carry, _):
+                xc, stats = carry
+                logits, mutated = model.apply(
+                    {"params": p, **stats}, xc, train=True,
+                    mutable=["batch_stats"])
+                dep = (1e-30 * jnp.sum(logits)).astype(xc.dtype)
+                return (xc + dep, dict(mutated)), None
+            (xc, _), _ = jax.lax.scan(body, (im, s), None, length=n)
+            return xc
+        return jax.jit(g)
+
+    log("timing fwd(train)...")
+    t = time_fn(fwd_t_chain, x, params, {"batch_stats": bstats})
     fwd_t = jax.jit(lambda p, s, im: model.apply(
         {"params": p, **s}, im, train=True, mutable=["batch_stats"]))
-    log("timing fwd(train)...")
-    t = time_fn(fwd_t, params, {"batch_stats": bstats}, x)
     log("cost-analysis fwd(train)...")
     c = cost(fwd_t.lower(params, {"batch_stats": bstats}, x).compile())
     log(f"fwd(train)  : {t*1e3:7.1f} ms  flops={c[0]:.3e} bytes={c[1]:.3e}")
 
     # -- grad --
+    r = jax.random.key(1)
+
+    def grad_chain(n):
+        def g(im, p, s):
+            def body(carry, _):
+                xc, stats = carry
+                (loss, (stats, _)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(
+                        p, stats, {"image": xc, "label": y}, r)
+                gsum = sum(jnp.sum(g.astype(jnp.float32))
+                           for g in jax.tree.leaves(grads))
+                dep = (1e-30 * (loss + gsum)).astype(xc.dtype)
+                return (xc + dep, stats), None
+            (xc, _), _ = jax.lax.scan(body, (im, s), None, length=n)
+            return xc
+        return jax.jit(g)
+
+    log("timing grad...")
+    t = time_fn(grad_chain, x, params, {"batch_stats": bstats})
+
     def just_grad(p, s, b, r):
         return jax.value_and_grad(loss_fn, has_aux=True)(p, s, b, r)
     gr = jax.jit(just_grad)
-    r = jax.random.key(1)
-    log("timing grad...")
-    t = time_fn(gr, params, {"batch_stats": bstats}, batch, r)
     log("cost-analysis grad...")
     c = cost(gr.lower(params, {"batch_stats": bstats}, batch, r).compile())
     log(f"grad(f+b)   : {t*1e3:7.1f} ms  flops={c[0]:.3e} bytes={c[1]:.3e}")
@@ -119,7 +165,10 @@ def main():
     new, m = train_step(state, batch)
     jax.block_until_ready(m)
     t0 = time.perf_counter()
-    cur = state
+    # Seed with the warmup's OUTPUT: restarting from `state` would make
+    # timed iteration 0 a bit-identical replay of the warmup dispatch,
+    # which the relay's execution cache serves in ~20us (PERF.md §0b).
+    cur = new
     for _ in range(STEPS):
         cur, m = train_step(cur, batch)
     jax.block_until_ready(m)

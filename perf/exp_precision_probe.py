@@ -1,0 +1,55 @@
+"""Does Mosaic honor dot_general precision=HIGHEST inside a Pallas kernel?
+
+If yes, the f32 flash-attention path could run with f32-true MXU products
+(multi-pass) and the on-chip f32 tolerance in tests/test_flash_attention_tpu
+could tighten from the bf16-product level (~4e-3) to ~1e-5.  This probes a
+minimal kernel; the answer decides whether plumbing a precision arg through
+flash_mha is worth it.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import make_log, setup
+
+jax = setup()
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+log = make_log("prec-probe")
+
+
+def kernel(prec, x_ref, y_ref, o_ref):
+    o_ref[...] = jax.lax.dot_general(
+        x_ref[...], y_ref[...], (((1,), (0,)), ((), ())),
+        precision=prec, preferred_element_type=jnp.float32)
+
+
+def run(prec):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(256, 256)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(256, 256)), jnp.float32)
+    out = pl.pallas_call(
+        functools.partial(kernel, prec),
+        out_shape=jax.ShapeDtypeStruct((256, 256), jnp.float32),
+    )(x, y)
+    ref = np.asarray(x, np.float64) @ np.asarray(y, np.float64)
+    err = float(np.max(np.abs(np.asarray(out, np.float64) - ref)))
+    log(f"precision={prec}: max |err| vs f64 = {err:.3e}")
+    return err
+
+
+def main():
+    log(f"backend={jax.default_backend()}")
+    for prec in [None, jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST]:
+        try:
+            run(prec)
+        except Exception as e:  # noqa: BLE001
+            log(f"precision={prec}: FAILED {type(e).__name__}: {e}"[:300])
+
+
+if __name__ == "__main__":
+    main()

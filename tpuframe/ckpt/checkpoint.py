@@ -194,6 +194,15 @@ def _shard_table(arr, base: str):
     return table, owned
 
 
+def _entry_spec(entry: dict) -> P:
+    """The PartitionSpec a manifest leaf entry was saved with (see
+    _spec_of); used both for broadcast-eligibility and for placement, so
+    the two can't diverge."""
+    if not entry["spec"]:
+        return P()
+    return P(*[tuple(e) if e else None for e in entry["spec"]])
+
+
 def _index_key(index, shape) -> tuple:
     out = []
     for sl, dim in zip(index, shape):
@@ -242,41 +251,20 @@ def restore(directory: str, step: int, *, mesh: Mesh | None = None,
         # path), so all global placement goes through host_device_put.
         from tpuframe.parallel.mesh import host_device_put
 
-        def _use_broadcast(sharding) -> bool:
-            # Fully-replicated leaves on a multi-host run: only the primary
-            # touches storage; bytes fan out over the interconnect
-            # (collectives.primary_device_put) — kills the O(hosts × ckpt
-            # bytes) storage read amplification of everyone re-assembling.
-            # CRC is verified by the one process that reads.
-            return (jax.process_count() > 1
-                    and isinstance(sharding, NamedSharding)
-                    and sharding.is_fully_replicated
-                    and os.environ.get("TPUFRAME_RESTORE_BROADCAST", "1") == "1"
-                    and {d.id for d in sharding.mesh.devices.flat}
-                    == {d.id for d in jax.devices()})
-
         def _broadcast_restore(sharding):
-            from tpuframe.parallel import collectives
-
-            dtype = np.dtype(entry["dtype"])
-            if jax.process_index() == 0:
-                a = _assemble(path, entry, manifest["crc"], verify_crc,
-                              crc_algo).astype(dtype, copy=False)
-            else:  # placeholder; payload arrives over the fabric
-                a = np.zeros(tuple(entry["shape"]), dtype)
-            data = collectives.primary_device_put(a, sharding)
+            # Payload already arrived via the ONE packed broadcast (see
+            # _receive_broadcast_batch); placement here is local-only.
+            a = _bcast_payload[name]
+            data = host_device_put(a, sharding)
             if "prng_impl" in entry:
                 return jax.random.wrap_key_data(data, impl=entry["prng_impl"])
             return data
 
-        if tgt_sharding is not None and _use_broadcast(tgt_sharding):
+        if tgt_sharding is not None and name in _bcast_payload:
             return _broadcast_restore(tgt_sharding)
-        if tgt_sharding is None and mesh is not None:
-            spec = P(*[tuple(e) if e else None for e in entry["spec"]]) \
-                if entry["spec"] else P()
-            mesh_sharding = NamedSharding(mesh, spec)
-            if _use_broadcast(mesh_sharding):
-                return _broadcast_restore(mesh_sharding)
+        if tgt_sharding is None and name in _bcast_payload:
+            return _broadcast_restore(NamedSharding(mesh,
+                                                     _entry_spec(entry)))
 
         arr = _assemble(path, entry, manifest["crc"], verify_crc, crc_algo)
         arr = arr.astype(np.dtype(entry["dtype"]), copy=False)
@@ -290,10 +278,80 @@ def restore(directory: str, step: int, *, mesh: Mesh | None = None,
             # Replicated target: full assemble + global placement.
             return host_device_put(arr, tgt_sharding)
         if mesh is not None:
-            spec = P(*[tuple(e) if e else None for e in entry["spec"]]) \
-                if entry["spec"] else P()
-            return host_device_put(arr, NamedSharding(mesh, spec))
+            return host_device_put(arr, NamedSharding(mesh,
+                                                      _entry_spec(entry)))
         return arr
+
+    def _use_broadcast(sharding) -> bool:
+        # Fully-replicated leaves on a multi-host run: only the primary
+        # touches storage; bytes fan out over the interconnect — kills the
+        # O(hosts × ckpt bytes) storage read amplification of everyone
+        # re-assembling.  CRC is verified by the one process that reads.
+        return (jax.process_count() > 1
+                and isinstance(sharding, NamedSharding)
+                and sharding.is_fully_replicated
+                and os.environ.get("TPUFRAME_RESTORE_BROADCAST", "1") == "1"
+                and {d.id for d in sharding.mesh.devices.flat}
+                == {d.id for d in jax.devices()})
+
+    def _receive_broadcast_batch(plan) -> dict:
+        """All primary-read leaves shipped in ONE packed collective.
+
+        Per-leaf broadcasts deadlock: the primary blocks on storage reads
+        while the placeholder ranks race ahead dispatching later leaves'
+        broadcast programs, and those programs' out-of-band Gloo/communicator
+        setup interleaves with in-flight collectives — the exact
+        collective-ordering hazard Horovod's background coordinator existed
+        to serialize away (SURVEY.md §3b).  One program + one collective has
+        no ordering to get wrong, and is faster (one fabric round instead of
+        hundreds).  Transient cost: the packed replicated-leaf bytes
+        materialize once per host."""
+        eligible, shard_mesh = [], None
+        for name, tgt in plan:
+            entry = manifest["leaves"][name]
+            s = getattr(tgt, "sharding", None)
+            if s is None:
+                if mesh is None:
+                    continue
+                s = NamedSharding(mesh, _entry_spec(entry))
+            if _use_broadcast(s):
+                eligible.append((name, entry))
+                shard_mesh = s.mesh
+        if not eligible:
+            return {}
+        from tpuframe.parallel import collectives
+
+        sizes = []
+        for name, entry in eligible:
+            n = int(np.prod(tuple(entry["shape"]), dtype=np.int64)) \
+                if entry["shape"] else 1
+            sizes.append(n * np.dtype(entry["dtype"]).itemsize)
+        total = int(sum(sizes))
+        if jax.process_index() == 0:
+            parts = []
+            for name, entry in eligible:
+                a = _assemble(path, entry, manifest["crc"], verify_crc,
+                              crc_algo)
+                a = np.ascontiguousarray(
+                    a.astype(np.dtype(entry["dtype"]), copy=False))
+                # reshape(-1) before view: 0-d leaves (step counters) reject
+                # itemsize-changing views.
+                parts.append(a.reshape(-1).view(np.uint8))
+            buf = np.concatenate(parts)
+            assert buf.nbytes == total, (buf.nbytes, total)
+        else:  # placeholder; payload arrives over the fabric
+            buf = np.zeros(total, np.uint8)
+        got = collectives.primary_device_put(
+            buf, NamedSharding(shard_mesh, P()))
+        # jnp.sum promotes uint8 — bring the bytes back to uint8 (values are
+        # preserved: exactly one row of the broadcast sum is nonzero).
+        host = np.asarray(got.addressable_shards[0].data).astype(np.uint8)
+        payload, off = {}, 0
+        for (name, entry), nb in zip(eligible, sizes):
+            payload[name] = host[off:off + nb].view(
+                np.dtype(entry["dtype"])).reshape(tuple(entry["shape"]))
+            off += nb
+        return payload
 
     if target is not None:
         # Exact structure (incl. registered dataclasses like TrainState)
@@ -305,10 +363,14 @@ def restore(directory: str, step: int, *, mesh: Mesh | None = None,
             raise ValueError(
                 f"checkpoint/target structure mismatch; missing={sorted(missing)} "
                 f"extra={sorted(extra)}")
-        leaves = [_placed(name, tgt) for name, tgt in zip(tgt_names, tgt_leaves)]
+        _bcast_payload = _receive_broadcast_batch(zip(tgt_names, tgt_leaves))
+        leaves = [_placed(name, tgt) for name, tgt in zip(tgt_names,
+                                                          tgt_leaves)]
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
     # No target: rebuild a nested dict from the saved leaf paths.
+    _bcast_payload = _receive_broadcast_batch(
+        [(name, None) for name in saved_names])
     out: dict = {}
     for name in saved_names:
         node = out
