@@ -69,7 +69,7 @@ def timeit_chain(make_chain, *args, chain: int = 16, reps: int = 3,
         return ts
 
     t_1 = best(make_chain(1), 10)
-    n = chain
+    n = min(chain, max_chain)  # the caller's memory cap binds from the start
     while True:
         t_n = best(make_chain(n), 0)
         delta = min(t_n) - min(t_1)

@@ -77,6 +77,17 @@ def main():
             "xla": lambda q, k, v: attn_ops.multihead_attention(
                 q, k, v, causal=True, impl="xla"),
         }
+        # The materialized [B,H,S,S] f32 scores of the xla path: don't even
+        # try shapes that cannot fit — the seq-8192 attempt crashed the
+        # relay's remote-compile helper (perf/results/attn_bench.out, queue
+        # 1) and helper crashes are a suspect for wedging the chip grant.
+        score_gb = BATCH * HEADS * s * s * 4 / 1e9
+        if score_gb > 4:
+            rows.append({"seq": s, "impl": "xla",
+                         "error": f"skipped: S^2 scores ~{score_gb:.0f}GB "
+                                  f"exceed HBM (flash runs this shape)"})
+            log(str(rows[-1]))
+            impls.pop("xla")
         # grad-of-scan saves per-iteration residuals (~4 tensors of
         # b*s*h*d bf16 each); cap the bwd chain so they fit in ~4 GB of
         # HBM rather than letting the adaptive growth OOM the chip.

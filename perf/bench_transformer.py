@@ -95,19 +95,36 @@ def bench_lm(attn_impl):
     variables = model.init(jax.random.key(0), batch["input_ids"][:1])
     tx = optax.adamw(1e-4)
 
-    def loss_fn(params, model_state, b, rng):
-        logits = model.apply({"params": params}, b["input_ids"], train=True,
-                             rngs={"dropout": rng})
-        return losses.softmax_cross_entropy(logits, b["labels"]), ({}, {})
+    fused = os.environ.get("XENT", "dense") == "fused"
+    if fused:
+        # Chunked fused head+loss (tpuframe.ops.fused_xent): the [B,S,V]
+        # logits never materialize in HBM.
+        from tpuframe.ops import fused_xent as fx
+
+        def loss_fn(params, model_state, b, rng):
+            hidden = model.apply({"params": params}, b["input_ids"],
+                                 train=True, rngs={"dropout": rng},
+                                 hidden_only=True)
+            w = params["lm_head"]["kernel"]
+            loss = jnp.mean(fx.fused_softmax_xent(hidden, w, b["labels"]))
+            return loss, ({}, {})
+    else:
+        def loss_fn(params, model_state, b, rng):
+            logits = model.apply({"params": params}, b["input_ids"],
+                                 train=True, rngs={"dropout": rng})
+            return losses.softmax_cross_entropy(logits, b["labels"]), ({}, {})
 
     state = step_lib.TrainState.create(variables["params"], tx)
     step = step_lib.make_train_step(loss_fn, tx, None, donate=True)
     dt = run_chain(step, state, batch)
     tok_s = LM_BATCH * LM_SEQ / dt
-    log(f"lm(124M,{attn_impl}) b={LM_BATCH} s={LM_SEQ}: {dt*1e3:.1f} ms/step,"
+    tag = f"lm(124M,{attn_impl}{',fused-xent' if fused else ''})"
+    log(f"{tag} b={LM_BATCH} s={LM_SEQ}: {dt*1e3:.1f} ms/step,"
         f" {tok_s:.0f} tokens/s")
-    return {"model": f"transformer-lm/{attn_impl}", "batch": LM_BATCH,
-            "seq": LM_SEQ, "ms_per_step": round(dt * 1e3, 1),
+    return {"model": f"transformer-lm/{attn_impl}"
+                     + ("/fused-xent" if fused else ""),
+            "batch": LM_BATCH, "seq": LM_SEQ,
+            "ms_per_step": round(dt * 1e3, 1),
             "tokens_per_s": round(tok_s)}
 
 
@@ -117,7 +134,15 @@ def main():
     if MODEL in ("both", "bert"):
         rows.append(bench_bert())
     if MODEL in ("both", "lm"):
-        for impl in ("xla", "pallas"):
+        only = os.environ.get("ATTN_ONLY", "")
+        impls = (only,) if only else ("xla", "pallas")
+        # xla attention materializes [B,H,S,S] f32 scores; refuse shapes
+        # that can't fit rather than crash the relay's compile helper.
+        score_gb = LM_BATCH * 12 * LM_SEQ * LM_SEQ * 4 / 1e9
+        if "xla" in impls and score_gb > 4:
+            log(f"skipping xla attention: scores ~{score_gb:.0f}GB")
+            impls = tuple(i for i in impls if i != "xla")
+        for impl in impls:
             try:
                 rows.append(bench_lm(impl))
             except Exception as e:  # noqa: BLE001

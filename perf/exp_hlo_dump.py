@@ -88,23 +88,34 @@ def main():
 
 
 def _nbytes(key: str) -> float:
-    m = re.match(r"(bf16|f32|s32|pred)\[([0-9,]*)\]", key)
+    m = re.match(r"(bf16|f32|s32|pred)\[([0-9,]*)\]\{([^:}]*)", key)
     if not m:
         return 0.0
-    dt, dims = m.groups()
+    dt, dims, perm = m.groups()
     if not dims:
         return 0.0
     sz = {"bf16": 2, "f32": 4, "s32": 4, "pred": 1}[dt]
-    n = 1.0
     parts = [int(d) for d in dims.split(",") if d]
     if not parts:
         return 0.0
-    # crude padded estimate: minor dim to 128, next-minor to 8
-    for i, d in enumerate(parts):
-        if i == len(parts) - 1:
-            d = (d + 127) // 128 * 128
-        elif i == len(parts) - 2:
-            d = (d + 7) // 8 * 8
+    # The layout's minor-to-major list says which LOGICAL dim is physically
+    # minor — that dim gets the 128-lane rounding, the next-minor the
+    # 8-sublane rounding.  Falling back to logical order when unparsable.
+    try:
+        mtm = [int(p) for p in perm.split(",") if p.strip() != ""]
+    except ValueError:
+        mtm = []
+    if len(mtm) != len(parts):
+        mtm = list(range(len(parts) - 1, -1, -1))
+    padded = list(parts)
+    if mtm:
+        minor = mtm[0]
+        padded[minor] = (padded[minor] + 127) // 128 * 128
+        if len(mtm) > 1:
+            nxt = mtm[1]
+            padded[nxt] = (padded[nxt] + 7) // 8 * 8
+    n = 1.0
+    for d in padded:
         n *= d
     return n * sz
 

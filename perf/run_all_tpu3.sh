@@ -1,0 +1,52 @@
+#!/bin/bash
+# Round-3 TPU queue #3: fused-xent A/B + long-context LM on the chip.
+#  - LM 124M seq 2048: dense vs fused-xent loss path (both attention impls)
+#  - seq 8192: pallas flash vs xla attention (xla expected to OOM/compile-fail
+#    — that negative result is the flash memory win, record it)
+#  - seq 32768 b=1: pallas + fused-xent (the lm_long flagship shape)
+# Same relay rules: ONE client, strictly serial; patient retry claim.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p perf/results
+LOG=perf/results/run_all3.log
+echo "=== run_all_tpu3 $(date -u +%FT%TZ) ===" >> "$LOG"
+
+note() { echo "[run_all3 $(date -u +%T)] $*" | tee -a "$LOG"; }
+
+note "phase 0: probing for chip claim (retry loop, up to ~5h)..."
+claimed=0
+for attempt in $(seq 1 60); do
+  timeout 2400 python -u -c "
+import time; t0=time.time()
+import jax, jax.numpy as jnp
+(jnp.ones((256,256), jnp.bfloat16) @ jnp.ones((256,256), jnp.bfloat16)).block_until_ready()
+print(f'CLAIM OK after {time.time()-t0:.1f}s', flush=True)
+" >> "$LOG" 2>&1 && { claimed=1; break; }
+  note "claim attempt $attempt failed; sleeping 180s"
+  sleep 180
+done
+if [ "$claimed" != 1 ]; then
+  note "phase 0 FAILED — relay wedged for the whole window; giving up"
+  exit 1
+fi
+note "chip claimed — running queue 3"
+
+run() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  note "START $name"
+  timeout "$tmo" "$@" > "perf/results/$name.out" 2> "perf/results/$name.err"
+  note "END $name rc=$?"
+}
+
+# 1. Fused-xent A/B at the standard shape (dense numbers exist from queue 1).
+MODEL=lm XENT=fused run tf_lm_fusedxent 2400 python perf/bench_transformer.py
+# 2. Long context 8k: both attention impls, fused xent (xla attn may OOM).
+MODEL=lm XENT=fused LM_BATCH=2 LM_SEQ=8192 \
+    run tf_lm_8k 2400 python perf/bench_transformer.py
+# 3. The 32k flagship shape, pallas-only (xla attn cannot fit).
+MODEL=lm XENT=fused LM_BATCH=1 LM_SEQ=32768 ATTN_ONLY=pallas \
+    run tf_lm_32k 2400 python perf/bench_transformer.py
+# 4. BERT at bigger batch (43% MFU at b=128 — check b=256 headroom).
+MODEL=bert BERT_BATCH=256 run tf_bert_b256 1800 python perf/bench_transformer.py
+
+note "queue 3 complete"

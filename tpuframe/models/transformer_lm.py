@@ -266,7 +266,14 @@ class TransformerLM(nn.Module):
     cfg: LMConfig = field(default_factory=LMConfig)
 
     @nn.compact
-    def __call__(self, input_ids, *, train: bool = False):
+    def __call__(self, input_ids, *, train: bool = False,
+                 hidden_only: bool = False):
+        """``hidden_only=True`` returns the post-final-LayerNorm hidden
+        states ``[B, S, H]`` instead of logits — the input the chunked
+        fused cross-entropy (tpuframe.ops.fused_xent) consumes together
+        with the ``lm_head`` kernel, so the ``[B, S, V]`` logits never
+        materialize in HBM.  init() must run with the default full path so
+        the lm_head parameters exist."""
         c = self.cfg
         s_local = input_ids.shape[-1]
         # Global positions: offset by this device's chunk index when the
@@ -283,5 +290,7 @@ class TransformerLM(nn.Module):
             use_moe = c.moe_experts > 0 and (i + 1) % c.moe_every == 0
             x = block(c, train, use_moe, name=f"block_{i}")(x, positions)
         x = nn.LayerNorm(use_bias=False, name="final_ln")(x)
+        if hidden_only:
+            return x
         logits = nn.Dense(c.vocab_size, use_bias=False, name="lm_head")(x)
         return logits.astype(jnp.float32)

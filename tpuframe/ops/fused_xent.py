@@ -1,0 +1,201 @@
+"""Chunked, fused softmax cross-entropy over a large vocabulary.
+
+The reference computed LM/classifier losses the eager-torch way: materialize
+``logits = h @ W`` ``[T, V]``, then softmax+gather (SURVEY.md §3a model
+rows).  On TPU that is an HBM-traffic problem, not a FLOP problem: at
+B*S = 16k tokens and V = 32k, the logits tensor is 1 GB in bf16 (plus f32
+softmax intermediates, plus the same again in backward), all of it
+round-tripping HBM on a step that is already bandwidth-bound.
+
+This op computes the exact same loss with the logits never resident in HBM:
+a ``lax.scan`` over vocab chunks keeps running (max, sumexp, target-logit)
+statistics — the online-logsumexp recurrence flash attention uses along the
+key axis, applied to the vocab axis — and the backward pass recomputes each
+chunk's logits from the saved logsumexp instead of storing probabilities
+(custom VJP).  Peak extra memory is one ``[T, chunk]`` block; matmuls stay
+MXU-shaped ([T, H] x [H, chunk]).
+
+No approximation: forward losses match the naive path to accumulation
+rounding, gradients are the analytic ``(softmax - onehot)`` pulled through
+the same chunking.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DEFAULT_CHUNK = 8192
+NEG_INF = -1e30
+
+
+def _vary_like(x: jax.Array, ref: jax.Array) -> jax.Array:
+    """Match ``x``'s varying-mesh-axes to ``ref``'s so scan carries agree
+    inside ``shard_map`` (fresh zeros are unvarying; body outputs derived
+    from the sharded hidden states are varying)."""
+    want = getattr(jax.typeof(ref), "vma", frozenset())
+    have = getattr(jax.typeof(x), "vma", frozenset())
+    missing = tuple(want - have)
+    return lax.pcast(x, missing, to="varying") if missing else x
+
+
+def _pad_vocab(w: jax.Array, chunk: int) -> tuple[jax.Array, int]:
+    v = w.shape[1]
+    vp = ((v + chunk - 1) // chunk) * chunk
+    if vp != v:
+        w = jnp.pad(w, ((0, 0), (0, vp - v)))
+    return w, vp
+
+
+def _chunk_logits(h, w, c_idx, chunk, v):
+    """f32 ``[T, chunk]`` logits for one vocab chunk; padded columns and
+    (by the caller's mask) out-of-range labels read as NEG_INF."""
+    wc = lax.dynamic_slice(w, (0, c_idx * chunk), (w.shape[0], chunk))
+    s = lax.dot_general(h, wc.astype(h.dtype), (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    cols = c_idx * chunk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(cols < v, s, NEG_INF)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused(h, w, labels, chunk):
+    (loss, arg), _ = _fused_fwd(h, w, labels, chunk)
+    return loss, arg
+
+
+def _fused_fwd(h, w, labels, chunk):
+    t = h.shape[0]
+    v = w.shape[1]
+    wp, vp = _pad_vocab(w, chunk)
+    n = vp // chunk
+
+    def body(carry, c_idx):
+        m, l, tgt, arg = carry
+        s = _chunk_logits(h, wp, c_idx, chunk, v)
+        m_c = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_c)
+        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(s - m_new[:, None]),
+                                             axis=-1)
+        # argmax rides along for free (the per-chunk max is already here):
+        # the metrics companion costs no extra vocab sweep.
+        a_c = c_idx * chunk + jnp.argmax(s, axis=-1).astype(jnp.int32)
+        arg = jnp.where(m_c > m, a_c, arg)
+        loc = labels - c_idx * chunk
+        in_c = (loc >= 0) & (loc < chunk)
+        picked = jnp.take_along_axis(
+            s, jnp.clip(loc, 0, chunk - 1)[:, None], axis=-1)[:, 0]
+        tgt = tgt + jnp.where(in_c, picked, 0.0)
+        return (m_new, l, tgt, arg), None
+
+    init = tuple(_vary_like(a, h) for a in (
+        jnp.full((t,), NEG_INF, jnp.float32),
+        jnp.zeros((t,), jnp.float32),
+        jnp.zeros((t,), jnp.float32),
+        jnp.zeros((t,), jnp.int32)))
+    (m, l, tgt, arg), _ = lax.scan(body, init, jnp.arange(n))
+    lse = m + jnp.log(l)
+    loss = lse - tgt
+    return (loss, arg), (h, w, labels, lse)
+
+
+def _fused_bwd(chunk, res, g):
+    h, w, labels, lse = res
+    g = g[0]  # (loss cotangent, argmax cotangent): argmax is int, no grad
+    v = w.shape[1]
+    wp, vp = _pad_vocab(w, chunk)
+    n = vp // chunk
+
+    def body(dh, c_idx):
+        wc = lax.dynamic_slice(wp, (0, c_idx * chunk), (w.shape[0], chunk))
+        s = _chunk_logits(h, wp, c_idx, chunk, v)
+        p = jnp.exp(s - lse[:, None])                       # [T, C] f32
+        loc = labels - c_idx * chunk
+        cols = lax.broadcasted_iota(jnp.int32, p.shape, 1)
+        onehot = (cols == loc[:, None]) & (loc >= 0)[:, None]
+        gmat = ((p - onehot.astype(jnp.float32)) * g[:, None]).astype(h.dtype)
+        dh = dh + lax.dot_general(
+            gmat, wc.astype(h.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dwc = lax.dot_general(h, gmat, (((0,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        return dh, dwc
+
+    dh, dwc_stack = lax.scan(
+        body, _vary_like(jnp.zeros(h.shape, jnp.float32), h), jnp.arange(n))
+    # dwc_stack: [n_chunks, H, chunk] -> [H, Vp] -> drop padding columns.
+    dw = dwc_stack.transpose(1, 0, 2).reshape(w.shape[0], vp)[:, :v]
+    # custom_vjp bypasses shard_map's automatic transpose-psum for an
+    # unvarying (replicated) w used in a varying computation: reduce dw
+    # over the axes w lacks relative to h so its cotangent matches w's
+    # replication (total gradient = sum of per-shard token sums).  No-op
+    # outside shard_map and in the explicit pcast-varying-params mode.
+    missing = tuple(getattr(jax.typeof(h), "vma", frozenset())
+                    - getattr(jax.typeof(w), "vma", frozenset()))
+    if missing:
+        dw = lax.psum(dw, missing)
+    return dh.astype(h.dtype), dw.astype(w.dtype), None
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_softmax_xent(hidden: jax.Array, w: jax.Array, labels: jax.Array,
+                       *, chunk: int = DEFAULT_CHUNK) -> jax.Array:
+    """Per-token cross-entropy of ``softmax(hidden @ w)`` vs ``labels``.
+
+    Args:
+      hidden: ``[..., H]`` final hidden states (any float dtype; matmuls run
+        in that dtype with f32 accumulation).
+      w: ``[H, V]`` output-projection kernel (the LM head).
+      labels: ``[...]`` int targets in ``[0, V)``.
+      chunk: vocab tile width; V is internally padded up to a multiple.
+
+    Returns per-token losses with ``labels``' shape, float32.
+    """
+    loss, _ = fused_softmax_xent_and_argmax(hidden, w, labels, chunk=chunk)
+    return loss
+
+
+def fused_softmax_xent_and_argmax(
+        hidden: jax.Array, w: jax.Array, labels: jax.Array,
+        *, chunk: int = DEFAULT_CHUNK) -> tuple[jax.Array, jax.Array]:
+    """Like :func:`fused_softmax_xent` but also returns the per-token
+    argmax prediction — computed inside the same vocab sweep (the per-chunk
+    max already exists for the online logsumexp), so token accuracy costs
+    no extra pass."""
+    lead = hidden.shape[:-1]
+    hid = hidden.reshape(-1, hidden.shape[-1])
+    lab = labels.reshape(-1).astype(jnp.int32)
+    if hid.shape[0] != lab.shape[0]:
+        raise ValueError(f"hidden {hidden.shape} / labels {labels.shape} "
+                         f"token counts differ")
+    loss, arg = _fused(hid, w, lab, int(chunk))
+    return loss.reshape(lead), arg.reshape(lead)
+
+
+def chunked_argmax(hidden: jax.Array, w: jax.Array,
+                   *, chunk: int = DEFAULT_CHUNK) -> jax.Array:
+    """argmax of ``hidden @ w`` without materializing the logits — the
+    metrics companion to :func:`fused_softmax_xent` (token accuracy)."""
+    lead = hidden.shape[:-1]
+    hid = hidden.reshape(-1, hidden.shape[-1])
+    v = w.shape[1]
+    wp, vp = _pad_vocab(w, chunk)
+    n = vp // chunk
+
+    def body(carry, c_idx):
+        best, arg = carry
+        s = _chunk_logits(hid, wp, c_idx, chunk, v)
+        m = jnp.max(s, axis=-1)
+        a = c_idx * chunk + jnp.argmax(s, axis=-1).astype(jnp.int32)
+        take = m > best
+        return (jnp.where(take, m, best), jnp.where(take, a, arg)), None
+
+    init = tuple(_vary_like(a, hid) for a in (
+        jnp.full((hid.shape[0],), NEG_INF, jnp.float32),
+        jnp.zeros((hid.shape[0],), jnp.int32)))
+    (_, arg), _ = lax.scan(body, init, jnp.arange(n))
+    return arg.reshape(lead)

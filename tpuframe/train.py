@@ -210,12 +210,30 @@ def make_loss_fn(cfg: TrainConfig, model) -> step_lib.LossFn:
         aux_w = float(cfg.model_kwargs.get("moe_aux_weight", 0.01))
 
         def loss_fn(params, model_state, batch, rng):
-            logits, sown = model.apply({"params": params, **model_state},
-                                       batch["input_ids"], train=True,
-                                       rngs={"dropout": rng},
-                                       mutable=["aux_loss"])
-            loss = losses.softmax_cross_entropy(logits, batch["labels"])
-            metrics = {"accuracy": losses.accuracy(logits, batch["labels"])}
+            if cfg.fused_xent:
+                # Chunked fused head+loss: [B,S,V] logits never hit HBM
+                # (tpuframe.ops.fused_xent); the argmax for token accuracy
+                # rides in the same vocab sweep.
+                from tpuframe.ops import fused_xent as fx
+
+                hidden, sown = model.apply(
+                    {"params": params, **model_state}, batch["input_ids"],
+                    train=True, rngs={"dropout": rng},
+                    mutable=["aux_loss"], hidden_only=True)
+                w = params["lm_head"]["kernel"]
+                per_tok, pred = fx.fused_softmax_xent_and_argmax(
+                    hidden, w, batch["labels"])
+                loss = jnp.mean(per_tok)
+                acc = jnp.mean((pred == batch["labels"]).astype(jnp.float32))
+                metrics = {"accuracy": acc}
+            else:
+                logits, sown = model.apply({"params": params, **model_state},
+                                           batch["input_ids"], train=True,
+                                           rngs={"dropout": rng},
+                                           mutable=["aux_loss"])
+                loss = losses.softmax_cross_entropy(logits, batch["labels"])
+                metrics = {"accuracy": losses.accuracy(logits,
+                                                       batch["labels"])}
             aux_leaves = jax.tree.leaves(sown)
             if aux_leaves:  # MoE load-balance penalty (tpuframe.ops.moe)
                 aux = sum(aux_leaves) / len(aux_leaves)
@@ -257,6 +275,25 @@ def make_loss_fn(cfg: TrainConfig, model) -> step_lib.LossFn:
 
 def make_metric_fn(cfg: TrainConfig, model):
     if _is_lm_task(cfg):
+        if cfg.fused_xent:
+            # Eval must honor the fused path too: lm_long's eval logits
+            # would be ~4 GB f32 per 32k-token sequence otherwise.
+            from tpuframe.ops import fused_xent as fx
+
+            def metric_fn(params, model_state, batch):
+                hidden = model.apply({"params": params, **model_state},
+                                     batch["input_ids"], hidden_only=True)
+                w = params["lm_head"]["kernel"]
+                per_tok, pred = fx.fused_softmax_xent_and_argmax(
+                    hidden, w, batch["labels"])
+                loss = jnp.mean(per_tok)
+                acc = jnp.mean((pred == batch["labels"])
+                               .astype(jnp.float32))
+                return {"loss": loss, "perplexity": jnp.exp(loss),
+                        "accuracy": acc}
+
+            return metric_fn
+
         def metric_fn(params, model_state, batch):
             logits = model.apply({"params": params, **model_state},
                                  batch["input_ids"])

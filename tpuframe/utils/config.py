@@ -60,6 +60,10 @@ class TrainConfig:
     # precision
     compute_dtype: str = "float32"  # bfloat16 on real TPU runs
 
+    # LM loss path: chunked fused softmax-xent (tpuframe.ops.fused_xent) —
+    # the [B,S,V] logits never materialize in HBM.  lm_text datasets only.
+    fused_xent: bool = False
+
     # observability (SURVEY.md §5.5): TensorBoard event-file dir (gs:// ok)
     tb_dir: str | None = None
 
@@ -162,6 +166,9 @@ def _lm_long() -> TrainConfig:
         warmup_steps=200, schedule="cosine", weight_decay=0.1,
         grad_clip_norm=1.0, global_batch=8, total_steps=5000,
         eval_every=500, compute_dtype="bfloat16",
+        # 32k tokens x 32k vocab: the dense-logits loss alone is 4 GB f32
+        # per sequence — the chunked fused head keeps it out of HBM.
+        fused_xent=True,
     )
 
 
