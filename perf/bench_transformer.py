@@ -83,9 +83,10 @@ def bench_bert():
 def bench_lm(attn_impl):
     from tpuframe.models.transformer_lm import LMConfig, TransformerLM
 
+    remat = os.environ.get("REMAT", "1") == "1"
     cfg = LMConfig(vocab_size=32000, hidden_size=768, num_layers=12,
                    num_heads=12, intermediate_size=3072, max_seq=LM_SEQ,
-                   dtype="bfloat16", attn_impl=attn_impl, remat=True)
+                   dtype="bfloat16", attn_impl=attn_impl, remat=remat)
     model = TransformerLM(cfg)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, size=(LM_BATCH, LM_SEQ + 1)
@@ -118,11 +119,12 @@ def bench_lm(attn_impl):
     step = step_lib.make_train_step(loss_fn, tx, None, donate=True)
     dt = run_chain(step, state, batch)
     tok_s = LM_BATCH * LM_SEQ / dt
-    tag = f"lm(124M,{attn_impl}{',fused-xent' if fused else ''})"
+    mods = (("" if remat else ",no-remat")
+            + (",fused-xent" if fused else ""))
+    tag = f"lm(124M,{attn_impl}{mods})"
     log(f"{tag} b={LM_BATCH} s={LM_SEQ}: {dt*1e3:.1f} ms/step,"
         f" {tok_s:.0f} tokens/s")
-    return {"model": f"transformer-lm/{attn_impl}"
-                     + ("/fused-xent" if fused else ""),
+    return {"model": f"transformer-lm/{attn_impl}" + mods.replace(",", "/"),
             "batch": LM_BATCH, "seq": LM_SEQ,
             "ms_per_step": round(dt * 1e3, 1),
             "tokens_per_s": round(tok_s)}

@@ -48,5 +48,10 @@ MODEL=lm XENT=fused LM_BATCH=1 LM_SEQ=32768 ATTN_ONLY=pallas \
     run tf_lm_32k 2400 python perf/bench_transformer.py
 # 4. BERT at bigger batch (43% MFU at b=128 — check b=256 headroom).
 MODEL=bert BERT_BATCH=256 run tf_bert_b256 1800 python perf/bench_transformer.py
+# 5. remat off at the standard LM shape (activations fit at b8 s2048;
+#    saves the recompute the queue-1 number paid).
+MODEL=lm XENT=fused REMAT=0 run tf_lm_noremat 2400 python perf/bench_transformer.py
+# 6. remat-off dense for an apples-to-apples xent A/B at the same settings.
+MODEL=lm REMAT=0 run tf_lm_noremat_dense 2400 python perf/bench_transformer.py
 
 note "queue 3 complete"

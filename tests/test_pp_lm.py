@@ -85,6 +85,33 @@ def test_pp_lm_golden_losses_vs_unsharded():
     assert ref_losses[-1] < ref_losses[0]
 
 
+def test_pp_lm_fused_xent_matches_dense():
+    """fused_xent=True through the pipeline: the chunked head+loss must
+    reproduce the dense pipeline losses step for step (same init/data)."""
+    model = ScanBlockLM(_cfg())
+    batch = _data()
+    tx = optax.adamw(1e-3)
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=2, pipe=4))
+
+    def run(fused):
+        factory, place_state, place_batch = pp_lm.make_pp_lm_step(
+            model, tx, mesh, n_micro=4, fused_xent=fused)
+        ps = place_state(_init_state(model, batch, tx))
+        step = factory(ps)
+        out = []
+        pb = place_batch(batch)
+        for _ in range(3):
+            ps, m = step(ps, pb)
+            out.append((float(m["loss"]), float(m["accuracy"])))
+        return out
+
+    dense, fused = run(False), run(True)
+    np.testing.assert_allclose([l for l, _ in fused], [l for l, _ in dense],
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose([a for _, a in fused], [a for _, a in dense],
+                               atol=1e-6)
+
+
 def test_pp_lm_block_state_is_sharded():
     model = ScanBlockLM(_cfg())
     batch = _data()

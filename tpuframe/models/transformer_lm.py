@@ -206,7 +206,8 @@ class ScanBlockLM(nn.Module):
     @nn.compact
     def __call__(self, inputs, *, train: bool = False, stage: bool = False,
                  stage_layers: int | None = None,
-                 embed_only: bool = False, head_only: bool = False):
+                 embed_only: bool = False, head_only: bool = False,
+                 hidden_only: bool = False):
         c = self.cfg
         if c.seq_mode != "none" or c.moe_experts > 0:
             raise ValueError("ScanBlockLM composes with pipeline parallelism"
@@ -232,6 +233,10 @@ class ScanBlockLM(nn.Module):
             return block_stack(inputs, stage_layers)
         if head_only:
             x = nn.LayerNorm(use_bias=False, name="final_ln")(inputs)
+            if hidden_only:
+                # normed hidden states for the chunked fused loss
+                # (tpuframe.ops.fused_xent) — lm_head applied there.
+                return x
             logits = nn.Dense(c.vocab_size, use_bias=False, name="lm_head")(x)
             return logits.astype(jnp.float32)
 
@@ -241,6 +246,11 @@ class ScanBlockLM(nn.Module):
             return x
         x = block_stack(x, c.num_layers)
         x = nn.LayerNorm(use_bias=False, name="final_ln")(x)
+        if hidden_only:
+            # honor standalone hidden_only like TransformerLM does — the
+            # harness's fused-xent loss path calls it without head_only
+            # (transformer-lm-pp run on a non-pp mesh).
+            return x
         logits = nn.Dense(c.vocab_size, use_bias=False, name="lm_head")(x)
         return logits.astype(jnp.float32)
 

@@ -42,12 +42,35 @@ def state_partition(state: TrainState) -> TrainState:
     return jax.tree_util.tree_map_with_path(spec_for, state)
 
 
+def _head_loss_acc(model, fused_xent: bool, params, x_last, labels):
+    """(mean CE loss, token accuracy) from the last pipeline stage's hidden
+    states — dense head, or the chunked fused softmax-xent path
+    (tpuframe.ops.fused_xent; logits never materialize).  One definition
+    shared by the train and eval pipeline steps so the two cannot drift."""
+    if fused_xent:
+        from tpuframe.ops import fused_xent as fx
+
+        hidden = model.apply({"params": params}, x_last,
+                             head_only=True, hidden_only=True)
+        per_tok, pred = fx.fused_softmax_xent_and_argmax(
+            hidden, params["lm_head"]["kernel"], labels)
+        return (jnp.mean(per_tok),
+                jnp.mean((pred == labels).astype(jnp.float32)))
+    logits = model.apply({"params": params}, x_last, head_only=True)
+    return (losses.softmax_cross_entropy(logits, labels),
+            losses.accuracy(logits, labels))
+
+
 def make_pp_lm_step(model, tx: optax.GradientTransformation, mesh: Mesh, *,
-                    n_micro: int):
+                    n_micro: int, fused_xent: bool = False):
     """Compiled train step: ScanBlockLM forward through the microbatch
     pipeline, CE loss, one optimizer update.  Returns ``(step_fn,
     place_state, place_batch)`` where the placers put a host-built
-    TrainState / batch onto the mesh with the pp shardings."""
+    TrainState / batch onto the mesh with the pp shardings.
+
+    ``fused_xent``: compute the head + loss with the chunked fused
+    softmax-xent (tpuframe.ops.fused_xent) — the [B,S,V] logits never
+    materialize; same loss/gradients as the dense path."""
     n_stages = int(mesh.shape["pipe"])
     num_layers = model.cfg.num_layers
     if num_layers % n_stages:
@@ -71,9 +94,8 @@ def make_pp_lm_step(model, tx: optax.GradientTransformation, mesh: Mesh, *,
                 stage_layers=layers_per_stage)
             out = pp.pipeline_apply(stage_fn, params["blocks"], micro)
             x_last = pp.last_stage_value(out).reshape(x.shape)
-            logits = model.apply({"params": params}, x_last, head_only=True)
-            loss = losses.softmax_cross_entropy(logits, batch["labels"])
-            acc = losses.accuracy(logits, batch["labels"])
+            loss, acc = _head_loss_acc(model, fused_xent, params, x_last,
+                                       batch["labels"])
             return lax.pmean(loss, data_axes), acc
 
         (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -119,7 +141,8 @@ def make_pp_lm_step(model, tx: optax.GradientTransformation, mesh: Mesh, *,
     return step_fn_factory, place_state, place_batch
 
 
-def make_pp_lm_eval(model, mesh: Mesh, *, n_micro: int):
+def make_pp_lm_eval(model, mesh: Mesh, *, n_micro: int,
+                    fused_xent: bool = False):
     """Forward-only pipeline step returning mean-able eval metrics
     (tpuframe.parallel.step.make_eval_step's contract), for the harness's
     evaluate() loop on a pp-sharded state."""
@@ -137,10 +160,9 @@ def make_pp_lm_eval(model, mesh: Mesh, *, n_micro: int):
             stage_layers=layers_per_stage)
         out = pp.pipeline_apply(stage_fn, params["blocks"], micro)
         x_last = pp.last_stage_value(out).reshape(x.shape)
-        logits = model.apply({"params": params}, x_last, head_only=True)
-        loss = losses.softmax_cross_entropy(logits, batch["labels"])
-        metrics = {"loss": loss,
-                   "accuracy": losses.accuracy(logits, batch["labels"]),
+        loss, acc = _head_loss_acc(model, fused_xent, params, x_last,
+                                   batch["labels"])
+        metrics = {"loss": loss, "accuracy": acc,
                    "perplexity": jnp.exp(loss)}
         return jax.tree.map(lambda m: lax.pmean(m, data_axes), metrics)
 

@@ -153,11 +153,13 @@ def build_harness(cfg: TrainConfig) -> Harness:
         from tpuframe.parallel import pp_lm
 
         factory, place_state, _ = pp_lm.make_pp_lm_step(
-            model, tx, mesh, n_micro=cfg.pp_microbatches)
+            model, tx, mesh, n_micro=cfg.pp_microbatches,
+            fused_xent=cfg.fused_xent)
         state = place_state(state)
         train_step = factory(state)
         eval_step = pp_lm.make_pp_lm_eval(
-            model, mesh, n_micro=cfg.pp_microbatches)(state)
+            model, mesh, n_micro=cfg.pp_microbatches,
+            fused_xent=cfg.fused_xent)(state)
     else:
         state_shardings = None
         if use_sharded_state:
