@@ -54,4 +54,11 @@ MODEL=lm XENT=fused REMAT=0 run tf_lm_noremat 2400 python perf/bench_transformer
 # 6. remat-off dense for an apples-to-apples xent A/B at the same settings.
 MODEL=lm REMAT=0 run tf_lm_noremat_dense 2400 python perf/bench_transformer.py
 
-note "queue 3 complete"
+# 7. Live autotune demo: tiny budgeted sweep of the fusion knob at batch 256
+#    (short bench: 4 measure steps) — the SURVEY §3b autotune row, running.
+TPUFRAME_BENCH_BATCH=256 TPUFRAME_BENCH_STEPS=8 TPUFRAME_BENCH_WARMUP=2 \
+    run autotune_demo 2400 python -m tpuframe.obs.autotune \
+    --out perf/results/autotune_report.json --budget 4 \
+    --axis "TPUFRAME_FUSION_THRESHOLD=,0,67108864" \
+    -- python bench.py
+note "queue 3 complete (incl. autotune demo)"

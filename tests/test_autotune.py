@@ -1,0 +1,76 @@
+"""Autotune sweep driver (tpuframe.obs.autotune) — greedy coordinate
+descent over env knobs, budget handling, failed-trial tolerance, and the
+subprocess measure's JSON-line contract."""
+
+import json
+import sys
+
+from tpuframe.obs.autotune import (Axis, autotune, main, subprocess_measure)
+
+
+def test_greedy_finds_separable_optimum():
+    # value = f(batch) + g(thresh): separable, so greedy is exact.
+    scores_b = {"128": 1.0, "256": 3.0, "512": 2.0}
+    scores_t = {"": 0.5, "0": 0.1, "8": 0.9}
+
+    calls = []
+
+    def measure(env):
+        calls.append(dict(env))
+        return scores_b[env["B"]] + scores_t[env["T"]]
+
+    report = autotune(measure, [Axis("B", ["128", "256", "512"]),
+                                Axis("T", ["", "0", "8"])])
+    assert report.best_env == {"B": "256", "T": "8"}
+    assert report.best_value == 3.9
+    # baseline + 2 extra per axis = 5 trials, no duplicates wasted
+    assert len(report.trials) == 5
+    # second axis swept at the first axis's winner
+    assert all(c["B"] == "256" for c in calls[3:])
+
+
+def test_budget_caps_trials():
+    report = autotune(lambda env: float(env["X"]),
+                      [Axis("X", [str(i) for i in range(10)])], budget=4)
+    assert len(report.trials) == 4
+    assert report.best_value == 3.0  # best among the 4 tried
+
+
+def test_failed_trials_recorded_not_fatal():
+    def measure(env):
+        if env["X"] == "boom":
+            raise RuntimeError("kaboom")
+        return float(env["X"])
+
+    report = autotune(measure, [Axis("X", ["1", "boom", "5"])])
+    assert report.best_env == {"X": "5"}
+    errs = [t for t in report.trials if "error" in t]
+    assert len(errs) == 1 and "kaboom" in errs[0]["error"]
+
+
+def test_subprocess_measure_parses_json_line(tmp_path):
+    script = tmp_path / "fake_bench.py"
+    script.write_text(
+        "import json, os\n"
+        "print('noise line')\n"
+        "print(json.dumps({'metric': 'x', "
+        "'value': float(os.environ.get('KNOB', '1')) * 2}))\n")
+    m = subprocess_measure([sys.executable, str(script)])
+    assert m({"KNOB": "21"}) == 42.0
+    assert m({"KNOB": ""}) == 2.0  # '' removes the var -> default 1
+
+
+def test_cli_end_to_end(tmp_path):
+    script = tmp_path / "fake_bench.py"
+    script.write_text(
+        "import json, os\n"
+        "v = {'a': 1.0, 'b': 9.0, 'c': 4.0}[os.environ['KNOB']]\n"
+        "print(json.dumps({'value': v}))\n")
+    out = tmp_path / "report.json"
+    rc = main(["--axis", "KNOB=a,b,c", "--out", str(out), "--",
+               sys.executable, str(script)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["best_env"] == {"KNOB": "b"}
+    assert report["best_value"] == 9.0
+    assert len(report["trials"]) == 3

@@ -88,6 +88,47 @@ def test_training_decreases_loss():
     assert float(loss_fn(h, w)) < l0 * 0.5
 
 
+def test_ignore_index_masks_loss_and_grads():
+    """torch ignore_index parity: masked tokens contribute zero loss and
+    zero gradient; the dense losses helper divides by the valid count."""
+    torch = pytest.importorskip("torch")  # reference semantics, cpu
+    F = torch.nn.functional
+
+    from tpuframe.models.losses import softmax_cross_entropy
+
+    h, w, labels = _data(t=32, v=50)
+    labels = labels.at[::4].set(-100)  # every 4th token ignored
+
+    # fused: per-token zeros at masked slots, grads unaffected by them
+    per_tok = fused_softmax_xent(h, w, labels, chunk=16, ignore_index=-100)
+    assert np.all(np.asarray(per_tok)[::4] == 0.0)
+
+    def loss_fused(h, w):
+        pt = fused_softmax_xent(h, w, labels, chunk=16, ignore_index=-100)
+        return jnp.sum(pt) / jnp.sum(labels != -100)
+
+    gh, gw = jax.grad(loss_fused, argnums=(0, 1))(h, w)
+
+    # torch reference on identical values
+    ht = torch.tensor(np.asarray(h), requires_grad=True)
+    wt = torch.tensor(np.asarray(w), requires_grad=True)
+    loss_t = F.cross_entropy(ht @ wt, torch.tensor(np.asarray(labels),
+                                                   dtype=torch.long),
+                             ignore_index=-100)
+    loss_t.backward()
+    np.testing.assert_allclose(float(loss_fused(h, w)), float(loss_t),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gh), ht.grad.numpy(),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gw), wt.grad.numpy(),
+                               rtol=2e-5, atol=2e-5)
+
+    # dense helper: same value as torch's mean reduction
+    dense = softmax_cross_entropy(h @ w, labels, ignore_index=-100)
+    np.testing.assert_allclose(float(dense), float(loss_t),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_chunked_argmax_matches_naive():
     h, w, _ = _data()
     got = chunked_argmax(h, w, chunk=16)

@@ -14,18 +14,30 @@ import optax
 
 
 def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
-                          label_smoothing: float = 0.0) -> jax.Array:
+                          label_smoothing: float = 0.0,
+                          ignore_index: int | None = None) -> jax.Array:
     """Mean CE over the batch; integer labels. ImageNet configs use
-    ``label_smoothing=0.1`` (standard ResNet-50 recipe)."""
+    ``label_smoothing=0.1`` (standard ResNet-50 recipe).
+
+    ``ignore_index``: torch ``F.cross_entropy(ignore_index=...)`` parity —
+    tokens with that label contribute neither loss nor gradient, and the
+    mean divides by the VALID count (matching torch's 'mean' reduction)."""
     num_classes = logits.shape[-1]
+    safe_labels = labels
+    if ignore_index is not None:
+        safe_labels = jnp.where(labels == ignore_index, 0, labels)
     if label_smoothing > 0.0:
         on = 1.0 - label_smoothing
         off = label_smoothing / (num_classes - 1)
-        soft = jax.nn.one_hot(labels, num_classes) * (on - off) + off
+        soft = jax.nn.one_hot(safe_labels, num_classes) * (on - off) + off
         loss = optax.softmax_cross_entropy(logits, soft)
     else:
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-    return jnp.mean(loss)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits,
+                                                               safe_labels)
+    if ignore_index is None:
+        return jnp.mean(loss)
+    valid = (labels != ignore_index).astype(loss.dtype)
+    return jnp.sum(loss * valid) / jnp.maximum(jnp.sum(valid), 1.0)
 
 
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:

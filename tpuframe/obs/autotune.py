@@ -1,0 +1,194 @@
+"""Autotune — the runnable equivalent of Horovod's Bayesian knob tuner.
+
+Horovod autotunes ``HOROVOD_FUSION_THRESHOLD`` / ``HOROVOD_CYCLE_TIME`` at
+runtime inside its C++ coordinator (SURVEY.md §3b, optional row).  Under
+XLA the tunable surface is compile-time env knobs, and because every trial
+is a fresh compiled program, the right tool is an out-of-process sweep:
+run the benchmark once per candidate setting, keep what measures fastest.
+
+This module implements greedy coordinate descent over declared knob axes —
+measure a baseline, then sweep one axis at a time keeping the best value
+found so far (the same one-factor-at-a-time structure Horovod's tuner
+reduces to for independent knobs, minus the Bayesian prior; with ~4 values
+per axis the full greedy pass is ~a dozen trials and needs no prior).
+
+Library use (any measure function) and CLI:
+
+    python -m tpuframe.obs.autotune --out report.json \
+        --axis TPUFRAME_BENCH_BATCH=128,256,512,1024 \
+        --axis TPUFRAME_FUSION_THRESHOLD=,0,8388608,67108864 \
+        -- python bench.py
+
+The command must print one JSON line with a ``value`` field (bench.py's
+contract); higher is better.  The report records every trial, the winning
+env, and the winning value; ``--apply`` re-echoes the winning env as shell
+exports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+Measure = Callable[[dict], float]  # env overrides -> metric (higher better)
+
+
+@dataclass
+class Axis:
+    """One tunable knob: env var name + candidate values ('' = unset)."""
+
+    name: str
+    values: list[str]
+
+    @classmethod
+    def parse(cls, spec: str) -> "Axis":
+        if "=" not in spec:
+            raise ValueError(f"axis spec {spec!r} is not NAME=v1,v2,...")
+        name, vals = spec.split("=", 1)
+        return cls(name=name, values=vals.split(","))
+
+
+@dataclass
+class Report:
+    trials: list[dict] = field(default_factory=list)
+    best_env: dict = field(default_factory=dict)
+    best_value: float = float("-inf")
+
+    def as_dict(self) -> dict:
+        # None when every trial failed: -inf would serialize as the
+        # non-standard -Infinity and break strict JSON consumers.
+        best = (None if self.best_value == float("-inf")
+                else self.best_value)
+        return {"trials": self.trials, "best_env": self.best_env,
+                "best_value": best}
+
+
+def autotune(measure: Measure, axes: list[Axis], *,
+             budget: int | None = None, log=None) -> Report:
+    """Greedy coordinate descent: baseline with every axis at its first
+    value, then per axis try the remaining values, keeping the argmax.
+    ``budget`` caps total measurements; ``measure`` exceptions record the
+    trial as failed (value -inf) and the sweep continues."""
+    report = Report()
+    env = {a.name: a.values[0] for a in axes}
+    spent = 0
+
+    def run(env_now: dict) -> float:
+        nonlocal spent
+        if budget is not None and spent >= budget:
+            raise _BudgetExhausted
+        spent += 1
+        t0 = time.time()
+        try:
+            value = float(measure(dict(env_now)))
+            err = None
+        except _BudgetExhausted:
+            raise
+        except Exception as e:  # noqa: BLE001 — a failed trial is data
+            value, err = float("-inf"), f"{type(e).__name__}: {e}"[:200]
+        # None (JSON null) for failed trials: float('-inf') would make
+        # the report file invalid JSON (-Infinity).
+        trial = {"env": dict(env_now),
+                 "value": None if err else value,
+                 "seconds": round(time.time() - t0, 1)}
+        if err:
+            trial["error"] = err
+        report.trials.append(trial)
+        if log:
+            log(f"trial {env_now} -> {value}"
+                + (f" ({err})" if err else ""))
+        if value > report.best_value:
+            report.best_value = value
+            report.best_env = dict(env_now)
+        return value
+
+    try:
+        best = run(env)
+        for axis in axes:
+            best_val = env[axis.name]
+            for v in axis.values[1:]:
+                candidate = dict(env, **{axis.name: v})
+                got = run(candidate)
+                if got > best:
+                    best, best_val = got, v
+            env[axis.name] = best_val  # greedy: keep the winner, move on
+    except _BudgetExhausted:
+        if log:
+            log(f"budget {budget} exhausted after {spent} trials")
+    return report
+
+
+class _BudgetExhausted(Exception):
+    pass
+
+
+def subprocess_measure(argv: list[str], *, timeout: float = 1800) -> Measure:
+    """A Measure that runs ``argv`` with env overrides applied ('' =
+    remove) and parses the last stdout line that is a JSON object with a
+    ``value`` field — bench.py's output contract."""
+
+    def measure(overrides: dict) -> float:
+        env = dict(os.environ)
+        for k, v in overrides.items():
+            if v == "":
+                env.pop(k, None)
+            else:
+                env[k] = v
+        proc = subprocess.run(argv, env=env, capture_output=True, text=True,
+                              timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(f"rc={proc.returncode}: "
+                               f"{proc.stderr[-300:]}")
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "value" in obj:
+                return float(obj["value"])
+        raise RuntimeError("no JSON line with a 'value' field on stdout")
+
+    return measure
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="greedy env-knob autotune over a benchmark command")
+    ap.add_argument("--axis", action="append", default=[],
+                    help="NAME=v1,v2,... (repeatable; '' value = unset)")
+    ap.add_argument("--out", default="autotune_report.json")
+    ap.add_argument("--budget", type=int, default=None)
+    ap.add_argument("--timeout", type=float, default=1800)
+    ap.add_argument("--apply", action="store_true",
+                    help="print the winning env as shell exports")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="-- benchmark command (prints a JSON 'value' line)")
+    args = ap.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        ap.error("no benchmark command given (after --)")
+    if not args.axis:
+        ap.error("at least one --axis required")
+
+    axes = [Axis.parse(s) for s in args.axis]
+    log = lambda m: print(f"[autotune] {m}", file=sys.stderr, flush=True)  # noqa: E731
+    report = autotune(subprocess_measure(cmd, timeout=args.timeout), axes,
+                      budget=args.budget, log=log)
+    with open(args.out, "w") as f:
+        json.dump(report.as_dict(), f, indent=1)
+    log(f"best {report.best_value} with {report.best_env}; "
+        f"report -> {args.out}")
+    if args.apply:
+        for k, v in report.best_env.items():
+            print(f"export {k}={v!r}" if v else f"unset {k}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

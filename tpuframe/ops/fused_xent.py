@@ -97,7 +97,9 @@ def _fused_fwd(h, w, labels, chunk):
         jnp.zeros((t,), jnp.int32)))
     (m, l, tgt, arg), _ = lax.scan(body, init, jnp.arange(n))
     lse = m + jnp.log(l)
-    loss = lse - tgt
+    # labels < 0 mark ignored tokens (ignore_index is remapped to -1 by the
+    # public wrappers): zero loss here, zero gradient in _fused_bwd.
+    loss = jnp.where(labels >= 0, lse - tgt, 0.0)
     return (loss, arg), (h, w, labels, lse)
 
 
@@ -115,7 +117,8 @@ def _fused_bwd(chunk, res, g):
         loc = labels - c_idx * chunk
         cols = lax.broadcasted_iota(jnp.int32, p.shape, 1)
         onehot = (cols == loc[:, None]) & (loc >= 0)[:, None]
-        gmat = ((p - onehot.astype(jnp.float32)) * g[:, None]).astype(h.dtype)
+        gvec = jnp.where(labels >= 0, g, 0.0)  # ignored tokens: no gradient
+        gmat = ((p - onehot.astype(jnp.float32)) * gvec[:, None]).astype(h.dtype)
         dh = dh + lax.dot_general(
             gmat, wc.astype(h.dtype), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -143,7 +146,8 @@ _fused.defvjp(_fused_fwd, _fused_bwd)
 
 
 def fused_softmax_xent(hidden: jax.Array, w: jax.Array, labels: jax.Array,
-                       *, chunk: int = DEFAULT_CHUNK) -> jax.Array:
+                       *, chunk: int = DEFAULT_CHUNK,
+                       ignore_index: int | None = None) -> jax.Array:
     """Per-token cross-entropy of ``softmax(hidden @ w)`` vs ``labels``.
 
     Args:
@@ -152,16 +156,22 @@ def fused_softmax_xent(hidden: jax.Array, w: jax.Array, labels: jax.Array,
       w: ``[H, V]`` output-projection kernel (the LM head).
       labels: ``[...]`` int targets in ``[0, V)``.
       chunk: vocab tile width; V is internally padded up to a multiple.
+      ignore_index: torch ``F.cross_entropy(ignore_index=...)`` parity —
+        tokens with that label get zero loss AND zero gradient.  Their
+        per-token entries are 0; for torch's 'mean' reduction divide the
+        sum by the valid count (``(labels != ignore_index).sum()``).
 
     Returns per-token losses with ``labels``' shape, float32.
     """
-    loss, _ = fused_softmax_xent_and_argmax(hidden, w, labels, chunk=chunk)
+    loss, _ = fused_softmax_xent_and_argmax(hidden, w, labels, chunk=chunk,
+                                            ignore_index=ignore_index)
     return loss
 
 
 def fused_softmax_xent_and_argmax(
         hidden: jax.Array, w: jax.Array, labels: jax.Array,
-        *, chunk: int = DEFAULT_CHUNK) -> tuple[jax.Array, jax.Array]:
+        *, chunk: int = DEFAULT_CHUNK,
+        ignore_index: int | None = None) -> tuple[jax.Array, jax.Array]:
     """Like :func:`fused_softmax_xent` but also returns the per-token
     argmax prediction — computed inside the same vocab sweep (the per-chunk
     max already exists for the online logsumexp), so token accuracy costs
@@ -169,11 +179,33 @@ def fused_softmax_xent_and_argmax(
     lead = hidden.shape[:-1]
     hid = hidden.reshape(-1, hidden.shape[-1])
     lab = labels.reshape(-1).astype(jnp.int32)
+    if ignore_index is not None:
+        # the kernel's internal ignore convention is negative labels
+        lab = jnp.where(lab == ignore_index, -1, lab)
     if hid.shape[0] != lab.shape[0]:
         raise ValueError(f"hidden {hidden.shape} / labels {labels.shape} "
                          f"token counts differ")
     loss, arg = _fused(hid, w, lab, int(chunk))
     return loss.reshape(lead), arg.reshape(lead)
+
+
+def mean_xent_and_accuracy(hidden: jax.Array, w: jax.Array,
+                           labels: jax.Array, *,
+                           chunk: int = DEFAULT_CHUNK,
+                           ignore_index: int | None = None
+                           ) -> tuple[jax.Array, jax.Array]:
+    """(mean loss, token accuracy) through the fused head — the one shared
+    definition the harness loss/metric fns and the pipeline step all call,
+    so train and eval math cannot drift.  With ``ignore_index`` both the
+    loss mean and the accuracy divide by the valid-token count."""
+    per_tok, pred = fused_softmax_xent_and_argmax(
+        hidden, w, labels, chunk=chunk, ignore_index=ignore_index)
+    hit = (pred == labels).astype(jnp.float32)
+    if ignore_index is None:
+        return jnp.mean(per_tok), jnp.mean(hit)
+    valid = (labels != ignore_index).astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(valid), 1.0)
+    return jnp.sum(per_tok) / denom, jnp.sum(hit * valid) / denom
 
 
 def chunked_argmax(hidden: jax.Array, w: jax.Array,

@@ -52,10 +52,8 @@ def _head_loss_acc(model, fused_xent: bool, params, x_last, labels):
 
         hidden = model.apply({"params": params}, x_last,
                              head_only=True, hidden_only=True)
-        per_tok, pred = fx.fused_softmax_xent_and_argmax(
+        return fx.mean_xent_and_accuracy(
             hidden, params["lm_head"]["kernel"], labels)
-        return (jnp.mean(per_tok),
-                jnp.mean((pred == labels).astype(jnp.float32)))
     logits = model.apply({"params": params}, x_last, head_only=True)
     return (losses.softmax_cross_entropy(logits, labels),
             losses.accuracy(logits, labels))

@@ -222,11 +222,8 @@ def make_loss_fn(cfg: TrainConfig, model) -> step_lib.LossFn:
                     {"params": params, **model_state}, batch["input_ids"],
                     train=True, rngs={"dropout": rng},
                     mutable=["aux_loss"], hidden_only=True)
-                w = params["lm_head"]["kernel"]
-                per_tok, pred = fx.fused_softmax_xent_and_argmax(
-                    hidden, w, batch["labels"])
-                loss = jnp.mean(per_tok)
-                acc = jnp.mean((pred == batch["labels"]).astype(jnp.float32))
+                loss, acc = fx.mean_xent_and_accuracy(
+                    hidden, params["lm_head"]["kernel"], batch["labels"])
                 metrics = {"accuracy": acc}
             else:
                 logits, sown = model.apply({"params": params, **model_state},
@@ -285,12 +282,8 @@ def make_metric_fn(cfg: TrainConfig, model):
             def metric_fn(params, model_state, batch):
                 hidden = model.apply({"params": params, **model_state},
                                      batch["input_ids"], hidden_only=True)
-                w = params["lm_head"]["kernel"]
-                per_tok, pred = fx.fused_softmax_xent_and_argmax(
-                    hidden, w, batch["labels"])
-                loss = jnp.mean(per_tok)
-                acc = jnp.mean((pred == batch["labels"])
-                               .astype(jnp.float32))
+                loss, acc = fx.mean_xent_and_accuracy(
+                    hidden, params["lm_head"]["kernel"], batch["labels"])
                 return {"loss": loss, "perplexity": jnp.exp(loss),
                         "accuracy": acc}
 
