@@ -56,9 +56,13 @@ MODEL=lm REMAT=0 run tf_lm_noremat_dense 2400 python perf/bench_transformer.py
 
 # 7. Live autotune demo: tiny budgeted sweep of the fusion knob at batch 256
 #    (short bench: 4 measure steps) — the SURVEY §3b autotune row, running.
+#    Per-trial timeout 900s < wrapper 4200s so a slow trial is dropped by
+#    the sweep (recorded as failed) instead of the wrapper killing the whole
+#    run before the report is written.
 TPUFRAME_BENCH_BATCH=256 TPUFRAME_BENCH_STEPS=8 TPUFRAME_BENCH_WARMUP=2 \
-    run autotune_demo 2400 python -m tpuframe.obs.autotune \
-    --out perf/results/autotune_report.json --budget 4 \
+    TPUFRAME_BENCH_BUDGET_S=850 \
+    run autotune_demo 4200 python -m tpuframe.obs.autotune \
+    --out perf/results/autotune_report.json --budget 4 --timeout 900 \
     --axis "TPUFRAME_FUSION_THRESHOLD=,0,67108864" \
     -- python bench.py
 note "queue 3 complete (incl. autotune demo)"

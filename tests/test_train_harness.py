@@ -74,6 +74,17 @@ class TestEndToEnd:
         np.testing.assert_allclose(straight["loss"], part2["loss"],
                                    rtol=1e-4)
 
+    def test_smoke_lars_optimizer_learns(self):
+        """LARS (the large-batch ImageNet scaling recipe): layerwise
+        trust-ratio optimizer runs through the harness and decreases
+        loss; BN/bias leaves excluded from decay+adaptation."""
+        cfg = get_config("smoke").with_overrides(
+            distributed=False, optimizer="lars", base_lr=1.0,
+            weight_decay=1e-4, total_steps=40, log_every=20, eval_every=100)
+        metrics = train_mod.train(cfg)
+        assert metrics["step"] == 40
+        assert np.isfinite(metrics["loss"]) and metrics["loss"] < 2.0
+
     def test_cifar_resnet18_steps(self):
         cfg = get_config("cifar10_resnet18").with_overrides(
             total_steps=3, global_batch=16, warmup_steps=1, log_every=1,

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import subprocess
 import sys
@@ -151,7 +152,12 @@ def subprocess_measure(argv: list[str], *, timeout: float = 1800) -> Measure:
             except json.JSONDecodeError:
                 continue
             if isinstance(obj, dict) and "value" in obj:
-                return float(obj["value"])
+                value = float(obj["value"])
+                if not math.isfinite(value):
+                    # json.loads accepts NaN/Infinity; recording them would
+                    # re-break the strict-JSON report this module guards.
+                    raise RuntimeError(f"non-finite benchmark value {value}")
+                return value
         raise RuntimeError("no JSON line with a 'value' field on stdout")
 
     return measure
@@ -184,6 +190,9 @@ def main(argv: list[str] | None = None) -> int:
         json.dump(report.as_dict(), f, indent=1)
     log(f"best {report.best_value} with {report.best_env}; "
         f"report -> {args.out}")
+    if report.best_value == float("-inf"):
+        log("every trial failed — exiting nonzero")
+        return 1
     if args.apply:
         for k, v in report.best_env.items():
             print(f"export {k}={v!r}" if v else f"unset {k}")

@@ -33,7 +33,7 @@ class TrainConfig:
     shard_seq: bool = False         # shard batch seq dim over the seq axis
 
     # optimization
-    optimizer: str = "sgd"          # sgd | adamw
+    optimizer: str = "sgd"          # sgd | adamw | lars (large-batch)
     base_lr: float = 0.1            # per-256-examples; scaled by global batch
     scale_lr_by_batch: bool = True  # the hvd.size() linear-scaling rule
     warmup_steps: int = 0

@@ -63,6 +63,16 @@ def build_optimizer(cfg: TrainConfig, params=None) -> optax.GradientTransformati
         parts.append(optax.adamw(
             sched, weight_decay=cfg.weight_decay,
             mask=_decay_mask(params) if params is not None else None))
+    elif cfg.optimizer == "lars":
+        # Large-batch ResNet scaling (the You et al. recipe the
+        # Horovod/MLPerf-era ImageNet runs used beyond ~8k global batch):
+        # layerwise trust-ratio adaptation; biases/BN params excluded from
+        # both adaptation and weight decay, as standard.
+        mask = _decay_mask(params) if params is not None else True
+        parts.append(optax.lars(
+            sched, weight_decay=cfg.weight_decay,
+            weight_decay_mask=mask, trust_ratio_mask=mask,
+            momentum=cfg.momentum, nesterov=False))
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     return optax.chain(*parts)
