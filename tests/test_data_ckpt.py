@@ -231,6 +231,31 @@ class TestCheckpoint:
         mgr = ckpt.CheckpointManager(str(tmp_path))
         assert mgr.restore_latest() is None
 
+    def test_async_save_commits_and_roundtrips(self, tmp_path, mesh8):
+        """async_write: save() returns before COMMIT; wait_pending() makes
+        every queued save durable, in order, with retention applied; the
+        snapshot is immune to the live tree changing after save()."""
+        state = _toy_state(mesh8)
+        mgr = ckpt.CheckpointManager(str(tmp_path), every_steps=10, keep=2,
+                                     async_write=True)
+        saved_w = np.array(np.asarray(state.params["w"]), copy=True)
+        for step in (10, 20, 30):
+            mgr.maybe_save(step, state)
+            # mutate the live tree right after the snapshot — the async
+            # writer must not see this (copy-on-prepare contract)
+            state = jax.tree_util.tree_map(
+                lambda a: a + 1.0
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, state)
+        mgr.wait_pending()
+        names = sorted(p.name for p in tmp_path.iterdir())
+        assert names == ["step_00000020", "step_00000030"]  # keep=2
+        assert (tmp_path / "step_00000030" / "COMMIT").exists()
+        step, restored = mgr.restore_latest(mesh=mesh8,
+                                            target=_toy_state(mesh8))
+        assert step == 30
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]),
+                                      saved_w + 2.0)  # state at save #3
+
 
 def chex_all_equal_structs(a, b):
     ja = jax.tree_util.tree_structure(a)

@@ -163,6 +163,40 @@ def test_replicated_restore_reads_storage_only_on_primary(tmp_path):
 
 
 @pytest.mark.slow
+def test_async_save_multihost_polling_finalize(tmp_path):
+    """async_write on a 2-host cluster: no barrier anywhere in the save
+    path — process 0's background worker finalizes by polling the other
+    host's CRC sidecar; COMMIT appears, restore round-trips."""
+    script = textwrap.dedent("""
+        import jax, numpy as np
+        from tpuframe.parallel import bootstrap, mesh as mesh_lib
+        bootstrap.initialize()
+        mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=4))
+        from tpuframe.ckpt import checkpoint as ck
+        repl = mesh_lib.replicated_sharding(mesh)
+        w = np.arange(8, dtype=np.float32)
+        state = {"w": mesh_lib.host_device_put(w, repl)}
+        mgr = ck.CheckpointManager(%(d)r, every_steps=1, async_write=True)
+        mgr.save(1, state)
+        mgr.save(2, state)
+        mgr.wait_pending()
+        # every process must see the committed result
+        import os, time
+        for _ in range(100):
+            if os.path.exists(%(d)r + "/step_00000002/COMMIT"):
+                break
+            time.sleep(0.1)
+        step, out = mgr.restore_latest(mesh=mesh, target=state)
+        assert step == 2, step
+        np.testing.assert_array_equal(np.asarray(out["w"]), w)
+        print("rank", jax.process_index(), "ASYNC_OK")
+    """) % {"d": str(tmp_path / "ack")}
+    results = LocalCluster(2, 2, timeout=420).launch(
+        [sys.executable, "-c", script])
+    assert all("ASYNC_OK" in r.stdout for r in results)
+
+
+@pytest.mark.slow
 def test_many_leaf_replicated_restore_no_deadlock(tmp_path):
     """Regression: per-leaf broadcast restore deadlocked once the tree had
     enough leaves for the placeholder ranks to race ~30 collective programs

@@ -29,6 +29,8 @@ import io
 import json
 import os
 import re
+import threading
+import time
 from typing import Any
 
 import jax
@@ -88,11 +90,21 @@ def _spec_of(leaf) -> list:
     return []
 
 
-def save(directory: str, step: int, tree: PyTree) -> str:
-    """Write one checkpoint; returns its path. Collective: every process must
-    call it (each writes the shards it owns)."""
+def _prepare_save(directory: str, step: int, tree: PyTree):
+    """Synchronous part of a save: device->host snapshots of every owned
+    shard + the manifest.  After this returns, the live tree may keep
+    training — the returned numpy buffers are immutable copies."""
     path = gcs.join(directory, f"step_{step:08d}")
     gcs.makedirs(path)
+    # A torn prior save of this SAME step (crash between sidecar and
+    # COMMIT, then retrain back to step N) leaves a stale sidecar that the
+    # async finalizer's poll would trust; every host deletes its own here,
+    # synchronously, before any worker can poll.  Residual skew races are
+    # backstopped by restore's CRC verification (stale sidecar + new files
+    # fails loudly, never silently corrupts).
+    stale = gcs.join(path, f"crc_{jax.process_index()}.json")
+    if gcs.exists(stale):
+        gcs.delete(stale)
     names, leaves, treedef = _flatten_with_paths(tree)
 
     del treedef  # structure is recorded as the ordered leaf-name list; restore
@@ -107,8 +119,7 @@ def save(directory: str, step: int, tree: PyTree) -> str:
         # with whatever the writer recorded.
         "crc_algo": "crc32c",
     }
-
-    crc_local: dict[str, int] = {}
+    owned_files: list[tuple[str, np.ndarray]] = []
     for name, leaf in zip(names, leaves):
         arr = leaf if isinstance(leaf, jax.Array) else jnp_asarray(leaf)
         prng_impl = None
@@ -126,28 +137,65 @@ def save(directory: str, step: int, tree: PyTree) -> str:
         }
         if prng_impl is not None:
             entry["prng_impl"] = prng_impl
-        for fname, data in owned:
-            buf = io.BytesIO()
-            np.save(buf, data)
-            raw = buf.getvalue()
-            gcs.write_bytes(gcs.join(path, fname), raw)
-            crc_local[fname] = _crc32(raw)
+        owned_files.extend(owned)
         manifest["leaves"][name] = entry
+    return path, manifest, owned_files
 
-    # CRCs are per-file and known only to the writer; persist per-host CRC
-    # sidecars, merged into the manifest by process 0 after the barrier.
+
+def _write_owned(path: str, owned_files) -> dict:
+    """Serialize + write this host's shard files; returns fname->crc and
+    writes the per-host CRC sidecar (each host's LAST artifact — its
+    existence means this host's files are durably written)."""
+    crc_local: dict[str, int] = {}
+    for fname, data in owned_files:
+        buf = io.BytesIO()
+        np.save(buf, data)
+        raw = buf.getvalue()
+        gcs.write_bytes(gcs.join(path, fname), raw)
+        crc_local[fname] = _crc32(raw)
     gcs.write_bytes(gcs.join(path, f"crc_{jax.process_index()}.json"),
                     json.dumps(crc_local).encode())
+    return crc_local
+
+
+def _finalize(path: str, manifest: dict, *, poll: bool,
+              timeout_s: float = 600.0) -> None:
+    """Process 0 merges every host's CRC sidecar and writes manifest+COMMIT.
+
+    ``poll=False``: callers already synchronized (the sync save's barrier).
+    ``poll=True``: wait for the sidecar files to appear instead — the async
+    path runs off the main thread, where a collective barrier could
+    interleave with the training loop's collectives (the exact ordering
+    hazard the packed-broadcast restore exists to avoid).  Sidecar files
+    are each host's last write, so their presence == that host finished.
+    On timeout the checkpoint is left torn (no COMMIT) — exactly what the
+    restore-side torn protection already handles."""
+    if jax.process_index() != 0:
+        return
+    deadline = time.time() + timeout_s
+    crc: dict[str, int] = {}
+    for i in range(jax.process_count()):
+        sidecar = gcs.join(path, f"crc_{i}.json")
+        while poll and not gcs.exists(sidecar):
+            if time.time() > deadline:
+                print(f"[ckpt] finalize timeout: host {i} sidecar missing; "
+                      f"leaving {path} uncommitted", flush=True)
+                return
+            time.sleep(0.2)
+        crc.update(json.loads(gcs.read_bytes(sidecar)))
+    manifest["crc"] = crc
+    gcs.write_bytes(gcs.join(path, _MANIFEST),
+                    json.dumps(manifest, indent=1).encode())
+    gcs.write_bytes(gcs.join(path, _COMMIT), b"ok")
+
+
+def save(directory: str, step: int, tree: PyTree) -> str:
+    """Write one checkpoint; returns its path. Collective: every process must
+    call it (each writes the shards it owns)."""
+    path, manifest, owned_files = _prepare_save(directory, step, tree)
+    _write_owned(path, owned_files)
     _barrier()
-    if jax.process_index() == 0:
-        crc: dict[str, int] = {}
-        for i in range(jax.process_count()):
-            crc.update(json.loads(
-                gcs.read_bytes(gcs.join(path, f"crc_{i}.json"))))
-        manifest["crc"] = crc
-        gcs.write_bytes(gcs.join(path, _MANIFEST),
-                        json.dumps(manifest, indent=1).encode())
-        gcs.write_bytes(gcs.join(path, _COMMIT), b"ok")
+    _finalize(path, manifest, poll=False)
     return path
 
 
@@ -173,7 +221,9 @@ def _shard_table(arr, base: str):
     host writes each file.
     """
     if not isinstance(arr, jax.Array) or not hasattr(arr, "global_shards"):
-        data = np.asarray(arr)
+        # copy=True: np.asarray may ALIAS an XLA buffer on the CPU backend,
+        # and async saves must survive the live tree being donated/updated.
+        data = np.array(arr, copy=True)
         fname = f"{base}.shard_0.npy"
         return ([{"id": 0, "index": None, "file": fname}],
                 [(fname, data)] if jax.process_index() == 0 else [])
@@ -190,7 +240,7 @@ def _shard_table(arr, base: str):
         if shard.device.process_index == jax.process_index():
             local = next(s for s in arr.addressable_shards
                          if _index_key(s.index, arr.shape) == key)
-            owned.append((fname, np.asarray(local.data)))
+            owned.append((fname, np.array(local.data, copy=True)))
     return table, owned
 
 
@@ -464,22 +514,92 @@ def latest_step(directory: str) -> int | None:
 
 class CheckpointManager:
     """Periodic save + retention + resume-latest (reference parity: the
-    checkpoint hooks + resume-from-bucket path, SURVEY.md §3a/§4.4)."""
+    checkpoint hooks + resume-from-bucket path, SURVEY.md §3a/§4.4).
+
+    ``async_write=True``: ``save()`` snapshots device state synchronously
+    (device->host copies of this host's owned shards) and returns; file
+    serialization, upload, and the COMMIT land on a single background
+    worker thread, so the train loop never waits on storage.  Cross-host
+    finalization uses sidecar-file polling instead of a collective barrier
+    — background threads must never issue collectives (ordering hazard vs
+    the main loop's compiled steps).  One worker == saves stay ordered;
+    call ``wait_pending()`` before reading the latest checkpoint back or
+    exiting."""
 
     def __init__(self, directory: str, *, every_steps: int = 1000,
-                 keep: int = 3):
+                 keep: int = 3, async_write: bool = False):
         self.directory = directory
         self.every_steps = every_steps
         self.keep = keep
+        self.async_write = async_write
+        self._pending: list[threading.Thread] = []
+        self._errors: list[str] = []
         gcs.makedirs(directory)
 
     def should_save(self, step: int) -> bool:
         return step > 0 and step % self.every_steps == 0
 
     def save(self, step: int, tree: PyTree) -> str:
-        path = save(self.directory, step, tree)
-        self._gc()
+        if not self.async_write:
+            path = save(self.directory, step, tree)
+            self._gc()
+            return path
+        path, manifest, owned_files = _prepare_save(self.directory, step,
+                                                    tree)
+        # Backpressure: each queued save holds a full host-RAM snapshot.
+        # Cap the backlog at 2 (one writing + one queued) — beyond that,
+        # block briefly on the oldest instead of accumulating snapshots
+        # until the host OOMs; and prune finished workers (only the newest
+        # is needed for ordering).
+        self._pending = [t for t in self._pending if t.is_alive()]
+        while len(self._pending) >= 2:
+            self._pending[0].join()
+            self._pending = [t for t in self._pending if t.is_alive()]
+        prev = self._pending[-1] if self._pending else None
+
+        def work():
+            try:
+                if prev is not None:
+                    prev.join()  # saves commit in order
+                _write_owned(path, owned_files)
+                _finalize(path, manifest, poll=True)
+                self._gc()
+            except Exception as e:  # noqa: BLE001 — surfaced by wait_pending
+                self._errors.append(f"save step {step}: "
+                                    f"{type(e).__name__}: {e}")
+
+        t = threading.Thread(target=work, name=f"ckpt-save-{step}",
+                             daemon=True)
+        self._pending.append(t)
+        self._last_path = path
+        t.start()
         return path
+
+    def wait_pending(self, *, commit_timeout_s: float = 600.0) -> None:
+        """Block until every async save has committed (no-op when sync).
+
+        Joining the local worker only proves THIS host's writes are done;
+        the COMMIT marker comes from process 0's worker, so every other
+        host additionally polls for it — after this returns, the newest
+        checkpoint is durably visible to all hosts (or the timeout left it
+        torn, which restore already tolerates)."""
+        for t in self._pending:
+            t.join()
+        self._pending.clear()
+        if self._errors:
+            errs = "; ".join(self._errors)
+            self._errors = []
+            raise RuntimeError(f"async checkpoint save(s) failed: {errs}")
+        last = getattr(self, "_last_path", None)
+        if last is None or jax.process_index() == 0:
+            return
+        deadline = time.time() + commit_timeout_s
+        while not gcs.exists(gcs.join(last, _COMMIT)):
+            if time.time() > deadline:
+                print(f"[ckpt] wait_pending: no COMMIT at {last} after "
+                      f"{commit_timeout_s}s", flush=True)
+                return
+            time.sleep(0.2)
 
     def maybe_save(self, step: int, tree: PyTree) -> str | None:
         return self.save(step, tree) if self.should_save(step) else None
@@ -496,9 +616,16 @@ class CheckpointManager:
     def _gc(self) -> None:
         if jax.process_index() != 0:
             return
+        # Committed checkpoints only: an uncommitted dir may be an IN-FLIGHT
+        # async save (another host mid-write) — deleting it would corrupt a
+        # checkpoint about to gain its COMMIT.  Torn crash leftovers are
+        # therefore never GC'd here; they are bounded by crash count,
+        # ignored by resume, and overwritten if the job retrains to the
+        # same step.
         steps = sorted(
             int(m.group(1))
             for m in (_STEP_RE.match(n) for n in gcs.listdir(self.directory))
-            if m)
+            if m and gcs.exists(gcs.join(self.directory, m.group(0),
+                                         _COMMIT)))
         for old in steps[:-self.keep] if self.keep > 0 else []:
             gcs.delete_tree(gcs.join(self.directory, f"step_{old:08d}"))

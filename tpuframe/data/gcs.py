@@ -82,6 +82,20 @@ def makedirs(path: str) -> None:
         Path(path).mkdir(parents=True, exist_ok=True)
 
 
+def delete(path: str) -> None:
+    """Delete one object/file (no-op if absent)."""
+    if is_gcs_path(path):
+        bucket, key = _split(path)
+        blob = _gcs_client().bucket(bucket).blob(key)
+        if blob.exists():
+            blob.delete()
+        return
+    try:
+        os.remove(path)
+    except FileNotFoundError:
+        pass
+
+
 def delete_tree(path: str) -> None:
     if is_gcs_path(path):
         bucket, key = _split(path)

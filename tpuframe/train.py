@@ -192,7 +192,8 @@ def build_harness(cfg: TrainConfig) -> Harness:
     start_step = 0
     if cfg.ckpt_dir is not None:
         manager = ckpt_lib.CheckpointManager(
-            cfg.ckpt_dir, every_steps=cfg.ckpt_every, keep=cfg.ckpt_keep)
+            cfg.ckpt_dir, every_steps=cfg.ckpt_every, keep=cfg.ckpt_keep,
+            async_write=cfg.ckpt_async)
         if cfg.resume:
             resumed = manager.restore_latest(mesh=mesh, target=state)
             if resumed is not None:
@@ -482,6 +483,8 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
         t_trace.__exit__(None, None, None)
     if h.manager is not None and step % cfg.ckpt_every != 0:
         h.manager.save(step, state)  # final state always durable
+    if h.manager is not None:
+        h.manager.wait_pending()  # async saves must commit before exit
     heartbeat.stop()
     if timeline is not None:
         timeline.close()

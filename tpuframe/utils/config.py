@@ -72,6 +72,10 @@ class TrainConfig:
     ckpt_every: int = 500
     ckpt_keep: int = 3
     resume: bool = True
+    # Background checkpoint writes: snapshot synchronously, serialize/upload
+    # + COMMIT on a worker thread (no barrier — sidecar polling); the loop
+    # never waits on storage.
+    ckpt_async: bool = False
 
     def with_overrides(self, **kv) -> "TrainConfig":
         known = {f.name for f in dataclasses.fields(self)}
