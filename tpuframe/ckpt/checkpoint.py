@@ -90,21 +90,29 @@ def _spec_of(leaf) -> list:
     return []
 
 
-def _prepare_save(directory: str, step: int, tree: PyTree):
-    """Synchronous part of a save: device->host snapshots of every owned
-    shard + the manifest.  After this returns, the live tree may keep
-    training — the returned numpy buffers are immutable copies."""
+def _prepare_save(directory: str, step: int, tree: PyTree, *, sink=None):
+    """Synchronous part of a save.
+
+    ``sink=None`` (async mode): device->host SNAPSHOTS (forced copies) of
+    every owned shard are accumulated and returned — after this returns the
+    live tree may keep training.  ``sink`` given (sync mode): each leaf's
+    owned shards are passed to ``sink(owned)`` immediately and NOT
+    accumulated, keeping peak memory at one leaf (the pre-async streaming
+    behavior; no copies needed since the caller blocks until written).
+
+    Stale artifacts from a prior save of this SAME step (torn save, or a
+    rerun over an old ckpt_dir) are cleaned here, synchronously: this
+    host's CRC sidecar, and COMMIT+manifest — otherwise wait_pending/
+    restore could see the directory as committed mid-rewrite.  The async
+    finalizer additionally requires sidecar mtimes newer than this
+    attempt (see _finalize), so a lagging host's stale sidecar cannot be
+    trusted even before its cleanup runs."""
     path = gcs.join(directory, f"step_{step:08d}")
     gcs.makedirs(path)
-    # A torn prior save of this SAME step (crash between sidecar and
-    # COMMIT, then retrain back to step N) leaves a stale sidecar that the
-    # async finalizer's poll would trust; every host deletes its own here,
-    # synchronously, before any worker can poll.  Residual skew races are
-    # backstopped by restore's CRC verification (stale sidecar + new files
-    # fails loudly, never silently corrupts).
-    stale = gcs.join(path, f"crc_{jax.process_index()}.json")
-    if gcs.exists(stale):
-        gcs.delete(stale)
+    for stale in (gcs.join(path, f"crc_{jax.process_index()}.json"),
+                  gcs.join(path, _COMMIT), gcs.join(path, _MANIFEST)):
+        if gcs.exists(stale):
+            gcs.delete(stale)
     names, leaves, treedef = _flatten_with_paths(tree)
 
     del treedef  # structure is recorded as the ordered leaf-name list; restore
@@ -128,7 +136,7 @@ def _prepare_save(directory: str, step: int, tree: PyTree):
             arr = jax.random.key_data(arr)
         # Every host computes the same global shard table; each host writes
         # only the files whose shard it owns (lowest-device-id replica).
-        table, owned = _shard_table(arr, _sanitize(name))
+        table, owned = _shard_table(arr, _sanitize(name), copy=sink is None)
         entry = {
             "shape": list(arr.shape),
             "dtype": _dtype_str(arr),
@@ -137,15 +145,16 @@ def _prepare_save(directory: str, step: int, tree: PyTree):
         }
         if prng_impl is not None:
             entry["prng_impl"] = prng_impl
-        owned_files.extend(owned)
+        if sink is not None:
+            sink(owned)
+        else:
+            owned_files.extend(owned)
         manifest["leaves"][name] = entry
     return path, manifest, owned_files
 
 
-def _write_owned(path: str, owned_files) -> dict:
-    """Serialize + write this host's shard files; returns fname->crc and
-    writes the per-host CRC sidecar (each host's LAST artifact — its
-    existence means this host's files are durably written)."""
+def _write_files(path: str, owned_files) -> dict:
+    """Serialize + write shard files; returns fname->crc."""
     crc_local: dict[str, int] = {}
     for fname, data in owned_files:
         buf = io.BytesIO()
@@ -153,13 +162,25 @@ def _write_owned(path: str, owned_files) -> dict:
         raw = buf.getvalue()
         gcs.write_bytes(gcs.join(path, fname), raw)
         crc_local[fname] = _crc32(raw)
+    return crc_local
+
+
+def _write_sidecar(path: str, crc_local: dict) -> None:
+    """The per-host CRC sidecar — each host's LAST artifact; its existence
+    (with a fresh mtime) means this host's files are durably written."""
     gcs.write_bytes(gcs.join(path, f"crc_{jax.process_index()}.json"),
                     json.dumps(crc_local).encode())
+
+
+def _write_owned(path: str, owned_files) -> dict:
+    """Files + sidecar in one call (the async worker's whole write)."""
+    crc_local = _write_files(path, owned_files)
+    _write_sidecar(path, crc_local)
     return crc_local
 
 
 def _finalize(path: str, manifest: dict, *, poll: bool,
-              timeout_s: float = 600.0) -> None:
+              min_mtime: float = 0.0, timeout_s: float = 600.0) -> None:
     """Process 0 merges every host's CRC sidecar and writes manifest+COMMIT.
 
     ``poll=False``: callers already synchronized (the sync save's barrier).
@@ -176,10 +197,17 @@ def _finalize(path: str, manifest: dict, *, poll: bool,
     crc: dict[str, int] = {}
     for i in range(jax.process_count()):
         sidecar = gcs.join(path, f"crc_{i}.json")
-        while poll and not gcs.exists(sidecar):
+        while poll and not (gcs.exists(sidecar)
+                            and gcs.mtime(sidecar) >= min_mtime):
+            # Freshness gate: a STALE sidecar (torn prior save of the same
+            # step) must not be trusted just because it exists — a lagging
+            # host may not have cleaned it yet.  Storage-side mtimes are
+            # host-skew-free on GCS; 60s covers local-FS clock fuzz, and
+            # genuinely stale artifacts are minutes-to-hours old (a crash +
+            # restart + retrain separates attempts).
             if time.time() > deadline:
-                print(f"[ckpt] finalize timeout: host {i} sidecar missing; "
-                      f"leaving {path} uncommitted", flush=True)
+                print(f"[ckpt] finalize timeout: host {i} sidecar missing "
+                      f"or stale; leaving {path} uncommitted", flush=True)
                 return
             time.sleep(0.2)
         crc.update(json.loads(gcs.read_bytes(sidecar)))
@@ -191,9 +219,18 @@ def _finalize(path: str, manifest: dict, *, poll: bool,
 
 def save(directory: str, step: int, tree: PyTree) -> str:
     """Write one checkpoint; returns its path. Collective: every process must
-    call it (each writes the shards it owns)."""
-    path, manifest, owned_files = _prepare_save(directory, step, tree)
-    _write_owned(path, owned_files)
+    call it (each writes the shards it owns).  Streams leaf by leaf — peak
+    extra host memory is one leaf's shards, not the whole checkpoint."""
+    crc_local: dict[str, int] = {}
+    path_holder: list[str] = []
+
+    def sink(owned):
+        crc_local.update(_write_files(path_holder[0], owned))
+
+    path = gcs.join(directory, f"step_{step:08d}")
+    path_holder.append(path)
+    path, manifest, _ = _prepare_save(directory, step, tree, sink=sink)
+    _write_sidecar(path, crc_local)
     _barrier()
     _finalize(path, manifest, poll=False)
     return path
@@ -213,7 +250,7 @@ def _sanitize(name: str) -> str:
     return name.replace("/", ".")
 
 
-def _shard_table(arr, base: str):
+def _shard_table(arr, base: str, *, copy: bool = True):
     """(manifest shard table, [(fname, np data) this process writes]).
 
     The table is identical on every host (deterministic ordering by index);
@@ -221,12 +258,14 @@ def _shard_table(arr, base: str):
     host writes each file.
     """
     if not isinstance(arr, jax.Array) or not hasattr(arr, "global_shards"):
-        # copy=True: np.asarray may ALIAS an XLA buffer on the CPU backend,
-        # and async saves must survive the live tree being donated/updated.
-        data = np.array(arr, copy=True)
         fname = f"{base}.shard_0.npy"
-        return ([{"id": 0, "index": None, "file": fname}],
-                [(fname, data)] if jax.process_index() == 0 else [])
+        if jax.process_index() != 0:
+            return [{"id": 0, "index": None, "file": fname}], []
+        # copy (async snapshots only): np.asarray may ALIAS an XLA buffer
+        # on the CPU backend, and async saves must survive the live tree
+        # being donated/updated before the background write runs.
+        data = np.array(arr, copy=True) if copy else np.asarray(arr)
+        return ([{"id": 0, "index": None, "file": fname}], [(fname, data)])
     by_index: dict = {}
     for shard in arr.global_shards:
         key = _index_key(shard.index, arr.shape)
@@ -240,7 +279,8 @@ def _shard_table(arr, base: str):
         if shard.device.process_index == jax.process_index():
             local = next(s for s in arr.addressable_shards
                          if _index_key(s.index, arr.shape) == key)
-            owned.append((fname, np.array(local.data, copy=True)))
+            owned.append((fname, np.array(local.data, copy=True) if copy
+                          else np.asarray(local.data)))
     return table, owned
 
 
@@ -544,6 +584,7 @@ class CheckpointManager:
             path = save(self.directory, step, tree)
             self._gc()
             return path
+        prep_t0 = time.time()
         path, manifest, owned_files = _prepare_save(self.directory, step,
                                                     tree)
         # Backpressure: each queued save holds a full host-RAM snapshot.
@@ -562,7 +603,8 @@ class CheckpointManager:
                 if prev is not None:
                     prev.join()  # saves commit in order
                 _write_owned(path, owned_files)
-                _finalize(path, manifest, poll=True)
+                _finalize(path, manifest, poll=True,
+                          min_mtime=prep_t0 - 60.0)
                 self._gc()
             except Exception as e:  # noqa: BLE001 — surfaced by wait_pending
                 self._errors.append(f"save step {step}: "

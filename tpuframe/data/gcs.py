@@ -82,6 +82,19 @@ def makedirs(path: str) -> None:
         Path(path).mkdir(parents=True, exist_ok=True)
 
 
+def mtime(path: str) -> float:
+    """Last-modified time (unix seconds) of an object/file; 0.0 if absent.
+    GCS timestamps are server-side, so cross-host comparisons are sound."""
+    if is_gcs_path(path):
+        bucket, key = _split(path)
+        blob = _gcs_client().bucket(bucket).get_blob(key)
+        return blob.updated.timestamp() if blob and blob.updated else 0.0
+    try:
+        return os.path.getmtime(path)
+    except OSError:
+        return 0.0
+
+
 def delete(path: str) -> None:
     """Delete one object/file (no-op if absent)."""
     if is_gcs_path(path):
