@@ -151,6 +151,37 @@ class TestHvdFacade:
         assert hvd.local_rank() == 0
         assert hvd.is_primary()
 
+    def test_allgather_alltoall_grouped_verbs(self, mesh8):
+        """The porting-surface extras: hvd.allgather / alltoall /
+        grouped_allreduce inside a mapped step; barrier/join/shutdown are
+        host-side and exercised single-process."""
+        def body(x):
+            gathered = hvd.allgather(x, axis=("data",))
+            pair = hvd.grouped_allreduce([x, 2 * x], axis=("data",))
+            # collective outputs are replica-identical but vma-varying;
+            # pmean makes them provably unvarying for the P() out_specs
+            return jax.tree.map(lambda t: jax.lax.pmean(t, "data"),
+                                (gathered, pair[0], pair[1]))
+
+        f = jax.jit(jax.shard_map(
+            body, mesh=mesh8, in_specs=P("data"),
+            out_specs=(jax.sharding.PartitionSpec(),) * 3))
+        xs = np.arange(8.0, dtype=np.float32)
+        gathered, a, b = f(xs)
+        np.testing.assert_array_equal(np.asarray(gathered), xs)
+        assert float(a[0]) == pytest.approx(3.5)     # mean over replicas
+        assert float(b[0]) == pytest.approx(7.0)
+        # uniform splits are the static-shape case and must pass through;
+        # only genuinely ragged (unequal) splits are rejected
+        np.testing.assert_array_equal(
+            np.asarray(hvd.alltoall(jnp.arange(8.0), splits=[1] * 8)),
+            np.arange(8.0))
+        with pytest.raises(NotImplementedError, match="UNEQUAL"):
+            hvd.alltoall(jnp.zeros((8,)), splits=[2, 6])
+        assert hvd.join() == -1     # barrier-backed; single-process no-op
+        hvd.barrier()
+        hvd.shutdown()              # idempotent
+
     def test_distributed_optimizer_averages(self, mesh8):
         tx = hvd.DistributedOptimizer(optax.sgd(1.0), axis=("data",))
 

@@ -537,10 +537,9 @@ def _load_shard(path: str, fname: str, crcs: dict, verify_crc: bool,
 
 def _barrier() -> None:
     """Cross-host sync so COMMIT is written only after every host's shards."""
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    from tpuframe.parallel import bootstrap
 
-        multihost_utils.sync_global_devices("tpuframe_ckpt_commit")
+    bootstrap.host_barrier("tpuframe_ckpt_commit")
 
 
 def latest_step(directory: str) -> int | None:

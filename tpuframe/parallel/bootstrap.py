@@ -92,6 +92,16 @@ def is_initialized() -> bool:
     return _STATE["initialized"]
 
 
+def host_barrier(tag: str) -> None:
+    """Cross-host sync point.  ``tag`` names the rendezvous: concurrent
+    UNRELATED barriers must carry different tags so a mispairing fails
+    loudly (hangs both) instead of silently releasing each other."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
 def shutdown() -> None:
     """Tear down the coordinator channel (used by launcher on clean exit)."""
     if _STATE["multi_process"]:

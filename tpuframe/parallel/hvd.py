@@ -149,6 +149,62 @@ def DistributedOptimizer(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def allgather(tensor, name: str | None = None, axis=_DEFAULT_AXIS):
+    """``hvd.allgather`` — concatenate per-replica tensors along dim 0."""
+    del name
+    return collectives.allgather(tensor, axis=axis)
+
+
+def alltoall(tensor, splits=None, name: str | None = None,
+             axis=_DEFAULT_AXIS):
+    """``hvd.alltoall`` with equal splits (dim 0 scattered, gathered back).
+    Horovod's ragged ``splits`` have no XLA equivalent — static shapes are
+    the compilation model; pre-pad to equal splits instead."""
+    del name
+    if splits is not None:
+        uniform = len({int(x) for x in splits}) == 1
+        if not uniform or sum(int(x) for x in splits) != tensor.shape[0]:
+            raise NotImplementedError(
+                "alltoall with UNEQUAL splits is ragged; XLA collectives "
+                "are static-shape — pad to equal splits")
+        # equal splits covering dim 0 == exactly the static case
+    return collectives.alltoall(tensor, axis=axis)
+
+
+def grouped_allreduce(tensors, average: bool = True, name: str | None = None,
+                      axis=_DEFAULT_AXIS):
+    """``hvd.grouped_allreduce`` — one fused reduction for a list of
+    tensors.  Horovod groups to control its fusion buffer; XLA's combiner
+    fuses adjacent reductions regardless, so this is allreduce mapped over
+    the list (the group arrives at the wire fused either way)."""
+    del name
+    return [collectives.allreduce(t, axis=axis, average=average)
+            for t in tensors]
+
+
+def barrier() -> None:
+    """``hvd.barrier`` — host-level process barrier (checkpoint/teardown
+    sync; NOT needed around compiled steps, which order themselves)."""
+    bootstrap.host_barrier("tpuframe_hvd_barrier")
+
+
+def join() -> int:
+    """``hvd.join`` — Horovod's elastic straggler drain.  tpuframe's
+    failure model is slice-restart + checkpoint resume (SURVEY.md §5.3):
+    pods fail as a unit, so there is no partial-membership state to drain.
+    Provided as a host barrier for porting compatibility; returns -1 like
+    Horovod does when no rank is joining."""
+    barrier()
+    return -1
+
+
+def shutdown() -> None:
+    """``hvd.shutdown`` — tear down the distributed runtime (idempotent:
+    bootstrap tracks init state, so a later ``hvd.init()`` re-initializes
+    and the launcher's own clean-exit shutdown doesn't double-teardown)."""
+    bootstrap.shutdown()
+
+
 def _maybe_compress(grads: PyTree, compression: str | None):
     """Cast float32 leaves down for the reduction; returns the original
     dtypes so decompression restores exactly what arrived (bf16-native
