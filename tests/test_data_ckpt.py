@@ -32,6 +32,27 @@ class TestDatasets:
         assert b["input_ids"].shape == (3, 64)
         assert set(b) == {"input_ids", "attention_mask", "token_type_ids", "label"}
 
+    def test_lm_text_padded_docs(self):
+        from tpuframe.data.datasets import lm_text
+
+        train, _ = lm_text(seq_len=32, vocab_size=64, synthetic_size=16,
+                           padded_docs=True, pad_id=0)
+        b = train[:16]
+        ids, labels = b["input_ids"], b["labels"]
+        assert ids.shape == (16, 32) and labels.shape == (16, 32)
+        for i in range(16):
+            ignored = np.where(labels[i] == -100)[0]
+            assert len(ignored) > 0  # every doc shorter than seq_len+1
+            lo = ignored[0]
+            # ignore region is a suffix; ids padded with pad_id after it
+            assert np.all(labels[i, lo:] == -100)
+            np.testing.assert_array_equal(ids[i, lo + 1:],
+                                          np.zeros(31 - lo, np.int32))
+            # valid region still the shifted next-token targets
+            np.testing.assert_array_equal(labels[i, :lo], ids[i, 1:lo + 1])
+        with pytest.raises(ValueError, match="synthetic"):
+            lm_text("/tmp/x", padded_docs=True)
+
     def test_shard_disjoint_and_equal(self):
         ds = ArrayDataset({"x": np.arange(103)})
         shards = [ds.shard(4, i) for i in range(4)]

@@ -260,7 +260,8 @@ def _tokenize(sents, labels, seq_len, vocab_size, tokenizer):
 # ---------------------------------------------------------------------------
 
 def lm_text(data_dir: str | None = None, *, seq_len: int = 2048,
-            vocab_size: int = 32000, synthetic_size: int = 256):
+            vocab_size: int = 32000, synthetic_size: int = 256,
+            padded_docs: bool = False, pad_id: int = 0):
     """Next-token-prediction chunks: input_ids [N, S], labels [N, S] int32
     (labels pre-shifted on the host so the loss is positionwise — no
     cross-shard shift is needed when the sequence dim is sharded over the
@@ -269,7 +270,21 @@ def lm_text(data_dir: str | None = None, *, seq_len: int = 2048,
     With ``data_dir``: reads ``tokens.npy`` (a single int32 token stream,
     e.g. pre-tokenized wikitext) and chunks it; synthetic mode generates an
     order-2 structured stream so convergence tests are meaningful.
+
+    ``padded_docs``: variable-length documents right-padded to ``seq_len``
+    with ``pad_id``; padded label positions carry ``-100`` — torch's
+    ``ignore_index`` convention, which the harness LM losses honor (zero
+    loss AND zero gradient there, means over valid tokens only).  The
+    fine-tuning data shape, vs the packed-stream pretraining shape.
     """
+    if padded_docs:
+        if data_dir is not None:
+            raise ValueError("padded_docs is a synthetic-data mode; "
+                             "pre-tokenized streams are packed, not padded")
+        return (_synthetic_lm_docs(synthetic_size, seq_len, vocab_size,
+                                   pad_id=pad_id, seed=8),
+                _synthetic_lm_docs(max(synthetic_size // 8, 8), seq_len,
+                                   vocab_size, pad_id=pad_id, seed=9))
     if data_dir is not None:
         stream = np.load(io.BytesIO(gcs.read_bytes(gcs.join(data_dir, "tokens.npy"))))
         stream = stream.astype(np.int32) % vocab_size
@@ -282,6 +297,24 @@ def lm_text(data_dir: str | None = None, *, seq_len: int = 2048,
         return chunk(0, split), chunk(split, n)
     return (_synthetic_lm(synthetic_size, seq_len, vocab_size, seed=8),
             _synthetic_lm(max(synthetic_size // 8, 8), seq_len, vocab_size, seed=9))
+
+
+def _synthetic_lm_docs(n, seq_len, vocab_size, *, pad_id, seed):
+    """Variable-length affine-recurrence documents, right-padded: lengths
+    uniform in [seq_len//4, seq_len]; labels are the shifted next tokens
+    inside the document and -100 (ignored) at/after the last real token."""
+    rng = np.random.default_rng(seed)
+    full = _synthetic_lm(n, seq_len, vocab_size, seed=seed)
+    ids = np.array(full[:n]["input_ids"], copy=True)
+    labels = np.array(full[:n]["labels"], copy=True)
+    lengths = rng.integers(max(seq_len // 4, 2), seq_len + 1, size=n)
+    for i, ln in enumerate(lengths):
+        ids[i, ln:] = pad_id
+        # position t predicts token t+1: the last valid prediction is at
+        # index ln-2 (predicting the doc's final token); everything from
+        # ln-1 on is padding context -> ignored.
+        labels[i, ln - 1:] = -100
+    return ArrayDataset({"input_ids": ids, "labels": labels})
 
 
 def _synthetic_lm(n, seq_len, vocab_size, *, seed):

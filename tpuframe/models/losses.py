@@ -13,15 +13,41 @@ import jax.numpy as jnp
 import optax
 
 
+def masked_mean(x: jax.Array, labels: jax.Array, ignore_index: int,
+                reduce_axis=None) -> jax.Array:
+    """Mean of ``x`` over positions whose label != ignore_index — THE one
+    definition of the valid-token reduction (loss, accuracy, fused path).
+
+    ``reduce_axis``: mesh axis name(s) to sum numerator AND denominator
+    over before dividing.  Per-shard masked means pmean-ed uniformly are
+    BIASED when shards hold unequal valid counts (padded docs: suffix
+    padding makes seq shards systematically unequal; data shards unequal
+    per draw) — the global sum-of-sums / sum-of-counts is exact.  Safe to
+    pass always: unbound axes (unmapped jit / auto-SPMD) reduce globally
+    already and psum_scalar no-ops."""
+    from tpuframe.parallel import collectives
+
+    valid = (labels != ignore_index).astype(jnp.float32)
+    num = jnp.sum(x.astype(jnp.float32) * valid)
+    den = jnp.sum(valid)
+    if reduce_axis is not None:
+        num = collectives.psum_scalar(num, reduce_axis)
+        den = collectives.psum_scalar(den, reduce_axis)
+    return num / jnp.maximum(den, 1.0)
+
+
 def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
                           label_smoothing: float = 0.0,
-                          ignore_index: int | None = None) -> jax.Array:
+                          ignore_index: int | None = None,
+                          reduce_axis=None) -> jax.Array:
     """Mean CE over the batch; integer labels. ImageNet configs use
     ``label_smoothing=0.1`` (standard ResNet-50 recipe).
 
     ``ignore_index``: torch ``F.cross_entropy(ignore_index=...)`` parity —
     tokens with that label contribute neither loss nor gradient, and the
-    mean divides by the VALID count (matching torch's 'mean' reduction)."""
+    mean divides by the VALID count (matching torch's 'mean' reduction);
+    ``reduce_axis`` makes that count global across mesh shards (see
+    masked_mean)."""
     num_classes = logits.shape[-1]
     safe_labels = labels
     if ignore_index is not None:
@@ -36,12 +62,16 @@ def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
                                                                safe_labels)
     if ignore_index is None:
         return jnp.mean(loss)
-    valid = (labels != ignore_index).astype(loss.dtype)
-    return jnp.sum(loss * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+    return masked_mean(loss, labels, ignore_index, reduce_axis)
 
 
-def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+def accuracy(logits: jax.Array, labels: jax.Array,
+             ignore_index: int | None = None,
+             reduce_axis=None) -> jax.Array:
+    hit = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    if ignore_index is None:
+        return jnp.mean(hit)
+    return masked_mean(hit, labels, ignore_index, reduce_axis)
 
 
 def topk_accuracy(logits: jax.Array, labels: jax.Array, k: int = 5) -> jax.Array:

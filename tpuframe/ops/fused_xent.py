@@ -192,20 +192,23 @@ def fused_softmax_xent_and_argmax(
 def mean_xent_and_accuracy(hidden: jax.Array, w: jax.Array,
                            labels: jax.Array, *,
                            chunk: int = DEFAULT_CHUNK,
-                           ignore_index: int | None = None
-                           ) -> tuple[jax.Array, jax.Array]:
+                           ignore_index: int | None = None,
+                           reduce_axis=None) -> tuple[jax.Array, jax.Array]:
     """(mean loss, token accuracy) through the fused head — the one shared
     definition the harness loss/metric fns and the pipeline step all call,
     so train and eval math cannot drift.  With ``ignore_index`` both the
-    loss mean and the accuracy divide by the valid-token count."""
+    loss mean and the accuracy divide by the valid-token count, globally
+    across ``reduce_axis`` mesh shards (losses.masked_mean: per-shard
+    means pmean-ed uniformly are biased under unequal padding)."""
     per_tok, pred = fused_softmax_xent_and_argmax(
         hidden, w, labels, chunk=chunk, ignore_index=ignore_index)
     hit = (pred == labels).astype(jnp.float32)
     if ignore_index is None:
         return jnp.mean(per_tok), jnp.mean(hit)
-    valid = (labels != ignore_index).astype(jnp.float32)
-    denom = jnp.maximum(jnp.sum(valid), 1.0)
-    return jnp.sum(per_tok) / denom, jnp.sum(hit * valid) / denom
+    from tpuframe.models.losses import masked_mean  # lazy: no import cycle
+
+    return (masked_mean(per_tok, labels, ignore_index, reduce_axis),
+            masked_mean(hit, labels, ignore_index, reduce_axis))
 
 
 def chunked_argmax(hidden: jax.Array, w: jax.Array,

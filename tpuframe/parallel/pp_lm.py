@@ -47,16 +47,20 @@ def _head_loss_acc(model, fused_xent: bool, params, x_last, labels):
     states — dense head, or the chunked fused softmax-xent path
     (tpuframe.ops.fused_xent; logits never materialize).  One definition
     shared by the train and eval pipeline steps so the two cannot drift."""
+    data_axes = tuple(mesh_lib.BATCH_AXES)
     if fused_xent:
         from tpuframe.ops import fused_xent as fx
 
         hidden = model.apply({"params": params}, x_last,
                              head_only=True, hidden_only=True)
         return fx.mean_xent_and_accuracy(
-            hidden, params["lm_head"]["kernel"], labels)
+            hidden, params["lm_head"]["kernel"], labels, ignore_index=-100,
+            reduce_axis=data_axes)
     logits = model.apply({"params": params}, x_last, head_only=True)
-    return (losses.softmax_cross_entropy(logits, labels),
-            losses.accuracy(logits, labels))
+    return (losses.softmax_cross_entropy(logits, labels, ignore_index=-100,
+                                         reduce_axis=data_axes),
+            losses.accuracy(logits, labels, ignore_index=-100,
+                            reduce_axis=data_axes))
 
 
 def make_pp_lm_step(model, tx: optax.GradientTransformation, mesh: Mesh, *,

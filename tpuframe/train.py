@@ -208,9 +208,35 @@ def build_harness(cfg: TrainConfig) -> Harness:
                    manager=manager, start_step=start_step)
 
 
+def _lm_reduce_axis(cfg: TrainConfig, *, for_grad: bool):
+    """Mesh axes for the GLOBAL valid-token mean (losses.masked_mean):
+    per-shard masked means pmean-ed uniformly are biased when shards hold
+    unequal valid counts (padded_docs).  The explicit-fusion and
+    grad-accumulation step modes differentiate a LOCAL loss and reduce
+    gradients themselves — a psum inside the loss would mis-scale them —
+    so the gradient-side global mean only applies in the default implicit
+    mode, and the biased combination is refused outright."""
+    axes = ((*mesh_lib.BATCH_AXES, "seq") if cfg.shard_seq
+            else mesh_lib.BATCH_AXES)
+    if not for_grad:
+        return axes  # eval metrics have no explicit-reduction mode
+    from tpuframe.parallel import tuning
+
+    explicit = tuning.step_threshold() is not None or cfg.accum_steps > 1
+    if not explicit:
+        return axes
+    if bool(cfg.dataset_kwargs.get("padded_docs")):
+        raise ValueError(
+            "padded_docs with TPUFRAME_FUSION_THRESHOLD or accum_steps>1: "
+            "these modes need a local loss, and a per-shard valid-token "
+            "mean would be biased by unequal padding across shards")
+    return None  # local loss; no -100 labels, so per-shard mean is exact
+
+
 def make_loss_fn(cfg: TrainConfig, model) -> step_lib.LossFn:
     if _is_lm_task(cfg):
         aux_w = float(cfg.model_kwargs.get("moe_aux_weight", 0.01))
+        raxis = _lm_reduce_axis(cfg, for_grad=True)
 
         def loss_fn(params, model_state, batch, rng):
             if cfg.fused_xent:
@@ -224,16 +250,25 @@ def make_loss_fn(cfg: TrainConfig, model) -> step_lib.LossFn:
                     train=True, rngs={"dropout": rng},
                     mutable=["aux_loss"], hidden_only=True)
                 loss, acc = fx.mean_xent_and_accuracy(
-                    hidden, params["lm_head"]["kernel"], batch["labels"])
+                    hidden, params["lm_head"]["kernel"], batch["labels"],
+                    ignore_index=-100, reduce_axis=raxis)
                 metrics = {"accuracy": acc}
             else:
                 logits, sown = model.apply({"params": params, **model_state},
                                            batch["input_ids"], train=True,
                                            rngs={"dropout": rng},
                                            mutable=["aux_loss"])
-                loss = losses.softmax_cross_entropy(logits, batch["labels"])
+                # ignore_index=-100: the torch/HF convention — padded
+                # label positions (datasets.lm_text padded_docs) carry -100
+                # and contribute neither loss nor gradient; a no-op for
+                # packed streams with no negative labels.
+                loss = losses.softmax_cross_entropy(logits, batch["labels"],
+                                                    ignore_index=-100,
+                                                    reduce_axis=raxis)
                 metrics = {"accuracy": losses.accuracy(logits,
-                                                       batch["labels"])}
+                                                       batch["labels"],
+                                                       ignore_index=-100,
+                                                       reduce_axis=raxis)}
             aux_leaves = jax.tree.leaves(sown)
             if aux_leaves:  # MoE load-balance penalty (tpuframe.ops.moe)
                 aux = sum(aux_leaves) / len(aux_leaves)
@@ -280,22 +315,31 @@ def make_metric_fn(cfg: TrainConfig, model):
             # would be ~4 GB f32 per 32k-token sequence otherwise.
             from tpuframe.ops import fused_xent as fx
 
+            raxis = _lm_reduce_axis(cfg, for_grad=False)
+
             def metric_fn(params, model_state, batch):
                 hidden = model.apply({"params": params, **model_state},
                                      batch["input_ids"], hidden_only=True)
                 loss, acc = fx.mean_xent_and_accuracy(
-                    hidden, params["lm_head"]["kernel"], batch["labels"])
+                    hidden, params["lm_head"]["kernel"], batch["labels"],
+                    ignore_index=-100, reduce_axis=raxis)
                 return {"loss": loss, "perplexity": jnp.exp(loss),
                         "accuracy": acc}
 
             return metric_fn
 
+        raxis = _lm_reduce_axis(cfg, for_grad=False)
+
         def metric_fn(params, model_state, batch):
             logits = model.apply({"params": params, **model_state},
                                  batch["input_ids"])
-            loss = losses.softmax_cross_entropy(logits, batch["labels"])
+            loss = losses.softmax_cross_entropy(logits, batch["labels"],
+                                                ignore_index=-100,
+                                                reduce_axis=raxis)
             return {"loss": loss, "perplexity": jnp.exp(loss),
-                    "accuracy": losses.accuracy(logits, batch["labels"])}
+                    "accuracy": losses.accuracy(logits, batch["labels"],
+                                                ignore_index=-100,
+                                                reduce_axis=raxis)}
 
         return metric_fn
 
