@@ -85,6 +85,49 @@ def test_pp_lm_golden_losses_vs_unsharded():
     assert ref_losses[-1] < ref_losses[0]
 
 
+def test_pp_lm_global_norm_clip_matches_unsharded():
+    """pp_clip_by_global_norm: the cross-stage clip must reproduce the
+    unsharded optax.clip_by_global_norm trajectory exactly — per-stage
+    local norms would diverge (the reason grad_clip_norm used to be
+    refused with pp).  Tight max_norm so the clip actually engages."""
+    model = ScanBlockLM(_cfg())
+    batch = _data()
+    max_norm = 0.05  # well below the typical initial grad norm
+
+    # --- unsharded reference with optax's own clip ---
+    tx_ref = optax.chain(optax.clip_by_global_norm(max_norm),
+                         optax.adamw(1e-3))
+    state = _init_state(model, batch, tx_ref)
+
+    def loss_fn(params, model_state, b, rng):
+        logits = model.apply({"params": params}, b["input_ids"])
+        loss = jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["labels"]))
+        return loss, ({}, {})
+
+    ref_step = step_lib.make_train_step(loss_fn, tx_ref, None, donate=False)
+    ref_losses, s = [], state
+    for _ in range(4):
+        s, m = ref_step(s, batch)
+        ref_losses.append(float(m["loss"]))
+
+    # --- pipelined with the cross-stage clip ---
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=2, pipe=4))
+    tx_pp = optax.chain(pp_lm.pp_clip_by_global_norm(max_norm),
+                        optax.adamw(1e-3))
+    factory, place_state, place_batch = pp_lm.make_pp_lm_step(
+        model, tx_pp, mesh, n_micro=4)
+    ps = place_state(_init_state(model, batch, tx_pp))
+    step = factory(ps)
+    pp_losses = []
+    pb = place_batch(batch)
+    for _ in range(4):
+        ps, m = step(ps, pb)
+        pp_losses.append(float(m["loss"]))
+
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-5, atol=2e-5)
+
+
 def test_pp_lm_fused_xent_matches_dense():
     """fused_xent=True through the pipeline: the chunked head+loss must
     reproduce the dense pipeline losses step for step (same init/data)."""
@@ -152,7 +195,7 @@ def test_pp_harness_end_to_end_with_resume(tmp_path):
     ck = str(tmp_path / "ck")
     base = get_config("lm_pp_smoke").with_overrides(
         total_steps=20, ckpt_every=10, log_every=10, eval_every=100,
-        ckpt_dir=ck)
+        ckpt_dir=ck, grad_clip_norm=1.0)  # exercises the pp-safe clip wiring
     straight = train_mod.train(base)
     assert straight["step"] == 20
     assert straight["loss"] < 3.0

@@ -12,8 +12,9 @@ data-presummed from the pmean-of-loss transpose.
 
 Constraints (documented, asserted): ``num_layers % pp_stages == 0``; the
 optimizer must not couple parameters across leaves with global statistics
-(per-leaf transforms like adam/adamw/sgd are fine; a global-norm clip would
-need an extra cross-stage psum).
+using only local values — per-leaf transforms (adam/adamw/sgd) are fine,
+and global-norm clipping is provided by ``pp_clip_by_global_norm`` (the
+cross-stage psum'd norm; the harness wires it for ``grad_clip_norm``).
 """
 
 from __future__ import annotations
@@ -40,6 +41,51 @@ def state_partition(state: TrainState) -> TrainState:
         return P("pipe") if in_blocks else P()
 
     return jax.tree_util.tree_map_with_path(spec_for, state)
+
+
+def pp_clip_by_global_norm(max_norm: float) -> optax.GradientTransformation:
+    """Global-norm clipping that is correct on a pipe-sharded grad tree.
+
+    ``optax.clip_by_global_norm`` computes the norm from the LOCAL leaf
+    values; under the pipeline layout each stage holds only its slice of
+    the ``blocks`` leaves, so the local norm is a per-stage statistic and
+    the resulting clip scales diverge across stages (the reason the
+    harness refused grad_clip_norm with pp).  Here the square-sums of
+    pipe-VARYING leaves are psum-ed over the pipe axis (each stage's slice
+    counted once), replicated leaves (embed/head) are counted once without
+    the psum, and every stage applies the same global scale."""
+
+    def sq_sum(g):
+        return jnp.sum(jnp.square(g.astype(jnp.float32)))
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(grads, state, params=None, **extra):
+        del params, extra
+        varying = jnp.zeros((), jnp.float32)
+        invariant = jnp.zeros((), jnp.float32)
+        pipe_bound = any(
+            "pipe" in getattr(jax.typeof(g), "vma", frozenset())
+            for g in jax.tree.leaves(grads))
+        for g in jax.tree.leaves(grads):
+            if "pipe" in getattr(jax.typeof(g), "vma", frozenset()):
+                varying = varying + sq_sum(g)
+            else:
+                invariant = invariant + sq_sum(g)
+        if pipe_bound:
+            # psum of the pipe-varying total is pipe-INVARIANT — it joins
+            # the replicated leaves' total directly, keeping the clip
+            # scale provably replicated (replicated-leaf updates must not
+            # become pipe-varying).
+            varying = lax.psum(varying, "pipe")
+        norm = jnp.sqrt(varying + invariant)
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-16))
+        return jax.tree.map(lambda g: (g * scale).astype(g.dtype),
+                            grads), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
 
 
 def _head_loss_acc(model, fused_xent: bool, params, x_last, labels):

@@ -121,11 +121,25 @@ def build_harness(cfg: TrainConfig) -> Harness:
     params = variables["params"]
     model_state = {k: v for k, v in variables.items() if k != "params"}
 
-    tx = build_optimizer(cfg, params)
+    use_pp = mesh is not None and mesh.shape["pipe"] > 1
+    if use_pp and cfg.grad_clip_norm is not None:
+        # optax's clip computes the norm from local leaf values — a
+        # per-STAGE statistic under the pipe-sharded layout; build the
+        # chain with the vma-aware cross-stage clip instead (once — pp
+        # models sit near the memory limit, no throwaway Adam trees).
+        import optax
+
+        from tpuframe.parallel.pp_lm import pp_clip_by_global_norm
+
+        tx = optax.chain(
+            pp_clip_by_global_norm(cfg.grad_clip_norm),
+            build_optimizer(cfg.with_overrides(grad_clip_norm=None),
+                            params))
+    else:
+        tx = build_optimizer(cfg, params)
     state = step_lib.TrainState.create(params, tx, model_state=model_state,
                                        rng=jax.random.key(cfg.seed + 1))
 
-    use_pp = mesh is not None and mesh.shape["pipe"] > 1
     if use_pp:
         # Pipeline parallelism: ScanBlockLM blocks + opt state sharded over
         # the pipe axis, GPipe microbatching (tpuframe.parallel.pp_lm).
@@ -137,13 +151,6 @@ def build_harness(cfg: TrainConfig) -> Harness:
         if use_sharded_state:
             raise ValueError("pipe parallelism does not compose with "
                              "fsdp/model/expert sharded-state axes yet")
-        if cfg.grad_clip_norm is not None:
-            # A global-norm clip computes per-stage norms over each stage's
-            # block shard — pipe-varying clip scales that crash the step at
-            # trace time with an opaque replication error.  Refuse clearly.
-            raise ValueError("pipe parallelism does not support "
-                             "grad_clip_norm (global statistic across "
-                             "pipe-sharded params); set it to None")
         if cfg.accum_steps != 1:
             raise ValueError("pipe parallelism has its own microbatching "
                              "(pp_microbatches); accum_steps must be 1")
@@ -220,16 +227,25 @@ def _lm_reduce_axis(cfg: TrainConfig, *, for_grad: bool):
             else mesh_lib.BATCH_AXES)
     if not for_grad:
         return axes  # eval metrics have no explicit-reduction mode
+    # The local-loss requirement only exists where make_train_step actually
+    # takes the explicit path: shard_map mode (distributed, no sharded-state
+    # axes).  Unmapped jit and auto-SPMD ignore the fusion knob and reduce
+    # globally by construction; a psum with unbound axes is a no-op there.
     from tpuframe.parallel import tuning
 
-    explicit = tuning.step_threshold() is not None or cfg.accum_steps > 1
+    sharded_state = (cfg.mesh.fsdp > 1 or cfg.mesh.model > 1
+                     or cfg.mesh.expert > 1)
+    shard_map_mode = cfg.distributed and not sharded_state
+    explicit = shard_map_mode and (tuning.step_threshold() is not None
+                                   or cfg.accum_steps > 1)
     if not explicit:
         return axes
     if bool(cfg.dataset_kwargs.get("padded_docs")):
         raise ValueError(
-            "padded_docs with TPUFRAME_FUSION_THRESHOLD or accum_steps>1: "
-            "these modes need a local loss, and a per-shard valid-token "
-            "mean would be biased by unequal padding across shards")
+            "padded_docs with TPUFRAME_FUSION_THRESHOLD or accum_steps>1 "
+            "in shard_map mode: these paths need a local loss, and a "
+            "per-shard valid-token mean would be biased by unequal "
+            "padding across shards")
     return None  # local loss; no -100 labels, so per-shard mean is exact
 
 
