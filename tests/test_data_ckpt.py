@@ -252,6 +252,35 @@ class TestCheckpoint:
         mgr = ckpt.CheckpointManager(str(tmp_path))
         assert mgr.restore_latest() is None
 
+    def test_save_best_keeps_single_record(self, tmp_path, mesh8):
+        """save_best: only improvements are kept, exactly one best dir
+        exists, restore_best returns the winning step's state."""
+        mgr = ckpt.CheckpointManager(str(tmp_path), every_steps=10)
+        s1 = _toy_state(mesh8)
+        s2 = jax.tree_util.tree_map(
+            lambda a: a * 2 if jnp.issubdtype(a.dtype, jnp.floating) else a,
+            s1)
+        assert mgr.save_best(10, s1, 1.5) is True
+        assert mgr.save_best(20, s2, 2.0) is False   # worse: not saved
+        assert mgr.save_best(30, s2, 0.5) is True    # better: replaces
+        best_dirs = [p.name for p in (tmp_path / "best").iterdir()
+                     if p.is_dir()]
+        assert best_dirs == ["step_00000030"]
+        step, restored = mgr.restore_best(mesh=mesh8, target=s1)
+        assert step == 30
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]),
+                                      np.asarray(s2.params["w"]))
+        # max mode: higher wins
+        mgr2 = ckpt.CheckpointManager(str(tmp_path / "m2"))
+        assert mgr2.save_best(1, s1, 0.7, mode="max") is True
+        assert mgr2.save_best(2, s2, 0.6, mode="max") is False
+        step2, _ = mgr2.restore_best(mesh=mesh8, target=s1)
+        assert step2 == 1
+        with pytest.raises(ValueError, match="contradicts"):
+            mgr2.save_best(3, s1, 0.1, mode="min")  # opposite-order record
+        with pytest.raises(ValueError, match="mode"):
+            mgr2.save_best(3, s1, 0.1, mode="best")
+
     def test_async_save_commits_and_roundtrips(self, tmp_path, mesh8):
         """async_write: save() returns before COMMIT; wait_pending() makes
         every queued save durable, in order, with retention applied; the

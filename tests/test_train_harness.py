@@ -74,6 +74,21 @@ class TestEndToEnd:
         np.testing.assert_allclose(straight["loss"], part2["loss"],
                                    rtol=1e-4)
 
+    def test_smoke_track_best_saves_best_eval(self, tmp_path):
+        """track_best: a best/ checkpoint exists after training and holds
+        the step with the lowest eval loss seen."""
+        import json
+
+        ck = tmp_path / "ck"
+        cfg = get_config("smoke").with_overrides(
+            distributed=False, total_steps=30, log_every=10, eval_every=10,
+            ckpt_dir=str(ck), ckpt_every=100, track_best=True)
+        train_mod.train(cfg)
+        record = json.loads((ck / "best" / "metric.json").read_text())
+        assert record["mode"] == "min" and record["step"] in (10, 20, 30)
+        best_dirs = [p.name for p in (ck / "best").iterdir() if p.is_dir()]
+        assert len(best_dirs) == 1
+
     def test_smoke_lars_optimizer_learns(self):
         """LARS (the large-batch ImageNet scaling recipe): layerwise
         trust-ratio optimizer runs through the harness and decreases

@@ -616,6 +616,56 @@ class CheckpointManager:
         t.start()
         return path
 
+    def save_best(self, step: int, tree: PyTree, metric: float,
+                  *, mode: str = "min") -> bool:
+        """Keep the single best-by-eval-metric checkpoint under ``best/``
+        (the reference genre's 'save best model' hook).  Returns True when
+        ``metric`` beat the stored record and the state was saved.  Always
+        a synchronous save: best saves are rare (eval cadence) and racing
+        an in-flight periodic async save of the same step is not worth it.
+        Collective: every process must call it with the same metric."""
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        best_dir = gcs.join(self.directory, "best")
+        record_path = gcs.join(best_dir, "metric.json")
+        prev = None
+        if gcs.exists(record_path):
+            record = json.loads(gcs.read_bytes(record_path))
+            if record.get("mode", mode) != mode:
+                raise ValueError(
+                    f"save_best mode {mode!r} contradicts the stored best "
+                    f"record's mode {record['mode']!r} in {best_dir} — "
+                    f"comparing a new metric against an opposite-ordered "
+                    f"record would silently corrupt best tracking")
+            prev = record["metric"]
+        better = (prev is None or
+                  (metric < prev if mode == "min" else metric > prev))
+        if not better:
+            return False
+        # Order matters for crash safety: save the NEW best first (COMMIT-
+        # atomic), then update the record, then delete the stale dir —
+        # deleting first would leave a window where a preemption loses the
+        # old best while the record still blocks any future save_best.
+        save(best_dir, step, tree)
+        if jax.process_index() == 0:
+            gcs.write_bytes(record_path, json.dumps(
+                {"metric": float(metric), "step": step,
+                 "mode": mode}).encode())
+            new_name = f"step_{step:08d}"
+            for m in (_STEP_RE.match(n) for n in gcs.listdir(best_dir)):
+                if m and m.group(0) != new_name:
+                    gcs.delete_tree(gcs.join(best_dir, m.group(0)))
+        return True
+
+    def restore_best(self, *, mesh: Mesh | None = None,
+                     target: PyTree | None = None):
+        """(step, tree) of the best-metric checkpoint, or None."""
+        best_dir = gcs.join(self.directory, "best")
+        step = latest_step(best_dir)
+        if step is None:
+            return None
+        return step, restore(best_dir, step, mesh=mesh, target=target)
+
     def wait_pending(self, *, commit_timeout_s: float = 600.0) -> None:
         """Block until every async save has committed (no-op when sync).
 

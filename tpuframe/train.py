@@ -528,6 +528,14 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             logger.log(step, eval_metrics, prefix="eval")
             final_train_metrics.update(
                 {f"eval_{k}": v for k, v in eval_metrics.items()})
+            if (cfg.track_best and h.manager is not None
+                    and "loss" in eval_metrics):
+                if h.manager.save_best(step, state,
+                                       float(eval_metrics["loss"])):
+                    if bootstrap.is_primary():
+                        print(f"[tpuframe] new best eval loss "
+                              f"{eval_metrics['loss']:.4f} at step {step}",
+                              flush=True)
             heartbeat.beat(step)  # eval (incl. its first compile) is progress
 
         if h.manager is not None:

@@ -76,6 +76,9 @@ class TrainConfig:
     # + COMMIT on a worker thread (no barrier — sidecar polling); the loop
     # never waits on storage.
     ckpt_async: bool = False
+    # Keep the single best-by-eval-loss checkpoint under <ckpt_dir>/best/
+    # (the reference genre's 'save best model' hook).
+    track_best: bool = False
 
     def with_overrides(self, **kv) -> "TrainConfig":
         known = {f.name for f in dataclasses.fields(self)}
