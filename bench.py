@@ -33,10 +33,13 @@ import time
 
 V100_HOROVOD_ANCHOR = 360.0  # images/sec/chip, see module docstring
 
-# Batch 512/chip measured fastest on the v5e bench chip (sweep 2026-07-29:
-# 128->1083, 256->1454, 512->1824, 1024->1797 images/sec/chip); large batches
-# keep the MXU fed through the small-spatial late stages.
-BATCH_PER_CHIP = int(os.environ.get("TPUFRAME_BENCH_BATCH", "512"))
+# Batch 256 measured fastest under honest chained-async timing (sweep
+# 2026-07-30 on the v5e chip: 256->2385, 512->2332, 768->2225, 1024->2033
+# images/sec/chip; round 2's 512 optimum was an artifact of the
+# serializing per-step-fetch timer).  Consistent with the step being
+# HBM-bound (PERF.md §2): bytes/img are ~flat with batch and the smaller
+# working set wins.
+BATCH_PER_CHIP = int(os.environ.get("TPUFRAME_BENCH_BATCH", "256"))
 IMAGE_SIZE = 224
 WARMUP_STEPS = int(os.environ.get("TPUFRAME_BENCH_WARMUP", "3"))
 MEASURE_STEPS = int(os.environ.get("TPUFRAME_BENCH_STEPS", "16"))

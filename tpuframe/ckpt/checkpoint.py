@@ -659,9 +659,22 @@ class CheckpointManager:
 
     def restore_best(self, *, mesh: Mesh | None = None,
                      target: PyTree | None = None):
-        """(step, tree) of the best-metric checkpoint, or None."""
+        """(step, tree) of the best-metric checkpoint, or None.
+
+        The step comes from the RECORD, not from the newest committed dir:
+        save_best's crash window can leave the beaten checkpoint alongside
+        the new one, and the beaten one may carry the higher step."""
         best_dir = gcs.join(self.directory, "best")
-        step = latest_step(best_dir)
+        record_path = gcs.join(best_dir, "metric.json")
+        if gcs.exists(record_path):
+            step = int(json.loads(gcs.read_bytes(record_path))["step"])
+            if not gcs.exists(gcs.join(best_dir, f"step_{step:08d}",
+                                       _COMMIT)):
+                # record written but its save lost (shouldn't happen given
+                # save-before-record ordering; be defensive): fall back
+                step = latest_step(best_dir)
+        else:
+            step = latest_step(best_dir)
         if step is None:
             return None
         return step, restore(best_dir, step, mesh=mesh, target=target)

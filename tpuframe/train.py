@@ -197,6 +197,9 @@ def build_harness(cfg: TrainConfig) -> Harness:
 
     manager = None
     start_step = 0
+    if cfg.track_best and cfg.ckpt_dir is None:
+        raise ValueError("track_best=True needs ckpt_dir (the best/ "
+                         "checkpoint lives under it)")
     if cfg.ckpt_dir is not None:
         manager = ckpt_lib.CheckpointManager(
             cfg.ckpt_dir, every_steps=cfg.ckpt_every, keep=cfg.ckpt_keep,
