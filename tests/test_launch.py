@@ -2,6 +2,7 @@
 multi-process fake cluster (SURVEY.md §7 test strategy: distributed tests via
 multi-process CPU jax — N host processes, forced host devices, no TPU)."""
 
+import os
 import sys
 import textwrap
 
@@ -160,6 +161,47 @@ def test_replicated_restore_reads_storage_only_on_primary(tmp_path):
     results = LocalCluster(2, 2, timeout=600).launch(
         [sys.executable, "-c", script])
     assert all("BCAST_OK" in r.stdout for r in results)
+
+
+def test_run_with_relaunch_retries_then_succeeds():
+    from tpuframe.launch.launcher import run_with_relaunch
+
+    calls = {"n": 0}
+
+    def run_once():
+        calls["n"] += 1
+        return 13 if calls["n"] < 3 else 0  # stall-abort rc twice, then ok
+
+    msgs = []
+    assert run_with_relaunch(run_once, 5, log=msgs.append) == 0
+    assert calls["n"] == 3
+    assert any("relaunch 2/5" in m for m in msgs)
+    # budget exhausted: the last nonzero rc propagates
+    calls["n"] = -10
+    assert run_with_relaunch(run_once, 2, log=msgs.append) == 13
+
+
+@pytest.mark.slow
+def test_launch_cli_relaunch_resumes_crashed_job(tmp_path):
+    """The supervisor loop end to end: a fault-injected job dies mid-run
+    (exit 42) under `launch local --relaunch 1`; the relaunched job
+    auto-resumes from the committed checkpoint and finishes."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.update({"TPUFRAME_FAULT_STEP": "6", "TPUFRAME_FAULT_ONCE": "1"})
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpuframe.launch", "local",
+         "--nprocs", "2", "--devices", "2", "--relaunch", "1", "--",
+         sys.executable, "-m", "tpuframe.train", "--config", "smoke",
+         "--set", "total_steps=8", "--set", "ckpt_every=4",
+         "--set", "log_every=4", "--set", "eval_every=1000",
+         "--set", "global_batch=16",
+         "--ckpt-dir", str(tmp_path / "ck")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-800:]
+    assert "relaunch 1/1" in proc.stdout
+    assert "resumed from step 4" in proc.stdout
 
 
 @pytest.mark.slow

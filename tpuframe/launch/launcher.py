@@ -23,6 +23,7 @@ Two launchers:
 
 from __future__ import annotations
 
+import re
 import os
 import socket
 import subprocess
@@ -122,6 +123,28 @@ class SliceLauncher:
         return subprocess.run(cmd, check=True)
 
 
+def run_with_relaunch(run_once, relaunches: int, *, log=print) -> int:
+    """Supervise a job through slice-restart recovery (SURVEY.md §5.3).
+
+    The failure model: jobs that stall or lose a host exit nonzero (the
+    harness's stall watchdog exits 13 precisely so a supervisor restarts
+    it), and the restarted job auto-resumes from the latest committed
+    checkpoint — the TPU-native replacement for hvd.elastic's in-place
+    re-rendezvous.  ``run_once() -> int`` is re-invoked until it returns 0
+    or ``relaunches`` restarts are spent."""
+    attempt = 0
+    while True:
+        rc = run_once()
+        if rc == 0 or attempt >= relaunches:
+            if rc != 0 and relaunches > 0:
+                log(f"[tpuframe.launch] giving up after {attempt} "
+                    f"relaunch(es); last rc={rc}")
+            return rc
+        attempt += 1
+        log(f"[tpuframe.launch] job exited rc={rc}; relaunch "
+            f"{attempt}/{relaunches} (resume from latest checkpoint)")
+
+
 def main(argv: list[str] | None = None) -> int:
     """CLI::
 
@@ -144,6 +167,8 @@ def main(argv: list[str] | None = None) -> int:
     lp.add_argument("--nprocs", type=int, default=2)
     lp.add_argument("--devices", type=int, default=4,
                     help="forced host devices per process")
+    lp.add_argument("--relaunch", type=int, default=0, metavar="N",
+                    help="restart a failed job up to N times (auto-resume)")
     lp.add_argument("cmd", nargs=argparse.REMAINDER)
 
     pp = sub.add_parser("provision", help="emit gcloud provisioning scripts")
@@ -157,18 +182,32 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--zone", default="us-central2-b")
     sp.add_argument("--accelerator", default="v4-32")
     sp.add_argument("--dry-run", action="store_true")
+    sp.add_argument("--relaunch", type=int, default=0, metavar="N",
+                    help="restart a failed job up to N times (auto-resume)")
     sp.add_argument("cmd", nargs=argparse.REMAINDER)
 
     args = p.parse_args(argv)
 
     if args.mode == "local":
         cmd = [c for c in args.cmd if c != "--"]
-        results = LocalCluster(args.nprocs, args.devices).launch(cmd)
-        for r in results:
-            prefix = f"[rank {r.process_id}] "
-            for line in r.stdout.strip().splitlines():
-                print(prefix + line)
-        return 0
+
+        def run_once() -> int:
+            try:
+                results = LocalCluster(args.nprocs, args.devices).launch(cmd)
+            except RuntimeError as e:
+                print(f"[tpuframe.launch] {e}")
+                # preserve the failure model's exit codes (13 = stall
+                # abort, 42-class = crash injection): surface the first
+                # failing rank's rc rather than flattening to 1.
+                m = re.search(r"exit (\d+)", str(e))
+                return int(m.group(1)) if m else 1
+            for r in results:
+                prefix = f"[rank {r.process_id}] "
+                for line in r.stdout.strip().splitlines():
+                    print(prefix + line)
+            return 0
+
+        return run_with_relaunch(run_once, args.relaunch)
 
     cfg = SliceConfig(name=args.name, zone=args.zone,
                       accelerator=args.accelerator)
@@ -182,10 +221,18 @@ def main(argv: list[str] | None = None) -> int:
 
     cmd = " ".join(c for c in args.cmd if c != "--")
     launcher = SliceLauncher(cfg, dry_run=args.dry_run)
-    out = launcher.launch(cmd)
     if args.dry_run:
-        print(" ".join(out))
-    return 0
+        print(" ".join(launcher.launch(cmd)))
+        return 0
+
+    def run_once() -> int:
+        try:
+            launcher.launch(cmd)
+        except subprocess.CalledProcessError as e:
+            return e.returncode or 1
+        return 0
+
+    return run_with_relaunch(run_once, args.relaunch)
 
 
 if __name__ == "__main__":

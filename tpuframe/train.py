@@ -458,6 +458,10 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
     # trailing state (uncommitted checkpoints, open logs).  HANG_STEP instead
     # simulates a wedged host (the peer-stall class the watchdog must catch).
     fault_step = int(os.environ.get("TPUFRAME_FAULT_STEP", "0") or "0")
+    # FAULT_ONCE: only fault on a from-scratch run — the relaunch/resume
+    # supervisor tests need the restarted job to survive the same step.
+    if os.environ.get("TPUFRAME_FAULT_ONCE") == "1" and h.start_step > 0:
+        fault_step = 0
     hang_step = int(os.environ.get("TPUFRAME_HANG_STEP", "0") or "0")
     hang_rank = int(os.environ.get("TPUFRAME_HANG_RANK", "-1") or "-1")
     if hang_rank >= 0 and jax.process_index() != hang_rank:
