@@ -1,0 +1,13 @@
+#!/bin/bash
+# Watches queue 2; when its runner exits (success or give-up), runs queue 3.
+# Queue 3's own patient claim loop handles a still-wedged relay.
+set -u
+cd "$(dirname "$0")/.."
+LOG=perf/results/chain.log
+echo "=== chain watcher $(date -u +%FT%TZ) ===" >> "$LOG"
+while pgrep -f "run_all_tpu2.sh" > /dev/null; do
+  sleep 60
+done
+echo "[chain $(date -u +%T)] queue 2 runner gone; starting queue 3" >> "$LOG"
+bash perf/run_all_tpu3.sh >> "$LOG" 2>&1
+echo "[chain $(date -u +%T)] queue 3 runner exited" >> "$LOG"
