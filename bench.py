@@ -86,15 +86,48 @@ def _emit(value: float, n_chips: int, **extra) -> None:
     print(json.dumps(line), flush=True)
 
 
+def _best_recorded() -> float | None:
+    """Best images/sec/chip among recorded on-chip runs (perf/results/
+    bench_*.out) — one source of truth for the 'last measured' annotation;
+    queued sweeps that find a new optimum update it automatically."""
+    import glob
+
+    best = None
+    here = os.path.dirname(os.path.abspath(__file__))
+    for f in glob.glob(os.path.join(here, "perf", "results", "bench_*.out")):
+        try:
+            with open(f) as fh:
+                lines = fh.read().strip().splitlines()
+            obj = json.loads(lines[-1]) if lines else {}
+        except (OSError, json.JSONDecodeError):
+            continue
+        v = obj.get("value")
+        if (isinstance(v, (int, float)) and not obj.get("degraded")
+                and (best is None or v > best)):
+            best = float(v)
+    return best
+
+
 def _watchdog() -> None:
     """Emit a (degraded) JSON line and hard-exit if the run overruns its
-    budget — a hung TPU relay must not turn into a silent driver timeout."""
+    budget — a hung TPU relay must not turn into a silent driver timeout.
+    A hang at import/claim stage is the relay-outage signature (PERF.md
+    §0); the degraded line then points at the last recorded on-chip
+    measurement (BASELINE.md) WITHOUT reporting it as this run's value."""
     if _DONE.wait(BUDGET_S) or _DONE.is_set():
         return  # main thread emitted the real result
-    _log(f"WATCHDOG: exceeded {BUDGET_S}s at stage "
-         f"{_RESULT.get('stage', 'unknown')!r}; emitting degraded result")
+    stage = _RESULT.get("stage", "unknown")
+    _log(f"WATCHDOG: exceeded {BUDGET_S}s at stage {stage!r}; "
+         f"emitting degraded result")
+    extra = {}
+    if stage == "import-jax":
+        extra = {"relay_outage_suspected": True}
+        best = _best_recorded()
+        if best is not None:
+            extra["last_measured_on_chip"] = best
+            extra["last_measured_source"] = "perf/results (see BASELINE.md)"
     _emit(_RESULT.get("best_value", 0.0), _RESULT.get("n_chips", 0),
-          degraded=True, stage=_RESULT.get("stage", "unknown"))
+          degraded=True, stage=stage, **extra)
     os._exit(0)
 
 

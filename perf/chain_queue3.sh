@@ -1,13 +1,24 @@
 #!/bin/bash
-# Watches queue 2; when its runner exits (success or give-up), runs queue 3.
+# Watches queue 2 (PID-anchored); when its runner exits, runs queue 3.
 # Queue 3's own patient claim loop handles a still-wedged relay.
 set -u
 cd "$(dirname "$0")/.."
 LOG=perf/results/chain.log
 echo "=== chain watcher $(date -u +%FT%TZ) ===" >> "$LOG"
-while pgrep -f "run_all_tpu2.sh" > /dev/null; do
-  sleep 60
+# Resolve the runner PID up front; allow up to 10 min for it to appear so a
+# watcher started first cannot racily conclude queue 2 already finished.
+pid=""
+for _ in $(seq 1 20); do
+  pid=$(pgrep -of "bash .*run_all_tpu2.sh" || true)
+  [ -n "$pid" ] && break
+  sleep 30
 done
-echo "[chain $(date -u +%T)] queue 2 runner gone; starting queue 3" >> "$LOG"
+if [ -n "$pid" ]; then
+  echo "[chain $(date -u +%T)] watching queue-2 runner pid=$pid" >> "$LOG"
+  while kill -0 "$pid" 2>/dev/null; do sleep 60; done
+else
+  echo "[chain $(date -u +%T)] no queue-2 runner found; proceeding" >> "$LOG"
+fi
+echo "[chain $(date -u +%T)] queue 2 done; starting queue 3" >> "$LOG"
 bash perf/run_all_tpu3.sh >> "$LOG" 2>&1
 echo "[chain $(date -u +%T)] queue 3 runner exited" >> "$LOG"
