@@ -60,15 +60,20 @@ class TestEndToEnd:
         # dropout rngs differ (per-replica decorrelation), so allow slack
         assert abs(m1["loss"] - m8["loss"]) < 0.35, (m1["loss"], m8["loss"])
 
-    def test_resume_continues_exactly(self, tmp_path):
+    @pytest.mark.parametrize("ckpt_async", [False, True])
+    def test_resume_continues_exactly(self, tmp_path, ckpt_async):
+        """Resume == straight run, for sync and async checkpointing (the
+        async case proves the background write/restore round-trip, not
+        mid-run commit timing — train() drains pending saves on exit)."""
         ck = str(tmp_path / "ck")
         base = get_config("smoke").with_overrides(
-            ckpt_dir=ck, ckpt_every=10, total_steps=20, log_every=10)
+            ckpt_dir=ck, ckpt_every=10, total_steps=20, log_every=10,
+            ckpt_async=ckpt_async)
         # run 20 steps straight through
         straight = train_mod.train(base)
         # run 10, stop, then "restart the job" and run to 20
-        part1 = train_mod.train(base.with_overrides(total_steps=10,
-                                                    ckpt_dir=ck + "2"))
+        train_mod.train(base.with_overrides(total_steps=10,
+                                            ckpt_dir=ck + "2"))
         part2 = train_mod.train(base.with_overrides(ckpt_dir=ck + "2"))
         assert part2["step"] == 20
         np.testing.assert_allclose(straight["loss"], part2["loss"],
