@@ -73,7 +73,7 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref,  # inputs
                 o_ref, lse_ref,                 # outputs
                 acc_ref, m_ref, l_ref,          # scratch
                 *, scale: float, causal: bool, block_q: int, block_k: int,
-                n_kv: int):
+                n_kv: int, precision=None):
     qi = pl.program_id(1)
     kv = pl.program_id(2)
 
@@ -87,7 +87,7 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref,  # inputs
         q = q_ref[0]                     # [bq, d]
         k = k_ref[0]                     # [bk, d]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q, k, (((1,), (1,)), ((), ())), precision=precision,
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
 
         keep = None                                       # [bq, bk] or None
@@ -116,7 +116,7 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref,  # inputs
 
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            precision=precision, preferred_element_type=jnp.float32)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -139,7 +139,8 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref,  # inputs
         lse_ref[0, 0] = lse[:, 0]
 
 
-def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret,
+               precision=None):
     bn, s_q, d = q.shape
     s_kv = k.shape[1]
     bq, bk = min(block_q, s_q), min(block_k, s_kv)
@@ -159,11 +160,11 @@ def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret):
         args.insert(0, mask[:, None, :])
         kernel = functools.partial(
             _fwd_kernel, scale=scale, causal=causal,
-            block_q=bq, block_k=bk, n_kv=n_kv)
+            block_q=bq, block_k=bk, n_kv=n_kv, precision=precision)
     else:
         kernel = functools.partial(
             _fwd_kernel, None, scale=scale, causal=causal,
-            block_q=bq, block_k=bk, n_kv=n_kv)
+            block_q=bq, block_k=bk, n_kv=n_kv, precision=precision)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -193,10 +194,10 @@ def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret):
 
 
 def _recompute_p(q_ref, k_ref, lse_ref, mask_ref, *, scale, causal,
-                 qi, kv, block_q, block_k):
+                 qi, kv, block_q, block_k, precision=None):
     """Rebuild the probability block from saved logsumexp (f32)."""
     s = jax.lax.dot_general(
-        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())), precision=precision,
         preferred_element_type=jnp.float32) * scale
     keep = None
     if mask_ref is not None:
@@ -215,7 +216,8 @@ def _recompute_p(q_ref, k_ref, lse_ref, mask_ref, *, scale, causal,
 
 
 def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, scale, causal, block_q, block_k, n_kv):
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k, n_kv,
+                   precision=None):
     qi = pl.program_id(1)
     kv = pl.program_id(2)
 
@@ -226,14 +228,15 @@ def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def compute():
         p = _recompute_p(q_ref, k_ref, lse_ref, mask_ref, scale=scale,
                          causal=causal, qi=qi, kv=kv,
-                         block_q=block_q, block_k=block_k)
+                         block_q=block_q, block_k=block_k,
+                         precision=precision)
         dp = jax.lax.dot_general(                       # dO @ V^T  [bq, bk]
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            precision=precision, preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0, 0][:, None])        # [bq, bk]
         dq_acc[...] += scale * jax.lax.dot_general(     # ds @ K    [bq, d]
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            precision=precision, preferred_element_type=jnp.float32)
 
     if causal:
         @pl.when(kv * block_k <= qi * block_q + (block_q - 1))
@@ -249,7 +252,8 @@ def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, causal, block_q, block_k, n_q):
+                    *, scale, causal, block_q, block_k, n_q,
+                    precision=None):
     kv = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -261,17 +265,18 @@ def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def compute():
         p = _recompute_p(q_ref, k_ref, lse_ref, mask_ref, scale=scale,
                          causal=causal, qi=qi, kv=kv,
-                         block_q=block_q, block_k=block_k)
+                         block_q=block_q, block_k=block_k,
+                         precision=precision)
         dv_acc[...] += jax.lax.dot_general(             # P^T @ dO  [bk, d]
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            precision=precision, preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            precision=precision, preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0, 0][:, None])
         dk_acc[...] += scale * jax.lax.dot_general(     # ds^T @ Q  [bk, d]
             ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            precision=precision, preferred_element_type=jnp.float32)
 
     if causal:
         @pl.when(qi * block_q + (block_q - 1) >= kv * block_k)
@@ -287,7 +292,7 @@ def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
-               block_q, block_k, interpret):
+               block_q, block_k, interpret, precision=None):
     bn, s_q, d = q.shape
     s_kv = k.shape[1]
     bq, bk = min(block_q, s_q), min(block_k, s_kv)
@@ -316,7 +321,8 @@ def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
         _bwd_dq_kernel, lambda h, b, i, j: (b // h, 0, j))
     dq = pl.pallas_call(
         functools.partial(kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, n_kv=n_kv),
+                          block_q=bq, block_k=bk, n_kv=n_kv,
+                          precision=precision),
         grid=(bn, n_q, n_kv),
         in_specs=mspec + [q_spec_qmajor, kv_spec_qmajor, kv_spec_qmajor,
                           q_spec_qmajor, row_spec_qmajor, row_spec_qmajor],
@@ -334,7 +340,8 @@ def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
         _bwd_dkv_kernel, lambda h, b, j, i: (b // h, 0, j))
     dk, dv = pl.pallas_call(
         functools.partial(kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, n_q=n_q),
+                          block_q=bq, block_k=bk, n_q=n_q,
+                          precision=precision),
         grid=(bn, n_kv, n_q),
         in_specs=mspec + [q_spec, kv_spec, kv_spec, q_spec, row_spec,
                           row_spec],
@@ -354,27 +361,28 @@ def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, mask, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, mask, causal, block_q, block_k, interpret, precision):
     out, _ = _flash_fwd(q, k, v, mask, scale=q.shape[-1] ** -0.5,
                         causal=causal, block_q=block_q, block_k=block_k,
-                        interpret=interpret)
+                        interpret=interpret, precision=precision)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, mask, causal, block_q, block_k, interpret):
+def _flash_vjp_fwd(q, k, v, mask, causal, block_q, block_k, interpret,
+                   precision):
     out, lse = _flash_fwd(q, k, v, mask, scale=q.shape[-1] ** -0.5,
                           causal=causal, block_q=block_q, block_k=block_k,
-                          interpret=interpret)
+                          interpret=interpret, precision=precision)
     return out, (q, k, v, mask, out, lse)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, do):
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, precision, res, do):
     q, k, v, mask, out, lse = res
     dq, dk, dv = _flash_bwd(q, k, v, mask, out, lse, do,
                             scale=q.shape[-1] ** -0.5, causal=causal,
                             block_q=block_q, block_k=block_k,
-                            interpret=interpret)
+                            interpret=interpret, precision=precision)
     return dq, dk, dv, None
 
 
@@ -384,7 +392,8 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 def flash_mha(q: jax.Array, k: jax.Array, v: jax.Array, *,
               mask: jax.Array | None = None, causal: bool = False,
               block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
-              interpret: bool | None = None) -> jax.Array:
+              interpret: bool | None = None,
+              precision=None) -> jax.Array:
     """Flash multi-head attention.
 
     Args:
@@ -394,6 +403,10 @@ def flash_mha(q: jax.Array, k: jax.Array, v: jax.Array, *,
         blocks are skipped entirely, halving the work.
       interpret: run under the Pallas interpreter (defaults to True off-TPU,
         which is how the CPU test suite executes this kernel).
+      precision: forwarded to every dot inside the kernels (fwd, recompute,
+        bwd).  None = backend default (bf16 MXU products for f32 inputs on
+        TPU); lax.Precision.HIGHEST requests multi-pass f32 — whether
+        Mosaic honors it on-chip is probed by perf/exp_precision_probe.py.
 
     Returns ``[batch, seq, heads, head_dim]`` attention output in q's dtype.
     """
@@ -412,5 +425,5 @@ def flash_mha(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     mask = None if mask is None else mask.astype(jnp.int32)
     out = _flash(fold(q), fold(k), fold(v), mask, causal,
-                 block_q, block_k, interpret)
+                 block_q, block_k, interpret, precision)
     return out.reshape(b, n, s_q, d).transpose(0, 2, 1, 3)
