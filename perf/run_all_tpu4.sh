@@ -1,0 +1,124 @@
+#!/bin/bash
+# Round-4 consolidated TPU queue — everything the round-3 relay outage
+# blocked, in VERDICT-priority order:
+#   1. FA on-chip tests after the f32-tolerance + precision plumbing fix
+#      (expect 8/8) + Mosaic precision probe (VERDICT missing #1 / weak #4)
+#   2. HLO byte census of the 143.5 GB/step (VERDICT missing #2)
+#   3. bench regeneration at all sweep batches under the corrected MFU
+#      accounting (VERDICT weak #2) — overwrites the stale "mfu: 0.1489"
+#      artifacts with honest chained-async numbers
+#   4. convergence + crash/resume proof (VERDICT missing #5)
+#   5. honest attention/breakdown timings (queue-2 carryover)
+#   6. transformer A/Bs: fused-xent, 8k/32k long context, BERT b256,
+#      remat on/off (queue-3 carryover; VERDICT missing #4)
+#   7. live autotune demo
+# Relay rules (PERF.md §0): ONE client, strictly serial, never kill a
+# client mid-claim.  Ends by chaining perf/run_all_tpu5.sh if present
+# (extension hook — a running bash script must not be edited in place).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p perf/results
+LOG=perf/results/run_all4.log
+echo "=== run_all_tpu4 $(date -u +%FT%TZ) ===" >> "$LOG"
+
+note() { echo "[run_all4 $(date -u +%T)] $*" | tee -a "$LOG"; }
+
+# Phase -1: wait out any claim probe left by an earlier queue (two clients
+# touching the relay at once violates the one-client rule).  This shell's
+# own cmdline never contains the probe marker, and this runs before phase
+# 0 launches our own probe, so a plain pgrep is self-exclusion-safe.
+while pgrep -f "CLAIM OK after" > /dev/null; do
+  note "waiting for a previous queue's claim probe to exit..."
+  sleep 60
+done
+
+note "phase 0: probing for chip claim (retry loop, up to ~8h)..."
+claimed=0
+for attempt in $(seq 1 96); do
+  timeout 2400 python -u -c "
+import time; t0=time.time()
+import jax, jax.numpy as jnp
+(jnp.ones((256,256), jnp.bfloat16) @ jnp.ones((256,256), jnp.bfloat16)).block_until_ready()
+print(f'CLAIM OK after {time.time()-t0:.1f}s', flush=True)
+" >> "$LOG" 2>&1 && { claimed=1; break; }
+  note "claim attempt $attempt failed; sleeping 180s"
+  sleep 180
+done
+if [ "$claimed" != 1 ]; then
+  note "phase 0 FAILED — relay wedged for the whole window; giving up"
+  exit 1
+fi
+note "chip claimed — running queue 4"
+
+run() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  note "START $name"
+  timeout "$tmo" "$@" > "perf/results/$name.out" 2> "perf/results/$name.err"
+  note "END $name rc=$?"
+}
+
+# --- 1. flash-attention proof --------------------------------------------
+TPUFRAME_TPU_TESTS=1 run fa_tpu_tests2 1800 \
+    python -m pytest tests/test_flash_attention_tpu.py -v
+run prec_probe 900 python perf/exp_precision_probe.py
+
+# --- 2. the byte census ---------------------------------------------------
+run hlo_dump 1800 python perf/exp_hlo_dump.py
+
+# --- 3. bench regeneration (corrected MFU accounting, honest timing) -----
+for b in 256 192 320 384 512 768 1024; do
+  TPUFRAME_BENCH_BATCH=$b run bench_b$b 1200 python bench.py
+done
+TPUFRAME_BENCH_BATCH=256 TPUFRAME_BENCH_STEM=space_to_depth \
+    run bench_s2d_256 1200 python bench.py
+# Retire the two stale-named artifacts ONLY once their reruns hold a real
+# (non-degraded) measurement — bench.py emits a value-0.0 degraded record
+# on watchdog timeout, which must not destroy the only prior measurement.
+ok_bench() { python - "$1" <<'EOF'
+import json, sys
+try:
+    rec = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+    sys.exit(0 if rec.get("value", 0) > 0 and not rec.get("degraded") else 1)
+except Exception:
+    sys.exit(1)
+EOF
+}
+if ok_bench perf/results/bench_b512.out; then
+  rm -f perf/results/bench_default.out perf/results/bench_default.err
+fi
+if ok_bench perf/results/bench_s2d_256.out; then
+  rm -f perf/results/bench_s2d.out perf/results/bench_s2d.err
+fi
+
+# --- 4. convergence + crash/resume proof ---------------------------------
+note "START exp_convergence (sub-script, has its own claim/retry phases)"
+bash perf/exp_convergence.sh >> "$LOG" 2>&1
+note "END exp_convergence rc=$?"
+
+# --- 5. honest attention + breakdown timings -----------------------------
+run attn_bench2 2400 python perf/bench_attention.py
+run breakdown2 1800 python perf/exp_breakdown.py
+
+# --- 6. transformer A/Bs -------------------------------------------------
+MODEL=lm XENT=fused run tf_lm_fusedxent 2400 python perf/bench_transformer.py
+MODEL=lm XENT=fused LM_BATCH=2 LM_SEQ=8192 \
+    run tf_lm_8k 2400 python perf/bench_transformer.py
+MODEL=lm XENT=fused LM_BATCH=1 LM_SEQ=32768 ATTN_ONLY=pallas \
+    run tf_lm_32k 2400 python perf/bench_transformer.py
+MODEL=bert BERT_BATCH=256 run tf_bert_b256 1800 python perf/bench_transformer.py
+MODEL=lm XENT=fused REMAT=0 run tf_lm_noremat 2400 python perf/bench_transformer.py
+MODEL=lm REMAT=0 run tf_lm_noremat_dense 2400 python perf/bench_transformer.py
+
+# --- 7. live autotune demo ----------------------------------------------
+TPUFRAME_BENCH_BATCH=256 TPUFRAME_BENCH_STEPS=8 TPUFRAME_BENCH_WARMUP=2 \
+    TPUFRAME_BENCH_BUDGET_S=850 \
+    run autotune_demo 4200 python -m tpuframe.obs.autotune \
+    --out perf/results/autotune_report.json --budget 4 --timeout 900 \
+    --axis "TPUFRAME_FUSION_THRESHOLD=,0,67108864" \
+    -- python bench.py
+
+note "queue 4 complete"
+if [ -x perf/run_all_tpu5.sh ] || [ -f perf/run_all_tpu5.sh ]; then
+  note "chaining queue 5"
+  bash perf/run_all_tpu5.sh
+fi
