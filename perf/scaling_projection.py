@@ -24,10 +24,19 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# --bytes-only N: print {"n_devices": N, "ar_bytes": B} as JSON and exit —
+# the mode tests/test_scaling32.py uses to verify the projection's central
+# assumption (all-reduce bytes are N-independent) at BOTH mesh endpoints.
+_N_DEVICES = 8
+if "--bytes-only" in sys.argv:
+    _N_DEVICES = int(sys.argv[sys.argv.index("--bytes-only") + 1])
+
 os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={_N_DEVICES}")
 
 import re
 
@@ -54,14 +63,15 @@ MEASURED_BATCH = 256
 CHIP = "v5e"
 
 
-def collective_bytes_per_step() -> int:
-    """Compile the DP ResNet-50 step on an 8-device virtual mesh; sum the
-    all-reduce operand bytes in the optimized HLO."""
-    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=8))
+def collective_bytes_per_step(n_devices: int = 8) -> int:
+    """Compile the DP ResNet-50 step on an ``n_devices`` virtual mesh; sum
+    the all-reduce operand bytes in the optimized HLO."""
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=n_devices))
     model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(16, 64, 64, 3)), jnp.bfloat16)
-    y = jnp.asarray(rng.integers(0, 1000, size=(16,)), jnp.int32)
+    batch = max(16, 2 * n_devices)
+    x = jnp.asarray(rng.normal(size=(batch, 64, 64, 3)), jnp.bfloat16)
+    y = jnp.asarray(rng.integers(0, 1000, size=(batch,)), jnp.int32)
     variables = model.init(jax.random.key(0), x[:2])
     tx = optax.sgd(0.1, momentum=0.9)
 
@@ -126,5 +136,10 @@ def project(ar_bytes: int):
 
 
 if __name__ == "__main__":
-    b = collective_bytes_per_step()
-    project(b)
+    b = collective_bytes_per_step(_N_DEVICES)
+    if "--bytes-only" in sys.argv:
+        import json
+
+        print(json.dumps({"n_devices": _N_DEVICES, "ar_bytes": b}))
+    else:
+        project(b)
