@@ -1,0 +1,119 @@
+"""MNLI — the second GLUE task (SURVEY.md §1 config 4 [B:10]): 3-way
+sentence-PAIR classification.  What's new vs SST-2, and therefore what
+these tests pin: header-located tsv parsing with '-' label drops, the
+``[CLS] a [SEP] b [SEP]`` pair encoding with 0/1 ``token_type_ids``
+(WordPiece parity vs HF for pairs), and the 3-class BERT head flowing
+through the harness.
+"""
+
+import numpy as np
+import pytest
+
+from tpuframe.data import datasets
+from tpuframe.utils import get_config
+
+from tpuframe import train as train_mod
+
+
+MNLI_TSV = "\t".join([
+    "index", "promptID", "pairID", "genre", "sentence1_binary_parse",
+    "sentence2_binary_parse", "sentence1_parse", "sentence2_parse",
+    "sentence1", "sentence2", "label1", "gold_label"]) + "\n" + "\n".join([
+    "\t".join(["0", "1", "1e", "fiction", "(p)", "(h)", "(p)", "(h)",
+               "the cat sat on the mat", "a cat is sitting",
+               "entailment", "entailment"]),
+    "\t".join(["1", "2", "2c", "travel", "(p)", "(h)", "(p)", "(h)",
+               "the train left at noon", "the train never ran",
+               "contradiction", "contradiction"]),
+    "\t".join(["2", "3", "3n", "letters", "(p)", "(h)", "(p)", "(h)",
+               "she wrote a letter", "she wrote to her brother",
+               "neutral", "neutral"]),
+    # No annotator consensus — must be dropped, not trained on.
+    "\t".join(["3", "4", "4x", "fiction", "(p)", "(h)", "(p)", "(h)",
+               "ambiguous premise", "ambiguous hypothesis",
+               "neutral", "-"]),
+])
+
+
+class TestMnliTsv:
+    @pytest.fixture()
+    def mnli_dir(self, tmp_path):
+        (tmp_path / "train.tsv").write_text(MNLI_TSV)
+        (tmp_path / "dev_matched.tsv").write_text(MNLI_TSV)
+        return str(tmp_path)
+
+    def test_parse_columns_by_header_and_drop_dash(self, mnli_dir):
+        train, dev = datasets.glue_mnli(mnli_dir, seq_len=32)
+        assert len(train) == 3  # the '-' row is gone
+        np.testing.assert_array_equal(train.columns["label"], [0, 2, 1])
+
+    def test_hash_fallback_pair_encoding(self, mnli_dir):
+        train, _ = datasets.glue_mnli(mnli_dir, seq_len=32)
+        ids = train.columns["input_ids"]
+        types = train.columns["token_type_ids"]
+        mask = train.columns["attention_mask"]
+        assert (ids[:, 0] == 101).all()
+        for i in range(3):
+            seps = np.flatnonzero(ids[i] == 102)
+            assert len(seps) == 2  # [CLS] a [SEP] b [SEP]
+            # Segment ids: 0 through the first [SEP], 1 from there to the
+            # second [SEP], 0 again in the padding.
+            assert types[i, :seps[0] + 1].max() == 0
+            assert types[i, seps[0] + 1:seps[1] + 1].min() == 1
+            assert types[i, seps[1] + 1:].max() == 0
+            assert mask[i, :seps[1] + 1].all() and not mask[i, seps[1] + 1:].any()
+
+
+class TestMnliSynthetic:
+    def test_shapes_and_learnable_signal(self):
+        train, ev = datasets.glue_mnli(None, seq_len=64, synthetic_size=128)
+        assert len(train) == 128
+        assert set(np.unique(train.columns["label"])) <= {0, 1, 2}
+        # Signal token encodes the label (the learnability hook).
+        np.testing.assert_array_equal(
+            train.columns["input_ids"][:, 1], 200 + train.columns["label"])
+        # Pair structure: token_type_ids 1-segment sits inside the mask.
+        types, mask = train.columns["token_type_ids"], train.columns["attention_mask"]
+        assert (types <= mask).all()
+        assert types.any(axis=1).all()  # every row HAS a B segment
+
+
+class TestWordPiecePairParity:
+    def test_pair_encoding_matches_hf(self, tmp_path):
+        transformers = pytest.importorskip("transformers")
+        from tpuframe.data.wordpiece import WordPieceTokenizer
+
+        vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "the", "cat",
+                 "sat", "on", "mat", "a", "is", "sitting", "##s", "dog"]
+        vpath = tmp_path / "vocab.txt"
+        vpath.write_text("\n".join(vocab) + "\n")
+        ours = WordPieceTokenizer(str(vpath))
+        theirs = transformers.BertTokenizer(str(vpath), do_lower_case=True)
+
+        pairs = [("the cat sat on the mat", "a cat is sitting"),
+                 ("the cats sat", "a dog is sitting on the mat"),
+                 ("the " * 30 + "cat", "dog " * 30)]  # forces pair truncation
+        enc_a = ours(pairs, max_length=24)
+        enc_b = theirs([p[0] for p in pairs], [p[1] for p in pairs],
+                       padding="max_length", truncation=True, max_length=24,
+                       return_tensors="np")
+        for key in ("input_ids", "attention_mask", "token_type_ids"):
+            np.testing.assert_array_equal(enc_a[key], enc_b[key], err_msg=key)
+
+
+class TestMnliHarness:
+    def test_bert_mnli_tiny_steps(self):
+        """The 3-class pair task end-to-end through the harness — same
+        graph as config glue_bert_mnli, tiny dimensions."""
+        cfg = get_config("glue_bert_mnli").with_overrides(
+            total_steps=2, global_batch=8, warmup_steps=1, log_every=1,
+            eval_every=2, eval_batches=1,
+            dataset_kwargs={"synthetic_size": 32, "seq_len": 32,
+                            "vocab_size": 512},
+            model_kwargs={"vocab_size": 512, "hidden_size": 64,
+                          "num_layers": 2, "num_heads": 2,
+                          "intermediate_size": 128, "max_position": 32})
+        assert cfg.model_kwargs["num_classes"] == 3  # merge kept the head
+        metrics = train_mod.train(cfg)
+        assert metrics["step"] == 2
+        assert np.isfinite(metrics["loss"])

@@ -202,17 +202,8 @@ def glue_sst2(data_dir: str | None = None, *, seq_len: int = 128,
     fine for allreduce-stress benchmarking only).
     """
     if data_dir is not None:
-        if tokenizer is None:
-            vpath = vocab_file or gcs.join(data_dir, "vocab.txt")
-            if gcs.exists(vpath):
-                from tpuframe.data.wordpiece import WordPieceTokenizer
+        tokenizer = _resolve_tokenizer(tokenizer, data_dir, vocab_file)
 
-                tokenizer = WordPieceTokenizer(vpath)
-            elif vocab_file is not None:
-                # An explicit vocab path that doesn't exist is a config error
-                # — silently hash-tokenizing would just show up as
-                # mysteriously bad accuracy.
-                raise FileNotFoundError(f"vocab_file not found: {vocab_file}")
         def load(name):
             text = gcs.read_bytes(gcs.join(data_dir, name)).decode()
             lines = text.strip().split("\n")[1:]  # header
@@ -229,7 +220,74 @@ def glue_sst2(data_dir: str | None = None, *, seq_len: int = 128,
             _synthetic_tokens(max(synthetic_size // 8, 64), seq_len, vocab_size, seed=7))
 
 
+MNLI_LABELS = {"entailment": 0, "neutral": 1, "contradiction": 2}
+
+
+def glue_mnli(data_dir: str | None = None, *, seq_len: int = 128,
+              vocab_size: int = 30522, synthetic_size: int = 1024,
+              tokenizer=None, vocab_file: str | None = None):
+    """MNLI sentence-PAIR classification (3-way: entailment / neutral /
+    contradiction) — the second GLUE task, exercising the ``[CLS] a [SEP]
+    b [SEP]`` pair-encoding path (``token_type_ids`` 0/1 segments) that
+    single-sentence SST-2 never touches.
+
+    With ``data_dir``: reads MNLI's ``train.tsv`` / ``dev_matched.tsv``.
+    MNLI tsv columns vary by split, so fields are located by HEADER NAME
+    (``sentence1``, ``sentence2``, ``gold_label``); rows with a missing or
+    ``-`` gold label (annotator disagreement) are dropped, matching the
+    standard evaluation protocol.  Tokenizer resolution is identical to
+    :func:`glue_sst2`.
+    """
+    if data_dir is not None:
+        tokenizer = _resolve_tokenizer(tokenizer, data_dir, vocab_file)
+
+        def load(name):
+            text = gcs.read_bytes(gcs.join(data_dir, name)).decode()
+            lines = text.strip().split("\n")
+            header = lines[0].split("\t")
+            col = {name: i for i, name in enumerate(header)}
+            ia, ib, il = (col["sentence1"], col["sentence2"],
+                          col["gold_label"])
+            pairs, labels = [], []
+            for line in lines[1:]:
+                f = line.split("\t")
+                if len(f) <= max(ia, ib, il):
+                    continue
+                lbl = f[il].strip()
+                if lbl not in MNLI_LABELS:
+                    continue  # '-' = no gold consensus
+                pairs.append((f[ia], f[ib]))
+                labels.append(MNLI_LABELS[lbl])
+            return _tokenize(pairs, np.asarray(labels, np.int32), seq_len,
+                             vocab_size, tokenizer)
+
+        return load("train.tsv"), load("dev_matched.tsv")
+    return (_synthetic_token_pairs(synthetic_size, seq_len, vocab_size,
+                                   seed=8),
+            _synthetic_token_pairs(max(synthetic_size // 8, 64), seq_len,
+                                   vocab_size, seed=9))
+
+
+def _resolve_tokenizer(tokenizer, data_dir, vocab_file):
+    """glue_* shared tokenizer resolution: caller-supplied > WordPiece with
+    a real vocab > None (hash fallback in _tokenize)."""
+    if tokenizer is not None:
+        return tokenizer
+    vpath = vocab_file or gcs.join(data_dir, "vocab.txt")
+    if gcs.exists(vpath):
+        from tpuframe.data.wordpiece import WordPieceTokenizer
+
+        return WordPieceTokenizer(vpath)
+    if vocab_file is not None:
+        # An explicit vocab path that doesn't exist is a config error —
+        # silently hash-tokenizing would just show up as mysteriously bad
+        # accuracy.
+        raise FileNotFoundError(f"vocab_file not found: {vocab_file}")
+    return None
+
+
 def _tokenize(sents, labels, seq_len, vocab_size, tokenizer):
+    """``sents``: strings, or (a, b) pair tuples for two-sentence tasks."""
     if tokenizer is not None:
         enc = tokenizer(sents, padding="max_length", truncation=True,
                         max_length=seq_len, return_tensors="np")
@@ -246,13 +304,40 @@ def _tokenize(sents, labels, seq_len, vocab_size, tokenizer):
     # WordPiece tokenizer.
     ids = np.zeros((len(sents), seq_len), np.int32)
     mask = np.zeros((len(sents), seq_len), np.int32)
+    types = np.zeros((len(sents), seq_len), np.int32)
+    hashed = lambda w: 2 + (zlib.crc32(w.encode()) % (vocab_size - 4))  # noqa: E731
     for i, s in enumerate(sents):
-        toks = [101] + [2 + (zlib.crc32(w.encode()) % (vocab_size - 4))
-                        for w in s.split()][: seq_len - 2] + [102]
+        if isinstance(s, tuple):
+            a, b = ([hashed(w) for w in part.split()] for part in s)
+            while len(a) + len(b) > seq_len - 3:  # HF longest_first order
+                (a if len(a) > len(b) else b).pop()
+            toks = [101] + a + [102] + b + [102]
+            types[i, len(a) + 2:len(toks)] = 1
+        else:
+            toks = [101] + [hashed(w) for w in s.split()][: seq_len - 2] + [102]
         ids[i, :len(toks)] = toks
         mask[i, :len(toks)] = 1
     return ArrayDataset({"input_ids": ids, "attention_mask": mask,
-                         "token_type_ids": np.zeros_like(ids), "label": labels})
+                         "token_type_ids": types, "label": labels})
+
+
+def _synthetic_token_pairs(n, seq_len, vocab_size, *, seed):
+    """Synthetic pair-encoded batches with 3 learnable classes: the signal
+    token (position 1) carries the label, and segment B starts at a
+    variable boundary so token_type_ids actually vary."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 3, size=n).astype(np.int32)
+    ids = rng.integers(4, vocab_size, size=(n, seq_len)).astype(np.int32)
+    ids[:, 0] = 101
+    ids[:, 1] = 200 + labels
+    lengths = rng.integers(seq_len // 2, seq_len + 1, size=n)
+    bounds = rng.integers(2, np.maximum(lengths - 1, 3))
+    pos = np.arange(seq_len)[None, :]
+    mask = (pos < lengths[:, None]).astype(np.int32)
+    types = ((pos >= bounds[:, None]) & (pos < lengths[:, None])).astype(
+        np.int32)
+    return ArrayDataset({"input_ids": ids, "attention_mask": mask,
+                         "token_type_ids": types, "label": labels})
 
 
 # ---------------------------------------------------------------------------

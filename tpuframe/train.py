@@ -47,13 +47,14 @@ def build_datasets(cfg: TrainConfig):
         "cifar10": datasets.cifar10,
         "imagenet": datasets.imagenet,
         "glue_sst2": datasets.glue_sst2,
+        "glue_mnli": datasets.glue_mnli,
         "lm_text": datasets.lm_text,
     }[cfg.dataset]
     return builder(cfg.data_dir, **cfg.dataset_kwargs)
 
 
 def _is_text_task(cfg: TrainConfig) -> bool:
-    return cfg.dataset == "glue_sst2"
+    return cfg.dataset in ("glue_sst2", "glue_mnli")
 
 
 def _is_lm_task(cfg: TrainConfig) -> bool:

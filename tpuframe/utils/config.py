@@ -149,6 +149,17 @@ def _glue_bert() -> TrainConfig:
     )
 
 
+def _glue_bert_mnli() -> TrainConfig:
+    """Config 4 [B:10], second GLUE task: BERT-base MNLI fine-tune — the
+    3-way sentence-PAIR format ([CLS] premise [SEP] hypothesis [SEP],
+    segment ids 0/1), exercising the pair-encoding path SST-2 doesn't.
+    Standard MNLI recipe: 3 epochs over 393k pairs at batch 32."""
+    return _glue_bert().with_overrides(
+        name="glue_bert_mnli", dataset="glue_mnli",
+        model_kwargs={"num_classes": 3}, total_steps=36000, warmup_steps=1200,
+    )
+
+
 def _imagenet_resnet50_pod() -> TrainConfig:
     """Config 5 [B:11]: ResNet-50 / ImageNet on a multi-host pod (v4-32).
     Same recipe as config 3 at 4x the batch; launched via tpuframe.launch."""
@@ -225,6 +236,7 @@ WORKLOADS = {
     "cifar10_resnet18": _cifar10_resnet18,
     "imagenet_resnet50": _imagenet_resnet50,
     "glue_bert": _glue_bert,
+    "glue_bert_mnli": _glue_bert_mnli,
     "imagenet_resnet50_pod": _imagenet_resnet50_pod,
     "lm_long": _lm_long,
     "lm_smoke": _lm_smoke,
