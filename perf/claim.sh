@@ -1,0 +1,43 @@
+# Shared TPU-relay claim helpers — source this, don't run it.
+#
+# Relay rules (PERF.md §0): ONE client at a time; never kill a client
+# mid-claim (a killed client wedges the chip grant for 10+ min); a wedged
+# relay raises UNAVAILABLE from backend init only after ~25 min of
+# internal retries, so claims are patient clean-exiting probes in a retry
+# loop rather than a single blocking attempt.
+#
+# claim_wait_for_others        — block until no other claim probe is live
+#                                (the one-client rule across queues).
+# claim_chip [attempts] [log]  — retry loop; returns 0 once a probe claims
+#                                the chip, 1 if every attempt failed.
+# The probe's "CLAIM OK after" marker text is load-bearing: it is both the
+# success line in the logs and the pgrep signature claim_wait_for_others
+# scans for.
+
+CLAIM_MARKER="CLAIM OK after"
+
+claim_wait_for_others() {
+  # A sourcing script's own cmdline never contains the marker (it lives
+  # only inside the probe's python -c), and this runs before that script
+  # launches its own probe, so a plain pgrep is self-exclusion-safe.
+  while pgrep -f "$CLAIM_MARKER" > /dev/null; do
+    echo "[claim $(date -u +%T)] waiting for another queue's claim probe..."
+    sleep 60
+  done
+}
+
+claim_chip() { # [attempts=60] [logfile=/dev/stdout]
+  local attempts=${1:-60} log=${2:-/dev/stdout} attempt
+  for attempt in $(seq 1 "$attempts"); do
+    timeout 2400 python -u -c "
+import time; t0=time.time()
+import jax, jax.numpy as jnp
+(jnp.ones((256,256), jnp.bfloat16) @ jnp.ones((256,256), jnp.bfloat16)).block_until_ready()
+print(f'$CLAIM_MARKER {time.time()-t0:.1f}s', flush=True)
+" >> "$log" 2>&1 && return 0
+    echo "[claim $(date -u +%T)] attempt $attempt failed; sleeping 180s" \
+      | tee -a "$log"
+    sleep 180
+  done
+  return 1
+}

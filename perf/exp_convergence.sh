@@ -20,21 +20,8 @@ cd "$(dirname "$0")/.."
 mkdir -p perf/results
 LOG=perf/results/convergence.log
 CKPT=perf/results/conv_ckpt
+. perf/claim.sh
 note() { echo "[conv $(date -u +%T)] $*" | tee -a "$LOG"; }
-
-claim() { # patient chip claim: clean-exiting probes, never killed mid-claim
-  for attempt in $(seq 1 "${1:-40}"); do
-    timeout 2400 python -u -c "
-import time; t0=time.time()
-import jax, jax.numpy as jnp
-(jnp.ones((256,256), jnp.bfloat16) @ jnp.ones((256,256), jnp.bfloat16)).block_until_ready()
-print(f'CLAIM OK after {time.time()-t0:.1f}s', flush=True)
-" >> "$LOG" 2>&1 && return 0
-    note "claim attempt $attempt failed; sleeping 180s"
-    sleep 180
-  done
-  return 1
-}
 
 echo "=== exp_convergence $(date -u +%FT%TZ) ===" >> "$LOG"
 rm -rf "$CKPT" "$CKPT-r50" perf/results/conv_a.jsonl \
@@ -54,7 +41,7 @@ rc=$?
 note "phase A exited rc=$rc (expect 42 = injected crash)"
 
 note "phase A2: re-claim after the crash (grant may be wedged ~10min)"
-claim 40 || { note "re-claim FAILED; aborting"; exit 1; }
+claim_chip 40 "$LOG" || { note "re-claim FAILED; aborting"; exit 1; }
 
 note "phase B: resume from last committed ckpt, run to step 600"
 timeout 2400 python -m tpuframe.train "${CIFAR_ARGS[@]}" \

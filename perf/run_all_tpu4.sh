@@ -20,31 +20,15 @@ cd "$(dirname "$0")/.."
 mkdir -p perf/results
 LOG=perf/results/run_all4.log
 echo "=== run_all_tpu4 $(date -u +%FT%TZ) ===" >> "$LOG"
+. perf/claim.sh
 
 note() { echo "[run_all4 $(date -u +%T)] $*" | tee -a "$LOG"; }
 
-# Phase -1: wait out any claim probe left by an earlier queue (two clients
-# touching the relay at once violates the one-client rule).  This shell's
-# own cmdline never contains the probe marker, and this runs before phase
-# 0 launches our own probe, so a plain pgrep is self-exclusion-safe.
-while pgrep -f "CLAIM OK after" > /dev/null; do
-  note "waiting for a previous queue's claim probe to exit..."
-  sleep 60
-done
+# Phase -1: the one-client rule across queues.
+claim_wait_for_others | tee -a "$LOG"
 
 note "phase 0: probing for chip claim (retry loop, up to ~8h)..."
-claimed=0
-for attempt in $(seq 1 96); do
-  timeout 2400 python -u -c "
-import time; t0=time.time()
-import jax, jax.numpy as jnp
-(jnp.ones((256,256), jnp.bfloat16) @ jnp.ones((256,256), jnp.bfloat16)).block_until_ready()
-print(f'CLAIM OK after {time.time()-t0:.1f}s', flush=True)
-" >> "$LOG" 2>&1 && { claimed=1; break; }
-  note "claim attempt $attempt failed; sleeping 180s"
-  sleep 180
-done
-if [ "$claimed" != 1 ]; then
+if ! claim_chip 96 "$LOG"; then
   note "phase 0 FAILED — relay wedged for the whole window; giving up"
   exit 1
 fi
