@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-4 TPU queue #5 (chained from run_all_tpu4.sh's extension hook):
+# close VERDICT stretch #8 — pallas flash attention must be <= XLA at
+# seq 2k, not 5% slower.  The kernel changes this round (dimension
+# semantics declared parallel, causal interior blocks skip the tri-mask
+# VPU chain, env-tunable block sizes) shift the landscape; this queue
+# measures it:
+#   1. block-size sweep at 2k/4k, fwd + fwd/bwd (block sizes are read
+#      from env at import, so each point is its own process)
+#   2. LM train-step pallas-vs-xla A/B with the tuned kernel
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p perf/results
+LOG=perf/results/run_all5.log
+echo "=== run_all_tpu5 $(date -u +%FT%TZ) ===" >> "$LOG"
+. perf/claim.sh
+
+note() { echo "[run_all5 $(date -u +%T)] $*" | tee -a "$LOG"; }
+
+claim_wait_for_others | tee -a "$LOG"
+note "phase 0: chip claim (short loop; usually chained from a hot queue 4)"
+if ! claim_chip 20 "$LOG"; then
+  note "phase 0 FAILED; giving up"
+  exit 1
+fi
+
+run() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  note "START $name"
+  timeout "$tmo" "$@" > "perf/results/$name.out" 2> "perf/results/$name.err"
+  note "END $name rc=$?"
+}
+
+# 1. Block-size sweep.  (128,128) is the round-3 baseline point but with
+# this round's kernel scheduling changes — the direct A/B for them.
+for blocks in 128x128 128x256 128x512 256x256 256x512 512x512; do
+  bq=${blocks%x*} bk=${blocks#*x}
+  SEQS=2048,4096 TPUFRAME_FA_BLOCK_Q=$bq TPUFRAME_FA_BLOCK_K=$bk \
+      run fa_sweep_$blocks 1800 python perf/bench_attention.py
+done
+
+# 2. Train-step A/B at the standard LM shape with the (default-block)
+# optimized kernel — the number VERDICT #8 compares: pallas vs xla ms/step.
+MODEL=lm run tf_lm_2k_opt 2400 python perf/bench_transformer.py
+
+note "queue 5 complete"

@@ -24,14 +24,17 @@ kernel under the Pallas interpreter.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# 128 = the MXU tile edge; env-overridable so on-chip sweeps
+# (perf/bench_attention.py) can tune without code edits.
+DEFAULT_BLOCK_Q = int(os.environ.get("TPUFRAME_FA_BLOCK_Q", "128"))
+DEFAULT_BLOCK_K = int(os.environ.get("TPUFRAME_FA_BLOCK_K", "128"))
 NEG_INF = -1e30  # softmax mask fill; finite so (x - x) stays 0, not nan
 
 _LANES = 128  # VMEM lane width: per-row stats are stored lane-broadcast
@@ -39,6 +42,30 @@ _LANES = 128  # VMEM lane width: per-row stats are stored lane-broadcast
 
 def _auto_interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _causal_dispatch(causal, qi, kv, block_q, block_k, compute):
+    """Run ``compute(need_tri)`` for this block's causal region.
+
+    Three regions by block position: strictly ABOVE the diagonal
+    contributes nothing (skip entirely); STRADDLING it needs the
+    per-element tri mask; strictly BELOW needs no tri at all — for long
+    sequences most blocks are below, so skipping the iota/compare/select
+    chain there removes real VPU work.  Non-causal: one unmasked call.
+    """
+    if not causal:
+        compute(False)
+        return
+    first_row, last_row = qi * block_q, qi * block_q + (block_q - 1)
+    first_col, last_col = kv * block_k, kv * block_k + (block_k - 1)
+
+    @pl.when(first_row >= last_col)
+    def _below():
+        compute(False)
+
+    @pl.when(jnp.logical_and(last_row >= first_col, first_row < last_col))
+    def _straddle():
+        compute(True)
 
 
 def _sds(like: jax.Array, shape, dtype) -> jax.ShapeDtypeStruct:
@@ -83,7 +110,7 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref,  # inputs
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    def compute():
+    def compute(need_tri):
         q = q_ref[0]                     # [bq, d]
         k = k_ref[0]                     # [bk, d]
         s = jax.lax.dot_general(
@@ -93,7 +120,7 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref,  # inputs
         keep = None                                       # [bq, bk] or None
         if mask_ref is not None:
             keep = jnp.broadcast_to(mask_ref[0, 0][None, :] != 0, s.shape)
-        if causal:
+        if need_tri:
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             tri = qi * block_q + rows >= kv * block_k + cols
@@ -120,13 +147,7 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref,  # inputs
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    if causal:
-        # Blocks strictly above the diagonal contribute nothing: skip.
-        @pl.when(kv * block_k <= qi * block_q + (block_q - 1))
-        def _():
-            compute()
-    else:
-        compute()
+    _causal_dispatch(causal, qi, kv, block_q, block_k, compute)
 
     @pl.when(kv == n_kv - 1)
     def _finalize():
@@ -183,6 +204,11 @@ def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret,
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, _LANES), jnp.float32),
         ],
+        # batch and q-block dims carry no cross-iteration state (the
+        # acc/m/l scratch carry lives on the kv dim only): declaring them
+        # parallel lets Mosaic schedule/pipeline them freely.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
     return out, lse[:, 0, :]
@@ -193,7 +219,7 @@ def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret,
 # ---------------------------------------------------------------------------
 
 
-def _recompute_p(q_ref, k_ref, lse_ref, mask_ref, *, scale, causal,
+def _recompute_p(q_ref, k_ref, lse_ref, mask_ref, *, scale, need_tri,
                  qi, kv, block_q, block_k, precision=None):
     """Rebuild the probability block from saved logsumexp (f32)."""
     s = jax.lax.dot_general(
@@ -202,7 +228,7 @@ def _recompute_p(q_ref, k_ref, lse_ref, mask_ref, *, scale, causal,
     keep = None
     if mask_ref is not None:
         keep = jnp.broadcast_to(mask_ref[0, 0][None, :] != 0, s.shape)
-    if causal:
+    if need_tri:
         rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         tri = qi * block_q + rows >= kv * block_k + cols
@@ -225,9 +251,9 @@ def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    def compute():
+    def compute(need_tri):
         p = _recompute_p(q_ref, k_ref, lse_ref, mask_ref, scale=scale,
-                         causal=causal, qi=qi, kv=kv,
+                         need_tri=need_tri, qi=qi, kv=kv,
                          block_q=block_q, block_k=block_k,
                          precision=precision)
         dp = jax.lax.dot_general(                       # dO @ V^T  [bq, bk]
@@ -238,12 +264,7 @@ def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             precision=precision, preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(kv * block_k <= qi * block_q + (block_q - 1))
-        def _():
-            compute()
-    else:
-        compute()
+    _causal_dispatch(causal, qi, kv, block_q, block_k, compute)
 
     @pl.when(kv == n_kv - 1)
     def _():
@@ -262,9 +283,9 @@ def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    def compute():
+    def compute(need_tri):
         p = _recompute_p(q_ref, k_ref, lse_ref, mask_ref, scale=scale,
-                         causal=causal, qi=qi, kv=kv,
+                         need_tri=need_tri, qi=qi, kv=kv,
                          block_q=block_q, block_k=block_k,
                          precision=precision)
         dv_acc[...] += jax.lax.dot_general(             # P^T @ dO  [bk, d]
@@ -278,12 +299,7 @@ def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             precision=precision, preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(qi * block_q + (block_q - 1) >= kv * block_k)
-        def _():
-            compute()
-    else:
-        compute()
+    _causal_dispatch(causal, qi, kv, block_q, block_k, compute)
 
     @pl.when(qi == n_q - 1)
     def _():
@@ -329,6 +345,8 @@ def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=_sds(q, q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(      # dq carry: kv dim only
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*margs, *common)
 
@@ -351,6 +369,8 @@ def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
                    _sds(q, v.shape, v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(      # dk/dv carry: q dim only
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*margs, *common)
     return dq, dk, dv
