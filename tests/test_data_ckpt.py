@@ -125,6 +125,21 @@ class TestShardedLoader:
         seen = [next(it) for _ in range(5)]  # 2 steps/epoch -> crosses twice
         assert len(seen) == 5
 
+    def test_cast_floats_halves_infeed_and_matches_device_cast(self):
+        import jax.numpy as jnp
+
+        train, _ = mnist(synthetic_size=64)
+        plain = next(ShardedLoader(train, 16, shuffle=False).epoch(0))
+        cast = next(ShardedLoader(train, 16, shuffle=False,
+                                  cast_floats=jnp.bfloat16).epoch(0))
+        assert cast["image"].dtype == jnp.bfloat16
+        assert cast["label"].dtype == plain["label"].dtype  # ints untouched
+        # Host-side numpy rounding == on-device XLA convert (both RNE), so
+        # feeding the cast batch is bit-identical to casting after transfer.
+        np.testing.assert_array_equal(
+            np.asarray(plain["image"].astype(jnp.bfloat16)),
+            np.asarray(cast["image"]))
+
 
 class TestGcsAbstraction:
     def test_local_roundtrip_and_atomicity(self, tmp_path):

@@ -108,10 +108,16 @@ def build_harness(cfg: TrainConfig) -> Harness:
 
     train_ds, eval_ds = build_datasets(cfg)
     loader_part, step_part, reduce_axes = _batch_layout(cfg)
+    # Float inputs are host-cast to the compute dtype before transfer (the
+    # model's first op would cast them on device anyway; bf16 halves
+    # infeed bytes — same rounding, same losses).
+    cast = dtype if dtype != jnp.float32 else None
     train_loader = ShardedLoader(train_ds, cfg.global_batch, data_mesh,
-                                 seed=cfg.seed, partition=loader_part)
+                                 seed=cfg.seed, partition=loader_part,
+                                 cast_floats=cast)
     eval_loader = ShardedLoader(eval_ds, cfg.global_batch, data_mesh,
-                                shuffle=False, partition=loader_part)
+                                shuffle=False, partition=loader_part,
+                                cast_floats=cast)
 
     sample = train_ds[:2]
     rng = jax.random.key(cfg.seed)
