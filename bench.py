@@ -204,8 +204,12 @@ def run(batch_per_chip: int, warmup: int, measure: int) -> float:
 
     # TPUFRAME_BENCH_STEM=space_to_depth A/Bs the MXU-friendly stem
     # reformulation (models/resnet.py; exact-function-preserving).
+    # TPUFRAME_BENCH_REMAT=1 A/Bs per-block rematerialization (trades idle
+    # MXU flops for HBM bytes on the bandwidth-bound step).
     stem = os.environ.get("TPUFRAME_BENCH_STEM", "conv")
-    model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem)
+    remat = os.environ.get("TPUFRAME_BENCH_REMAT", "0") == "1"
+    model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem,
+                            remat=remat)
     rng = np.random.default_rng(0)
     # bf16 on the host: halves infeed bytes and skips the on-device cast.
     x = rng.normal(0.5, 0.25, size=(global_batch, IMAGE_SIZE, IMAGE_SIZE, 3)

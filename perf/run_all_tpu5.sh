@@ -43,4 +43,10 @@ done
 # optimized kernel — the number VERDICT #8 compares: pallas vs xla ms/step.
 MODEL=lm run tf_lm_2k_opt 2400 python perf/bench_transformer.py
 
+# 3. ResNet remat A/B: on a bandwidth-bound step (81% of the HBM roofline,
+# MXU 29% busy) recomputing intra-block activations with idle MXU cycles
+# may beat storing+reloading them.
+TPUFRAME_BENCH_BATCH=256 TPUFRAME_BENCH_REMAT=1 \
+    run bench_b256_remat 1200 python bench.py
+
 note "queue 5 complete"

@@ -112,6 +112,12 @@ class ResNet(nn.Module):
     # stride-2 conv wastes the systolic array's reduction dim; this is the
     # MLPerf-style recipe, exactly function-preserving per s2d_stem_kernel).
     stem: str = "conv"
+    # Rematerialize each residual block in the backward pass: only block
+    # boundaries are saved forward; intra-block activations are recomputed.
+    # On a bandwidth-bound step (PERF.md §2: 81% of the HBM roofline, MXU
+    # ~29% busy) this trades idle MXU flops for HBM bytes — A/B'd on-chip
+    # via TPUFRAME_BENCH_REMAT.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -139,10 +145,18 @@ class ResNet(nn.Module):
         if not self.cifar_stem:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
 
+        block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
+        # Explicit names matching flax's auto-naming of the UNwrapped class:
+        # nn.remat renames modules ("CheckpointBottleneck_0"), which would
+        # silently re-key the param tree and orphan existing checkpoints
+        # whenever remat is toggled.
+        block_idx = 0
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = self.block_cls(self.width * 2 ** i, strides, conv, norm)(x)
+                x = block_cls(self.width * 2 ** i, strides, conv, norm,
+                              name=f"{self.block_cls.__name__}_{block_idx}")(x)
+                block_idx += 1
 
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.num_classes, dtype=self.dtype,
@@ -151,15 +165,17 @@ class ResNet(nn.Module):
 
 
 def ResNet18(num_classes: int = 10, *, cifar_stem: bool = True,
-             dtype: jnp.dtype = jnp.float32) -> ResNet:
+             dtype: jnp.dtype = jnp.float32, remat: bool = False) -> ResNet:
     """Config 2 default: ResNet-18 with the CIFAR stem ([B:8])."""
     return ResNet(stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock,
-                  num_classes=num_classes, cifar_stem=cifar_stem, dtype=dtype)
+                  num_classes=num_classes, cifar_stem=cifar_stem, dtype=dtype,
+                  remat=remat)
 
 
 def ResNet50(num_classes: int = 1000, *, cifar_stem: bool = False,
-             dtype: jnp.dtype = jnp.float32, stem: str = "conv") -> ResNet:
+             dtype: jnp.dtype = jnp.float32, stem: str = "conv",
+             remat: bool = False) -> ResNet:
     """Configs 3/5: ResNet-50 v1.5 for ImageNet ([B:9][B:11])."""
     return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck,
                   num_classes=num_classes, cifar_stem=cifar_stem, dtype=dtype,
-                  stem=stem)
+                  stem=stem, remat=remat)
