@@ -15,6 +15,12 @@
 # scans for.
 
 CLAIM_MARKER="CLAIM OK after"
+# Graceful halt: touch this file and every queue exits before its next
+# claim attempt or benchmark run (so e.g. the driver's end-of-round
+# bench.py is never blocked behind a queue's chip claim).
+STOP_SENTINEL="perf/STOP"
+
+queue_should_stop() { [ -e "$STOP_SENTINEL" ]; }
 
 claim_wait_for_others() {
   # A sourcing script's own cmdline never contains the marker (it lives
@@ -29,6 +35,11 @@ claim_wait_for_others() {
 claim_chip() { # [attempts=60] [logfile=/dev/stdout]
   local attempts=${1:-60} log=${2:-/dev/stdout} attempt
   for attempt in $(seq 1 "$attempts"); do
+    if queue_should_stop; then
+      echo "[claim $(date -u +%T)] STOP sentinel present; aborting claim" \
+        | tee -a "$log"
+      return 1
+    fi
     timeout 2400 python -u -c "
 import time; t0=time.time()
 import jax, jax.numpy as jnp

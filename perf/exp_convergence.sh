@@ -32,6 +32,7 @@ CIFAR_ARGS=(--config cifar10_resnet18
   --set ckpt_async=True --set log_every=10 --set eval_every=300
   --set eval_batches=4 --ckpt-dir "$CKPT")
 
+queue_should_stop && { note "STOP sentinel present; exiting"; exit 0; }
 note "phase A: cifar10_resnet18, crash injected at step 350"
 TPUFRAME_FAULT_STEP=350 TPUFRAME_FAULT_ONCE=1 \
   timeout 2400 python -m tpuframe.train "${CIFAR_ARGS[@]}" \
@@ -43,12 +44,14 @@ note "phase A exited rc=$rc (expect 42 = injected crash)"
 note "phase A2: re-claim after the crash (grant may be wedged ~10min)"
 claim_chip 40 "$LOG" || { note "re-claim FAILED; aborting"; exit 1; }
 
+queue_should_stop && { note "STOP sentinel present; exiting"; exit 0; }
 note "phase B: resume from last committed ckpt, run to step 600"
 timeout 2400 python -m tpuframe.train "${CIFAR_ARGS[@]}" \
   --log-file perf/results/conv_b.jsonl \
   > perf/results/conv_b.out 2>&1
 note "phase B exited rc=$?"
 
+queue_should_stop && { note "STOP sentinel present; exiting"; exit 0; }
 note "phase C: imagenet_resnet50 synthetic, 300 sustained steps @ batch 256"
 timeout 3000 python -m tpuframe.train --config imagenet_resnet50 \
   --set total_steps=300 --set warmup_steps=50 --set global_batch=256 \

@@ -36,6 +36,10 @@ note "chip claimed — running queue 4"
 
 run() { # name timeout cmd...
   local name=$1 tmo=$2; shift 2
+  if queue_should_stop; then
+    note "STOP sentinel present; skipping $name and exiting"
+    exit 0
+  fi
   note "START $name"
   timeout "$tmo" "$@" > "perf/results/$name.out" 2> "perf/results/$name.err"
   note "END $name rc=$?"
