@@ -101,6 +101,65 @@ class TestWordPiecePairParity:
             np.testing.assert_array_equal(enc_a[key], enc_b[key], err_msg=key)
 
 
+STSB_TSV = "\t".join([
+    "index", "genre", "filename", "year", "old_index", "source1", "source2",
+    "sentence1", "sentence2", "score"]) + "\n" + "\n".join([
+    "\t".join(["0", "main-captions", "f", "2012", "1", "n", "n",
+               "a plane is taking off", "an air plane is taking off", "5.0"]),
+    "\t".join(["1", "main-captions", "f", "2012", "2", "n", "n",
+               "a man is playing a flute", "a man is eating food", "0.8"]),
+    # Unscored row (test-set shape) — must be dropped.
+    "\t".join(["2", "main-captions", "f", "2012", "3", "n", "n",
+               "x", "y", ""]),
+])
+
+
+class TestStsb:
+    def test_tsv_scores_parsed_and_unscored_dropped(self, tmp_path):
+        (tmp_path / "train.tsv").write_text(STSB_TSV)
+        (tmp_path / "dev.tsv").write_text(STSB_TSV)
+        train, _ = datasets.glue_stsb(str(tmp_path), seq_len=32)
+        assert len(train) == 2
+        assert train.columns["label"].dtype == np.float32
+        np.testing.assert_allclose(train.columns["label"], [5.0, 0.8])
+
+    def test_synthetic_score_signal(self):
+        train, _ = datasets.glue_stsb(None, seq_len=64, synthetic_size=128)
+        labels = train.columns["label"]
+        assert labels.dtype == np.float32
+        assert 0.0 <= labels.min() and labels.max() <= 5.0
+        # Score is decodable from the signal token — the learnability hook.
+        np.testing.assert_allclose(
+            labels, (train.columns["input_ids"][:, 1] - 200) / 2.0)
+
+    def test_float_labels_survive_bf16_infeed_cast(self):
+        """The cast_keys contract end-to-end: under a bf16 config the
+        loader may cast float INPUTS, but float TARGETS must stay f32."""
+        import jax.numpy as jnp
+
+        from tpuframe.data import ShardedLoader
+
+        train, _ = datasets.glue_stsb(None, seq_len=32, synthetic_size=64)
+        batch = next(ShardedLoader(train, 16, shuffle=False,
+                                   cast_floats=jnp.bfloat16).epoch(0))
+        assert batch["label"].dtype == jnp.float32
+
+    def test_bert_stsb_regression_tiny_steps(self):
+        cfg = get_config("glue_bert_stsb").with_overrides(
+            total_steps=2, global_batch=8, warmup_steps=1, log_every=1,
+            eval_every=2, eval_batches=1,
+            dataset_kwargs={"synthetic_size": 32, "seq_len": 32,
+                            "vocab_size": 512},
+            model_kwargs={"vocab_size": 512, "hidden_size": 64,
+                          "num_layers": 2, "num_heads": 2,
+                          "intermediate_size": 128, "max_position": 32})
+        assert cfg.model_kwargs["num_classes"] == 1
+        metrics = train_mod.train(cfg)
+        assert metrics["step"] == 2
+        assert np.isfinite(metrics["loss"])
+        assert "mse" in metrics and "eval_mse" in metrics
+
+
 class TestMnliHarness:
     def test_bert_mnli_tiny_steps(self):
         """The 3-class pair task end-to-end through the harness — same

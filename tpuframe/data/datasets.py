@@ -241,23 +241,13 @@ def glue_mnli(data_dir: str | None = None, *, seq_len: int = 128,
     if data_dir is not None:
         tokenizer = _resolve_tokenizer(tokenizer, data_dir, vocab_file)
 
+        def parse_label(raw):  # '-' / unknown = no gold consensus: drop
+            return MNLI_LABELS.get(raw.strip())
+
         def load(name):
-            text = gcs.read_bytes(gcs.join(data_dir, name)).decode()
-            lines = text.strip().split("\n")
-            header = lines[0].split("\t")
-            col = {name: i for i, name in enumerate(header)}
-            ia, ib, il = (col["sentence1"], col["sentence2"],
-                          col["gold_label"])
-            pairs, labels = [], []
-            for line in lines[1:]:
-                f = line.split("\t")
-                if len(f) <= max(ia, ib, il):
-                    continue
-                lbl = f[il].strip()
-                if lbl not in MNLI_LABELS:
-                    continue  # '-' = no gold consensus
-                pairs.append((f[ia], f[ib]))
-                labels.append(MNLI_LABELS[lbl])
+            pairs, labels = _parse_pair_tsv(
+                gcs.read_bytes(gcs.join(data_dir, name)).decode(),
+                label_col="gold_label", parse_label=parse_label)
             return _tokenize(pairs, np.asarray(labels, np.int32), seq_len,
                              vocab_size, tokenizer)
 
@@ -266,6 +256,72 @@ def glue_mnli(data_dir: str | None = None, *, seq_len: int = 128,
                                    seed=8),
             _synthetic_token_pairs(max(synthetic_size // 8, 64), seq_len,
                                    vocab_size, seed=9))
+
+
+def _parse_pair_tsv(text: str, *, label_col: str, parse_label):
+    """Header-located GLUE pair-task tsv: returns ((a, b) pairs, labels).
+    ``parse_label`` maps the raw label field to a value or None (drop row
+    — '-' MNLI labels, unscored STS-B test rows)."""
+    lines = text.strip().split("\n")
+    col = {c: i for i, c in enumerate(lines[0].split("\t"))}
+    ia, ib, il = col["sentence1"], col["sentence2"], col[label_col]
+    pairs, labels = [], []
+    for line in lines[1:]:
+        f = line.split("\t")
+        if len(f) <= max(ia, ib, il):
+            continue
+        lbl = parse_label(f[il])
+        if lbl is None:
+            continue
+        pairs.append((f[ia], f[ib]))
+        labels.append(lbl)
+    return pairs, labels
+
+
+def glue_stsb(data_dir: str | None = None, *, seq_len: int = 128,
+              vocab_size: int = 30522, synthetic_size: int = 1024,
+              tokenizer=None, vocab_file: str | None = None):
+    """STS-B sentence-pair REGRESSION (similarity score 0-5, float32
+    label) — the GLUE task family's third shape: the harness trains it
+    with MSE instead of cross-entropy (HF convention: num_classes=1 ⇒
+    regression).  Float labels also exercise the loader's cast_keys
+    contract: inputs may be host-cast to bf16, targets must stay f32.
+
+    With ``data_dir``: reads ``train.tsv`` / ``dev.tsv`` with
+    header-located ``sentence1``/``sentence2``/``score`` columns.
+    """
+    if data_dir is not None:
+        tokenizer = _resolve_tokenizer(tokenizer, data_dir, vocab_file)
+
+        def parse_label(raw):  # unscored (test-set shape) rows: drop
+            try:
+                return float(raw)
+            except ValueError:
+                return None
+
+        def load(name):
+            pairs, scores = _parse_pair_tsv(
+                gcs.read_bytes(gcs.join(data_dir, name)).decode(),
+                label_col="score", parse_label=parse_label)
+            return _tokenize(pairs, np.asarray(scores, np.float32), seq_len,
+                             vocab_size, tokenizer)
+
+        return load("train.tsv"), load("dev.tsv")
+    return (_synthetic_score_pairs(synthetic_size, seq_len, vocab_size,
+                                   seed=10),
+            _synthetic_score_pairs(max(synthetic_size // 8, 64), seq_len,
+                                   vocab_size, seed=11))
+
+
+def _synthetic_score_pairs(n, seq_len, vocab_size, *, seed):
+    """Pair-encoded batches with a LEARNABLE float score: the signal token
+    (position 1) encodes one of 11 levels mapping to scores 0.0-5.0."""
+    rng = np.random.default_rng(seed)
+    level = rng.integers(0, 11, size=n)
+    ds = _synthetic_token_pairs(n, seq_len, vocab_size, seed=seed)
+    ds.columns["input_ids"][:, 1] = 200 + level
+    ds.columns["label"] = (level / 2.0).astype(np.float32)
+    return ds
 
 
 def _resolve_tokenizer(tokenizer, data_dir, vocab_file):

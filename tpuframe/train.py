@@ -48,13 +48,20 @@ def build_datasets(cfg: TrainConfig):
         "imagenet": datasets.imagenet,
         "glue_sst2": datasets.glue_sst2,
         "glue_mnli": datasets.glue_mnli,
+        "glue_stsb": datasets.glue_stsb,
         "lm_text": datasets.lm_text,
     }[cfg.dataset]
     return builder(cfg.data_dir, **cfg.dataset_kwargs)
 
 
 def _is_text_task(cfg: TrainConfig) -> bool:
-    return cfg.dataset in ("glue_sst2", "glue_mnli")
+    return cfg.dataset in ("glue_sst2", "glue_mnli", "glue_stsb")
+
+
+def _is_regression_task(cfg: TrainConfig) -> bool:
+    # HF convention, enforced as stated: num_labels == 1 ⇒ regression
+    # (STS-B) — MSE on the squeezed single logit, no accuracy metric.
+    return cfg.model_kwargs.get("num_classes") == 1
 
 
 def _is_lm_task(cfg: TrainConfig) -> bool:
@@ -305,11 +312,17 @@ def make_loss_fn(cfg: TrainConfig, model) -> step_lib.LossFn:
         return loss_fn
 
     if _is_text_task(cfg):
+        regression = _is_regression_task(cfg)
+
         def loss_fn(params, model_state, batch, rng):
             logits = model.apply(
                 {"params": params, **model_state}, batch["input_ids"],
                 batch["attention_mask"], batch["token_type_ids"], train=True,
                 rngs={"dropout": rng})
+            if regression:
+                pred = logits[..., 0]
+                loss = jnp.mean((pred - batch["label"]) ** 2)
+                return loss, (model_state, {"mse": loss})
             loss = losses.softmax_cross_entropy(logits, batch["label"])
             return loss, (model_state,
                           {"accuracy": losses.accuracy(logits, batch["label"])})
@@ -370,10 +383,15 @@ def make_metric_fn(cfg: TrainConfig, model):
         return metric_fn
 
     if _is_text_task(cfg):
+        regression = _is_regression_task(cfg)
+
         def metric_fn(params, model_state, batch):
             logits = model.apply({"params": params, **model_state},
                                  batch["input_ids"], batch["attention_mask"],
                                  batch["token_type_ids"])
+            if regression:
+                mse = jnp.mean((logits[..., 0] - batch["label"]) ** 2)
+                return {"loss": mse, "mse": mse}
             return {"accuracy": losses.accuracy(logits, batch["label"]),
                     "loss": losses.softmax_cross_entropy(logits, batch["label"])}
 

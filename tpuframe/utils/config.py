@@ -160,6 +160,17 @@ def _glue_bert_mnli() -> TrainConfig:
     )
 
 
+def _glue_bert_stsb() -> TrainConfig:
+    """Config 4 [B:10], third GLUE shape: BERT-base STS-B — sentence-pair
+    REGRESSION (similarity 0-5).  num_classes=1 ⇒ the harness trains with
+    MSE on the single squeezed logit (HF's num_labels==1 convention).
+    Standard recipe: ~4 epochs over 5.7k pairs at batch 32."""
+    return _glue_bert().with_overrides(
+        name="glue_bert_stsb", dataset="glue_stsb",
+        model_kwargs={"num_classes": 1}, total_steps=720, warmup_steps=72,
+    )
+
+
 def _imagenet_resnet50_pod() -> TrainConfig:
     """Config 5 [B:11]: ResNet-50 / ImageNet on a multi-host pod (v4-32).
     Same recipe as config 3 at 4x the batch; launched via tpuframe.launch."""
@@ -237,6 +248,7 @@ WORKLOADS = {
     "imagenet_resnet50": _imagenet_resnet50,
     "glue_bert": _glue_bert,
     "glue_bert_mnli": _glue_bert_mnli,
+    "glue_bert_stsb": _glue_bert_stsb,
     "imagenet_resnet50_pod": _imagenet_resnet50_pod,
     "lm_long": _lm_long,
     "lm_smoke": _lm_smoke,
