@@ -123,6 +123,14 @@ class TestStsb:
         assert train.columns["label"].dtype == np.float32
         np.testing.assert_allclose(train.columns["label"], [5.0, 0.8])
 
+    def test_crlf_tsv(self, tmp_path):
+        """CRLF GLUE files: header names must not carry \\r (the last
+        column's lookup broke before splitlines) and labels must parse."""
+        (tmp_path / "train.tsv").write_text(STSB_TSV.replace("\n", "\r\n"))
+        (tmp_path / "dev.tsv").write_text(STSB_TSV.replace("\n", "\r\n"))
+        train, _ = datasets.glue_stsb(str(tmp_path), seq_len=32)
+        np.testing.assert_allclose(train.columns["label"], [5.0, 0.8])
+
     def test_synthetic_score_signal(self):
         train, _ = datasets.glue_stsb(None, seq_len=64, synthetic_size=128)
         labels = train.columns["label"]
