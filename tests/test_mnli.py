@@ -166,6 +166,20 @@ class TestStsb:
         assert metrics["step"] == 2
         assert np.isfinite(metrics["loss"])
         assert "mse" in metrics and "eval_mse" in metrics
+        assert -1.0 <= metrics["eval_pearson"] <= 1.0
+        assert not any(k.startswith("eval__m_") for k in metrics)
+
+    def test_finalize_eval_pearson_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        pred = rng.normal(2.0, 1.5, size=256)
+        y = 0.7 * pred + rng.normal(0, 0.5, size=256)
+        avg = {"_m_pred": pred.mean(), "_m_y": y.mean(),
+               "_m_pred2": (pred ** 2).mean(), "_m_y2": (y ** 2).mean(),
+               "_m_py": (pred * y).mean(), "mse": 1.0}
+        out = train_mod._finalize_eval(avg)
+        np.testing.assert_allclose(out["pearson"], np.corrcoef(pred, y)[0, 1],
+                                   rtol=1e-12)
+        assert set(out) == {"pearson", "mse"}
 
 
 class TestMnliHarness:

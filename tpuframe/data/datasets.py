@@ -206,7 +206,7 @@ def glue_sst2(data_dir: str | None = None, *, seq_len: int = 128,
 
         def load(name):
             text = gcs.read_bytes(gcs.join(data_dir, name)).decode()
-            lines = text.strip().splitlines()[1:]  # header; CRLF-safe
+            lines = text.replace("\r\n", "\n").strip().split("\n")[1:]  # drop header; CRLF-safe
             sents, labels = [], []
             for line in lines:
                 sent, _, lbl = line.rpartition("\t")
@@ -261,10 +261,10 @@ def glue_mnli(data_dir: str | None = None, *, seq_len: int = 128,
 def _parse_pair_tsv(text: str, *, label_col: str, parse_label):
     """Header-located GLUE pair-task tsv: returns ((a, b) pairs, labels).
     ``parse_label`` maps the raw label field to a value or None (drop row
-    — '-' MNLI labels, unscored STS-B test rows).  splitlines() (not
-    split("\\n")) so CRLF files don't leave a \\r glued to the last
-    column's header and labels."""
-    lines = text.strip().splitlines()
+    — '-' MNLI labels, unscored STS-B test rows).  CRLF-normalized
+    (NOT splitlines(), which would also split on \\x0c / U+2028-class
+    breaks that can legally appear inside a text field)."""
+    lines = text.replace("\r\n", "\n").strip().split("\n")
     col = {c: i for i, c in enumerate(lines[0].split("\t"))}
     ia, ib, il = col["sentence1"], col["sentence2"], col[label_col]
     pairs, labels = [], []
@@ -318,8 +318,8 @@ def glue_stsb(data_dir: str | None = None, *, seq_len: int = 128,
 def _synthetic_score_pairs(n, seq_len, vocab_size, *, seed):
     """Pair-encoded batches with a LEARNABLE float score: the signal token
     (position 1) encodes one of 11 levels mapping to scores 0.0-5.0."""
-    if vocab_size <= 211:  # ids 200..210 must be real embedding rows
-        raise ValueError(f"synthetic STS-B needs vocab_size > 211 for the "
+    if vocab_size < 211:  # ids 200..210 must be real embedding rows
+        raise ValueError(f"synthetic STS-B needs vocab_size >= 211 for the "
                          f"score signal tokens; got {vocab_size}")
     rng = np.random.default_rng(seed)
     level = rng.integers(0, 11, size=n)

@@ -390,8 +390,19 @@ def make_metric_fn(cfg: TrainConfig, model):
                                  batch["input_ids"], batch["attention_mask"],
                                  batch["token_type_ids"])
             if regression:
-                mse = jnp.mean((logits[..., 0] - batch["label"]) ** 2)
-                return {"loss": mse, "mse": mse}
+                pred = logits[..., 0]
+                y = batch["label"]
+                mse = jnp.mean((pred - y) ** 2)
+                # First/second moments as per-batch MEANS: evaluate()'s
+                # averaging over equal-size batches then reproduces the
+                # whole-set moments exactly, from which _finalize_eval
+                # derives the task's standard Pearson r without a second
+                # pass or per-example host traffic.
+                return {"loss": mse, "mse": mse,
+                        "_m_pred": jnp.mean(pred), "_m_y": jnp.mean(y),
+                        "_m_pred2": jnp.mean(pred ** 2),
+                        "_m_y2": jnp.mean(y ** 2),
+                        "_m_py": jnp.mean(pred * y)}
             return {"accuracy": losses.accuracy(logits, batch["label"]),
                     "loss": losses.softmax_cross_entropy(logits, batch["label"])}
 
@@ -430,7 +441,20 @@ def evaluate(h: Harness, max_batches: int) -> dict:
             jax.block_until_ready(agg)
     if agg is None:
         return {}
-    return {k: float(v) / n for k, v in jax.device_get(agg).items()}
+    return _finalize_eval({k: float(v) / n
+                           for k, v in jax.device_get(agg).items()})
+
+
+def _finalize_eval(avg: dict) -> dict:
+    """Derive set-level metrics from aggregated moments (keys starting
+    with ``_m_``), which are internal and dropped from the report."""
+    if "_m_py" in avg:
+        var_p = avg["_m_pred2"] - avg["_m_pred"] ** 2
+        var_y = avg["_m_y2"] - avg["_m_y"] ** 2
+        cov = avg["_m_py"] - avg["_m_pred"] * avg["_m_y"]
+        if var_p > 0 and var_y > 0:
+            avg["pearson"] = cov / (var_p * var_y) ** 0.5
+    return {k: v for k, v in avg.items() if not k.startswith("_m_")}
 
 
 def train(cfg: TrainConfig, *, trace_dir: str | None = None,
