@@ -162,8 +162,10 @@ def _watchdog() -> None:
                 deadline = BUDGET_S - RELAY_DOWN_IMPORT_DEADLINE_S
         else:
             return
-    if deadline > 0 and (_DONE.wait(deadline) or _DONE.is_set()):
+    if deadline > 0 and _DONE.wait(deadline):
         return  # main thread emitted the real result
+    if _DONE.is_set():
+        return  # real result emitted in the wait/emit race window
     stage = _RESULT.get("stage", "unknown")
     _log(f"WATCHDOG: exceeded the {stage!r}-stage deadline; "
          f"emitting degraded result")

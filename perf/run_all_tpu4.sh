@@ -59,6 +59,10 @@ for b in 256 192 320 384 512 768 1024; do
 done
 TPUFRAME_BENCH_BATCH=256 TPUFRAME_BENCH_STEM=space_to_depth \
     run bench_s2d_256 1200 python bench.py
+# Same-config rerun of the historical batch-512 s2d point (the PERF.md
+# '2347 vs 2332' A/B) so retiring the old artifact loses no data point.
+TPUFRAME_BENCH_BATCH=512 TPUFRAME_BENCH_STEM=space_to_depth \
+    run bench_s2d_512 1200 python bench.py
 # Retire the two stale-named artifacts ONLY once their reruns hold a real
 # (non-degraded) measurement — bench.py emits a value-0.0 degraded record
 # on watchdog timeout, which must not destroy the only prior measurement.
@@ -74,7 +78,7 @@ EOF
 if ok_bench perf/results/bench_b512.out; then
   rm -f perf/results/bench_default.out perf/results/bench_default.err
 fi
-if ok_bench perf/results/bench_s2d_256.out; then
+if ok_bench perf/results/bench_s2d_512.out; then
   rm -f perf/results/bench_s2d.out perf/results/bench_s2d.err
 fi
 

@@ -1,0 +1,70 @@
+"""TPU cross-platform lowering guard for the flash-attention kernels.
+
+The CPU suite runs the kernels under the Pallas interpreter, which skips
+the pallas→Mosaic lowering stage entirely — historically the place
+on-chip-only breakage hides (tiling, scratch shapes, compiler params:
+round-2 verdict #2).  ``jax.export`` can lower for platform "tpu" from a
+CPU host, running kernel tracing, BlockSpec/grid validation, and Mosaic
+custom-call serialization without hardware.  This does NOT cover the
+final Mosaic→TPU codegen (tests/test_flash_attention_tpu.py does, on
+chip), but it catches the lowering class in CI.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import export
+
+from tpuframe.ops.flash_attention import flash_mha
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() == "tpu",
+    reason="cross-platform lowering guard; redundant on a real TPU")
+
+
+def _qkv(dtype=jnp.bfloat16, s=256):
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(rng.normal(size=(2, s, 4, 64)), dtype)  # noqa: E731
+    return mk(), mk(), mk()
+
+
+def _assert_tpu_lowerable(fn, *args):
+    exp = export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    assert b"tpu_custom_call" in exp.mlir_module_serialized
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+def test_fwd_lowers_for_tpu(causal, dtype):
+    q, k, v = _qkv(dtype)
+    _assert_tpu_lowerable(
+        lambda q, k, v: flash_mha(q, k, v, causal=causal, interpret=False),
+        q, k, v)
+
+
+def test_fwd_with_mask_lowers_for_tpu():
+    q, k, v = _qkv()
+    mask = jnp.ones((2, 256), jnp.int32)
+    _assert_tpu_lowerable(
+        lambda q, k, v, m: flash_mha(q, k, v, mask=m, interpret=False),
+        q, k, v, mask)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_bwd_lowers_for_tpu(causal):
+    q, k, v = _qkv()
+
+    def loss(q, k, v):
+        return jnp.sum(flash_mha(q, k, v, causal=causal,
+                                 interpret=False).astype(jnp.float32) ** 2)
+
+    _assert_tpu_lowerable(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+def test_nondefault_blocks_lower_for_tpu():
+    # The queue-5 sweep's block shapes must at least lower.
+    q, k, v = _qkv(s=1024)
+    _assert_tpu_lowerable(
+        lambda q, k, v: flash_mha(q, k, v, causal=True, block_q=256,
+                                  block_k=512, interpret=False), q, k, v)
