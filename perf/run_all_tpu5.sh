@@ -52,5 +52,9 @@ MODEL=lm run tf_lm_2k_opt 2400 python perf/bench_transformer.py
 # may beat storing+reloading them.
 TPUFRAME_BENCH_BATCH=256 TPUFRAME_BENCH_REMAT=1 \
     run bench_b256_remat 1200 python bench.py
+# If both independently help at 256, the byte savings should stack.
+TPUFRAME_BENCH_BATCH=256 TPUFRAME_BENCH_REMAT=1 \
+    TPUFRAME_BENCH_STEM=space_to_depth \
+    run bench_b256_remat_s2d 1200 python bench.py
 
 note "queue 5 complete"
