@@ -22,6 +22,24 @@ STOP_SENTINEL="perf/STOP"
 
 queue_should_stop() { [ -e "$STOP_SENTINEL" ]; }
 
+relay_up() {
+  # Fast tunnel-port probe (the outage signature: every port refuses
+  # instantly — same check bench.py does pre-import).  Exit 0 = some
+  # port accepts TCP.
+  python - <<'PYEOF'
+import socket, sys
+for port in (8083, 8082, 8081):
+    s = socket.socket(); s.settimeout(2.0)
+    try:
+        s.connect(("127.0.0.1", port)); sys.exit(0)
+    except OSError:
+        continue
+    finally:
+        s.close()
+sys.exit(1)
+PYEOF
+}
+
 claim_wait_for_others() {
   # A sourcing script's own cmdline never contains the marker (it lives
   # only inside the probe's python -c), and this runs before that script

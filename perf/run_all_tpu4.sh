@@ -42,7 +42,19 @@ run() { # name timeout cmd...
   fi
   note "START $name"
   timeout "$tmo" "$@" > "perf/results/$name.out" 2> "perf/results/$name.err"
-  note "END $name rc=$?"
+  local rc=$?
+  note "END $name rc=$rc"
+  # Mid-queue outage: a failed run with the tunnel down means every
+  # later run would burn its whole timeout against a dead relay
+  # (round 3's queue-1→outage transition).  Re-claim patiently instead.
+  if [ "$rc" != 0 ] && ! relay_up; then
+    note "relay down after $name failed — re-entering claim loop"
+    if ! claim_chip 96 "$LOG"; then
+      note "re-claim FAILED; giving up"
+      exit 1
+    fi
+    note "chip re-claimed — resuming queue"
+  fi
 }
 
 # --- 1. flash-attention proof --------------------------------------------

@@ -32,7 +32,16 @@ run() { # name timeout cmd...
   fi
   note "START $name"
   timeout "$tmo" "$@" > "perf/results/$name.out" 2> "perf/results/$name.err"
-  note "END $name rc=$?"
+  local rc=$?
+  note "END $name rc=$rc"
+  if [ "$rc" != 0 ] && ! relay_up; then
+    note "relay down after $name failed — re-entering claim loop"
+    if ! claim_chip 96 "$LOG"; then
+      note "re-claim FAILED; giving up"
+      exit 1
+    fi
+    note "chip re-claimed — resuming queue"
+  fi
 }
 
 # 1. Block-size sweep.  (128,128) is the round-3 baseline point but with
