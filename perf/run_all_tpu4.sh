@@ -34,28 +34,7 @@ if ! claim_chip 96 "$LOG"; then
 fi
 note "chip claimed — running queue 4"
 
-run() { # name timeout cmd...
-  local name=$1 tmo=$2; shift 2
-  if queue_should_stop; then
-    note "STOP sentinel present; skipping $name and exiting"
-    exit 0
-  fi
-  note "START $name"
-  timeout "$tmo" "$@" > "perf/results/$name.out" 2> "perf/results/$name.err"
-  local rc=$?
-  note "END $name rc=$rc"
-  # Mid-queue outage: a failed run with the tunnel down means every
-  # later run would burn its whole timeout against a dead relay
-  # (round 3's queue-1→outage transition).  Re-claim patiently instead.
-  if [ "$rc" != 0 ] && ! relay_up; then
-    note "relay down after $name failed — re-entering claim loop"
-    if ! claim_chip 96 "$LOG"; then
-      note "re-claim FAILED; giving up"
-      exit 1
-    fi
-    note "chip re-claimed — resuming queue"
-  fi
-}
+run() { queue_run "$@"; }  # shared runner: perf/claim.sh (outage re-claim + retry)
 
 # --- 1. flash-attention proof --------------------------------------------
 TPUFRAME_TPU_TESTS=1 run fa_tpu_tests2 1800 \

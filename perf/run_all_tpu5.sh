@@ -24,25 +24,7 @@ if ! claim_chip 20 "$LOG"; then
   exit 1
 fi
 
-run() { # name timeout cmd...
-  local name=$1 tmo=$2; shift 2
-  if queue_should_stop; then
-    note "STOP sentinel present; skipping $name and exiting"
-    exit 0
-  fi
-  note "START $name"
-  timeout "$tmo" "$@" > "perf/results/$name.out" 2> "perf/results/$name.err"
-  local rc=$?
-  note "END $name rc=$rc"
-  if [ "$rc" != 0 ] && ! relay_up; then
-    note "relay down after $name failed — re-entering claim loop"
-    if ! claim_chip 96 "$LOG"; then
-      note "re-claim FAILED; giving up"
-      exit 1
-    fi
-    note "chip re-claimed — resuming queue"
-  fi
-}
+run() { queue_run "$@"; }  # shared runner: perf/claim.sh (outage re-claim + retry)
 
 # 1. Block-size sweep.  (128,128) is the round-3 baseline point but with
 # this round's kernel scheduling changes — the direct A/B for them.
