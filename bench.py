@@ -108,7 +108,7 @@ def _best_recorded() -> float | None:
     return best
 
 
-def _relay_probe() -> bool | None:
+def _relay_probe(ports=(8083, 8082, 8081)) -> bool | None:
     """Fast health probe of the loopback TPU relay BEFORE importing jax.
 
     The relay tunnel serves on localhost ports (:8081-:8083); during an
@@ -124,7 +124,7 @@ def _relay_probe() -> bool | None:
     if os.environ.get("AXON_LOOPBACK_RELAY") != "1":
         return None
     host = (os.environ.get("PALLAS_AXON_POOL_IPS") or "127.0.0.1").split(",")[0]
-    for port in (8083, 8082, 8081):
+    for port in ports:
         s = socket.socket()
         s.settimeout(2.0)
         try:

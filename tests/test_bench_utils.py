@@ -1,0 +1,75 @@
+"""Host-side units of bench.py: the watchdog's last-measured annotation
+source and the relay probe (the driver-metric path must degrade
+truthfully — a wrong 'best recorded' or a fabricated probe verdict would
+poison BENCH_r* artifacts)."""
+
+import importlib.util
+import json
+import socket
+import threading
+
+import pytest
+
+
+@pytest.fixture()
+def bench(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "bench", __file__.rsplit("/tests/", 1)[0] + "/bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(results, name, rec):
+    (results / name).write_text(json.dumps(rec) + "\n")
+
+
+def test_best_recorded_skips_degraded_and_takes_max(bench, monkeypatch,
+                                                    tmp_path):
+    results = tmp_path / "perf" / "results"
+    results.mkdir(parents=True)
+    # _best_recorded roots its glob at dirname(bench.__file__): point the
+    # module, not the global os.path, at the sandbox.
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    _write(results, "bench_a.out", {"value": 2000.0})
+    _write(results, "bench_b.out", {"value": 2385.2})
+    _write(results, "bench_c.out", {"value": 9999.0, "degraded": True})
+    _write(results, "bench_d.out", {"no_value": 1})
+    (results / "bench_junk.out").write_text("not json\n")
+    assert bench._best_recorded() == 2385.2
+
+
+def test_best_recorded_none_when_nothing_real(bench, monkeypatch, tmp_path):
+    results = tmp_path / "perf" / "results"
+    results.mkdir(parents=True)
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    _write(results, "bench_a.out", {"value": 0.0, "degraded": True})
+    assert bench._best_recorded() is None
+
+
+def test_relay_probe_none_outside_loopback_env(bench, monkeypatch):
+    monkeypatch.delenv("AXON_LOOPBACK_RELAY", raising=False)
+    assert bench._relay_probe() is None
+
+
+def test_relay_probe_up_down(bench, monkeypatch):
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    def accept_quietly():
+        try:
+            srv.accept()
+        except OSError:
+            pass  # listener closed after the probe — expected
+
+    t = threading.Thread(target=accept_quietly, daemon=True)
+    t.start()
+    try:
+        assert bench._relay_probe(ports=(port,)) is True
+    finally:
+        srv.close()
+    # Socket closed: the same port now refuses -> probe says down.
+    assert bench._relay_probe(ports=(port,)) is False
