@@ -1,0 +1,67 @@
+"""The XLA-FFI custom-call path (SURVEY.md §3b native demonstrator):
+C++ running inside a compiled XLA program on the CPU backend, bit-equal
+to the jnp expression it replaces."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuframe.ops import native_call
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "cpu",
+    reason="FFI custom calls are CPU-backend only (TPU kernels are pallas)")
+
+
+def _inputs(shape=(4, 32, 32, 3), seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.integers(0, 256, size=shape), jnp.uint8)
+    mean = jnp.asarray([0.485, 0.456, 0.406], jnp.float32)
+    std = jnp.asarray([0.229, 0.224, 0.225], jnp.float32)
+    return x, mean, std
+
+
+def test_ffi_kernel_registers_and_matches_jnp():
+    x, mean, std = _inputs()
+    assert native_call._ffi_available(), "FFI kernel failed to build/register"
+    got = jax.jit(native_call.normalize_u8)(x, mean, std)
+    want = native_call._jnp_reference(x, mean, std)
+    # Same fused multiply-add structure on both sides — the kernel
+    # precomputes scale/shift exactly as the XLA fusion does; allow 1-ulp
+    # class differences from operation-order freedom.
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_lowers_to_custom_call_in_jit():
+    x, mean, std = _inputs((2, 8, 8, 3))
+    assert native_call._ffi_available()
+    txt = jax.jit(native_call.normalize_u8).lower(x, mean, std).as_text()
+    assert "tf_normalize_u8" in txt
+
+
+def test_rank2_and_odd_channels():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.integers(0, 256, size=(16, 5)), jnp.uint8)
+    mean = jnp.asarray(rng.uniform(0.2, 0.8, size=5), jnp.float32)
+    std = jnp.asarray(rng.uniform(0.1, 0.4, size=5), jnp.float32)
+    got = native_call.normalize_u8(x, mean, std)
+    np.testing.assert_allclose(
+        np.asarray(got),
+        np.asarray(native_call._jnp_reference(x, mean, std)),
+        rtol=1e-6, atol=1e-6)
+
+
+def test_non_u8_falls_back():
+    x = jnp.zeros((2, 4, 3), jnp.float32)
+    mean = jnp.zeros((3,), jnp.float32)
+    std = jnp.ones((3,), jnp.float32)
+    out = native_call.normalize_u8(x, mean, std)  # must not raise
+    assert out.dtype == jnp.float32
+
+
+def test_scalar_mean_std_falls_back():
+    x = jnp.zeros((2, 4, 1), jnp.uint8)
+    out = native_call.normalize_u8(x, 0.5, 0.5)  # grayscale-style call
+    np.testing.assert_allclose(np.asarray(out), -1.0)

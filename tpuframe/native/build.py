@@ -14,14 +14,20 @@ import subprocess
 import tempfile
 
 _SRC = os.path.join(os.path.dirname(__file__), "src", "tpuframe_native.cc")
+_FFI_SRC = os.path.join(os.path.dirname(__file__), "src", "tpuframe_ffi.cc")
 _OUT_DIR = os.path.join(os.path.dirname(__file__), "_build")
 
 
-def build(force: bool = False) -> str:
-    """Compile (if needed) and return the shared-library path."""
-    with open(_SRC, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    out = os.path.join(_OUT_DIR, f"libtpuframe_native_{digest}.so")
+def _compile(src: str, stem: str, extra_flags: list[str], *,
+             salt: str = "", force: bool = False) -> str:
+    """``salt`` joins the cache key for inputs outside the source file
+    (e.g. the jaxlib whose headers an FFI build compiles against)."""
+    h = hashlib.sha256()
+    with open(src, "rb") as f:
+        h.update(f.read())
+    h.update(("\0" + salt + "\0" + " ".join(extra_flags)).encode())
+    digest = h.hexdigest()[:16]
+    out = os.path.join(_OUT_DIR, f"{stem}_{digest}.so")
     if os.path.exists(out) and not force:
         return out
     os.makedirs(_OUT_DIR, exist_ok=True)
@@ -30,7 +36,7 @@ def build(force: bool = False) -> str:
     try:
         subprocess.run(
             ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-             _SRC, "-o", tmp],
+             *extra_flags, src, "-o", tmp],
             check=True, capture_output=True, text=True)
         os.replace(tmp, out)  # atomic: concurrent builders converge
     except BaseException:
@@ -38,3 +44,25 @@ def build(force: bool = False) -> str:
             os.unlink(tmp)
         raise
     return out
+
+
+def build(force: bool = False) -> str:
+    """Compile (if needed) and return the host-runtime library path."""
+    return _compile(_SRC, "libtpuframe_native", [], force=force)
+
+
+def build_ffi() -> str:
+    """Compile (if needed) and return the XLA-FFI kernel library path.
+
+    Needs the XLA FFI headers jaxlib ships (header-only C++ API) — unlike
+    the dependency-free host runtime, so it is a separate .so with its own
+    build, keyed by the jaxlib version too (a jaxlib upgrade changes the
+    FFI headers the kernel compiles against — a stale .so must not be
+    served to a new runtime); consumers degrade gracefully when the
+    headers or toolchain are missing."""
+    import jax.ffi
+    import jaxlib
+
+    return _compile(_FFI_SRC, "libtpuframe_ffi",
+                    [f"-I{jax.ffi.include_dir()}"],
+                    salt=f"jaxlib-{jaxlib.__version__}")

@@ -1,0 +1,89 @@
+// XLA FFI custom-call kernel — the SURVEY.md §3b "native custom-call
+// demonstrator": C++ running INSIDE a compiled XLA program (vs the ctypes
+// host runtime in tpuframe_native.cc, which runs outside the graph).
+//
+//   tf_normalize_u8: y = (x/255 - mean[c]) / std[c] over [..., C] uint8 —
+//   the canonical DataLoader-worker transform (torchvision
+//   ToTensor+Normalize), multithreaded over rows.  CPU backend only: on
+//   TPU this op belongs to XLA fusion on-device (and custom C++ cannot run
+//   there — that's what pallas kernels are for); on the CPU hosts of the
+//   fake cluster it demonstrates the in-graph native path the reference
+//   gets from Horovod's C++/cuDNN stack.
+//
+// Built by tpuframe/native/build.py::build_ffi with -I jax.ffi.include_dir()
+// (header-only XLA FFI C++ API; no libraries linked).
+
+#include <algorithm>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error NormalizeU8Impl(ffi::Buffer<ffi::U8> x,
+                                  ffi::Buffer<ffi::F32> mean,
+                                  ffi::Buffer<ffi::F32> stddev,
+                                  ffi::ResultBuffer<ffi::F32> y) {
+  const auto dims = x.dimensions();
+  if (dims.size() < 1) {
+    return ffi::Error::InvalidArgument("tf_normalize_u8: rank >= 1 required");
+  }
+  const int64_t c = dims.back();
+  int64_t rows = 1;
+  for (size_t i = 0; i + 1 < dims.size(); ++i) rows *= dims[i];
+  if (static_cast<int64_t>(mean.element_count()) != c ||
+      static_cast<int64_t>(stddev.element_count()) != c) {
+    return ffi::Error::InvalidArgument(
+        "tf_normalize_u8: mean/std length must equal the channel dim");
+  }
+  const uint8_t* src = x.typed_data();
+  const float* mu = mean.typed_data();
+  const float* sd = stddev.typed_data();
+  float* dst = y->typed_data();
+
+  // Precompute per-channel scale/shift: y = x * (1/(255*sd)) - mu/sd.
+  std::vector<float> scale(c), shift(c);
+  for (int64_t j = 0; j < c; ++j) {
+    scale[j] = 1.0f / (255.0f * sd[j]);
+    shift[j] = -mu[j] / sd[j];
+  }
+
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      const uint8_t* in = src + r * c;
+      float* out = dst + r * c;
+      for (int64_t j = 0; j < c; ++j) {
+        out[j] = static_cast<float>(in[j]) * scale[j] + shift[j];
+      }
+    }
+  };
+
+  const int64_t total = rows * c;
+  int64_t n_threads =
+      std::min<int64_t>(std::max(1u, std::thread::hardware_concurrency() / 2),
+                        rows);
+  if (n_threads <= 1 || total < (1 << 20)) {
+    work(0, rows);
+    return ffi::Error::Success();
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(n_threads);
+  const int64_t chunk = (rows + n_threads - 1) / n_threads;
+  for (int64_t t = 0; t < n_threads; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = std::min(lo + chunk, rows);
+    if (lo >= hi) break;
+    workers.emplace_back(work, lo, hi);
+  }
+  for (auto& w : workers) w.join();
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(TfNormalizeU8, NormalizeU8Impl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::U8>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());

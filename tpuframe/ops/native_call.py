@@ -1,0 +1,77 @@
+"""In-graph native (C++) custom calls via the XLA FFI — SURVEY.md §3b's
+native-component demonstrator, complementing the out-of-graph ctypes host
+runtime (tpuframe.native).
+
+``normalize_u8(x, mean, std)``: the canonical input transform
+(``(x/255 - mean)/std``, torchvision ToTensor+Normalize semantics) as ONE
+multithreaded C++ kernel running inside the compiled program.  CPU
+backend only — on TPU the same math belongs to on-device XLA fusion
+(custom C++ cannot run there; pallas is the TPU kernel path), so the
+public entry transparently falls back to the identical jnp expression
+whenever the FFI kernel is unavailable or the backend isn't CPU.  The
+two paths agree to the 1-ulp class (pinned by test): the kernel
+precomputes per-channel scale/shift so its rounding order differs from
+the literal ``(x/255 - mean)/std`` in the last bits.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_TARGET = "tf_normalize_u8"
+_LOCK = threading.Lock()
+_STATE: dict = {}  # {"registered": bool}
+
+
+def _ffi_available() -> bool:
+    """Register the kernel once; False when the toolchain/headers/backend
+    make the native path unavailable (callers fall back, never fail)."""
+    with _LOCK:
+        if "registered" in _STATE:
+            return _STATE["registered"]
+        ok = False
+        if (jax.default_backend() == "cpu"
+                and os.environ.get("TPUFRAME_NO_NATIVE") != "1"):
+            try:
+                import ctypes
+
+                from tpuframe.native.build import build_ffi
+
+                lib = ctypes.CDLL(build_ffi())
+                jax.ffi.register_ffi_target(
+                    _TARGET, jax.ffi.pycapsule(lib.TfNormalizeU8),
+                    platform="cpu")
+                _STATE["lib"] = lib  # keep the dlopen handle alive
+                ok = True
+            except Exception:  # noqa: BLE001 — capability, not a hard dep
+                ok = False
+        _STATE["registered"] = ok
+        return ok
+
+
+def _jnp_reference(x, mean, std):
+    return (x.astype(jnp.float32) / 255.0 - mean) / std
+
+
+def normalize_u8(x: jax.Array, mean, std) -> jax.Array:
+    """``(x/255 - mean[c]) / std[c]`` for uint8 ``[..., C]`` images.
+
+    Inside jit on the CPU backend this lowers to the C++ FFI kernel;
+    everywhere else it is the equivalent jnp expression.
+    """
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    # Shape guards ordered so scalar mean/std (grayscale-style calls) fall
+    # back instead of tripping on shape[-1] of a 0-d array.
+    if (mean.ndim != 1 or std.shape != mean.shape or x.ndim < 1
+            or x.dtype != jnp.uint8 or x.shape[-1] != mean.shape[0]):
+        return _jnp_reference(x, mean, std)
+    if not _ffi_available():
+        return _jnp_reference(x, mean, std)
+    call = jax.ffi.ffi_call(
+        _TARGET, jax.ShapeDtypeStruct(x.shape, jnp.float32))
+    return call(x, mean, std)
