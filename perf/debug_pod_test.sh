@@ -11,7 +11,7 @@ COMMON_ARGS=(-m tpuframe.train --config imagenet_resnet50_pod
   --set total_steps=8 --set ckpt_every=4 --set global_batch=32
   --set log_every=4 --set eval_every=1000 --set warmup_steps=2
   --set "compute_dtype='float32'"
-  --set "dataset_kwargs={'image_size': 32, 'synthetic_size': 64}"
+  --set "dataset_kwargs={'image_size': 32, 'synthetic_size': 64, 'num_classes': 100}"
   --set "model_kwargs={'cifar_stem': True, 'num_classes': 100}"
   --ckpt-dir "$D/ck")
 

@@ -139,13 +139,22 @@ def cifar10(data_dir: str | None = None, *, synthetic_size: int = 2048):
 # ---------------------------------------------------------------------------
 
 def imagenet(data_dir: str | None = None, *, image_size: int = 224,
-             synthetic_size: int = 512):
-    """[B, S, S, 3] float32, int32 labels in [0, 1000).
+             synthetic_size: int = 512, keep_u8: bool = False,
+             num_classes: int = 1000):
+    """[B, S, S, 3] float32 (or uint8), int32 labels in [0, num_classes)
+    (synthetic; real shards carry the full 1000-class labels).
 
     Real ImageNet arrives as per-host ``.npy`` shards (images_XXXXX.npy /
     labels_XXXXX.npy) prepared by ``tpuframe.data.prepare_imagenet`` —
     decoding JPEGs on the training hosts would bottleneck the input pipeline
     (SURVEY.md §7 hard part 2), so decode/resize happens offline.
+
+    ``keep_u8``: keep images uint8 end-to-end on the host — 4x less host
+    RAM than the f32 default (real ImageNet: ~150 GB vs ~600 GB per host
+    group) and 1 byte/px over the host→device link (vs 2 for the bf16
+    infeed cast); the harness normalizes ON DEVICE (train._maybe_normalize
+    — XLA-fused on TPU, the native FFI kernel on CPU hosts).  Synthetic
+    mode quantizes its f32 images to the same u8 representation.
     """
     if data_dir is not None:
         import jax
@@ -168,7 +177,7 @@ def imagenet(data_dir: str | None = None, *, image_size: int = 224,
               for n in names]
         x = np.concatenate(xs)
         y = np.concatenate(ys).astype(np.int32)
-        if x.dtype == np.uint8:
+        if x.dtype == np.uint8 and not keep_u8:
             # prepare_imagenet stores uint8 (4x less IO); normalize here.
             x = ((x.astype(np.float32) / 255.0) - IMAGENET_MEAN) / IMAGENET_STD
         split = int(0.99 * len(x))
@@ -178,10 +187,20 @@ def imagenet(data_dir: str | None = None, *, image_size: int = 224,
         train.host_presharded = n_proc > 1
         test.host_presharded = n_proc > 1
         return train, test
-    return (_synthetic_images(synthetic_size, (image_size, image_size, 3), 1000, seed=4),
-            _synthetic_images(max(synthetic_size // 8, 64),
-                              (image_size, image_size, 3), 1000,
-                              seed=5, template_seed=4))
+    # ``num_classes`` (synthetic only): scaled-down smoke configs shrink
+    # the model head — the label range must shrink with it (the harness
+    # rejects out-of-range labels at build time).
+    train, test = (
+        _synthetic_images(synthetic_size, (image_size, image_size, 3),
+                          num_classes, seed=4),
+        _synthetic_images(max(synthetic_size // 8, 64),
+                          (image_size, image_size, 3), num_classes,
+                          seed=5, template_seed=4))
+    if keep_u8:
+        for ds in (train, test):
+            ds.columns["image"] = np.round(
+                ds.columns["image"] * 255.0).astype(np.uint8)
+    return train, test
 
 
 # ---------------------------------------------------------------------------

@@ -58,6 +58,33 @@ def _is_text_task(cfg: TrainConfig) -> bool:
     return cfg.dataset in ("glue_sst2", "glue_mnli", "glue_stsb")
 
 
+def _maybe_normalize(cfg: TrainConfig, x):
+    """On-device normalization for uint8 image batches (datasets built
+    with ``keep_u8=True``: 1 byte/px over the host→device link, 4x less
+    host RAM).  XLA fuses this into the first conv's input read on TPU;
+    on CPU hosts it lowers to the native FFI kernel
+    (tpuframe.ops.native_call).  Float batches pass through — they were
+    normalized on the host at build time."""
+    if x.dtype != jnp.uint8:
+        return x
+    from tpuframe.ops.native_call import normalize_u8
+
+    if cfg.data_dir is None:
+        # Synthetic u8 is quantized [0,1]-scale data: de-quantize only, so
+        # the u8 and f32 synthetic paths feed the same distribution.
+        mean, std = np.float32(0.0), np.float32(1.0)
+    else:
+        # Real data: the same per-dataset constants the f32 builder branch
+        # applies on the host.
+        mean, std = {
+            "imagenet": (datasets.IMAGENET_MEAN, datasets.IMAGENET_STD),
+            "cifar10": (datasets.CIFAR_MEAN, datasets.CIFAR_STD),
+        }.get(cfg.dataset, (np.float32(0.0), np.float32(1.0)))
+    mean = np.broadcast_to(np.asarray(mean, np.float32), (x.shape[-1],))
+    std = np.broadcast_to(np.asarray(std, np.float32), (x.shape[-1],))
+    return normalize_u8(x, mean, std)
+
+
 def _is_regression_task(cfg: TrainConfig) -> bool:
     # HF convention, enforced as stated: num_labels == 1 ⇒ regression
     # (STS-B) — MSE on the squeezed single logit, no accuracy metric.
@@ -114,6 +141,22 @@ def build_harness(cfg: TrainConfig) -> Harness:
     model = models.get_model(cfg.model, dtype=dtype, **cfg.model_kwargs)
 
     train_ds, eval_ds = build_datasets(cfg)
+    # Labels out of the head's range don't crash — one_hot silently yields
+    # all-zero rows, training "runs" with a nonsense loss and eval goes
+    # NaN.  Catch the config error (e.g. num_classes=10 on the 1000-class
+    # synthetic imagenet) at build time with a message instead.
+    n_cls = cfg.model_kwargs.get("num_classes")
+    if (n_cls is not None and n_cls > 1 and not _is_lm_task(cfg)):
+        for split_name, ds in (("train", train_ds), ("eval", eval_ds)):
+            labels = ds.columns.get("label")
+            if labels is not None and np.issubdtype(labels.dtype,
+                                                    np.integer) and len(labels):
+                hi = int(labels.max())
+                if hi >= n_cls:
+                    raise ValueError(
+                        f"{split_name} labels reach {hi} but the model head "
+                        f"has num_classes={n_cls} — label range and head "
+                        f"size must match (check model_kwargs/dataset)")
     loader_part, step_part, reduce_axes = _batch_layout(cfg)
     # Float inputs are host-cast to the compute dtype before transfer (the
     # model's first op would cast them on device anyway; bf16 halves
@@ -131,7 +174,8 @@ def build_harness(cfg: TrainConfig) -> Harness:
     if _is_text_task(cfg) or _is_lm_task(cfg):
         variables = model.init(rng, jnp.asarray(sample["input_ids"]))
     else:
-        variables = model.init(rng, jnp.asarray(sample["image"]))
+        variables = model.init(
+            rng, _maybe_normalize(cfg, jnp.asarray(sample["image"])))
     params = variables["params"]
     model_state = {k: v for k, v in variables.items() if k != "params"}
 
@@ -331,7 +375,8 @@ def make_loss_fn(cfg: TrainConfig, model) -> step_lib.LossFn:
 
     def loss_fn(params, model_state, batch, rng):
         outputs = model.apply(
-            {"params": params, **model_state}, batch["image"], train=True,
+            {"params": params, **model_state},
+            _maybe_normalize(cfg, batch["image"]), train=True,
             rngs={"dropout": rng},
             mutable=list(model_state) if model_state else False)
         if model_state:
@@ -409,7 +454,8 @@ def make_metric_fn(cfg: TrainConfig, model):
         return metric_fn
 
     def metric_fn(params, model_state, batch):
-        logits = model.apply({"params": params, **model_state}, batch["image"])
+        logits = model.apply({"params": params, **model_state},
+                             _maybe_normalize(cfg, batch["image"]))
         out = {"accuracy": losses.accuracy(logits, batch["label"]),
                "loss": losses.softmax_cross_entropy(logits, batch["label"])}
         if batch["label"].shape and cfg.dataset == "imagenet":
