@@ -56,7 +56,7 @@ note "phase C: imagenet_resnet50 synthetic, 300 sustained steps @ batch 256"
 timeout 3000 python -m tpuframe.train --config imagenet_resnet50 \
   --set total_steps=300 --set warmup_steps=50 --set global_batch=256 \
   --set log_every=10 --set eval_every=10000 --set ckpt_every=10000 \
-  --set "dataset_kwargs={'synthetic_size': 1024}" \
+  --set "dataset_kwargs={'synthetic_size': 1024, 'keep_u8': True}" \
   --ckpt-dir "$CKPT-r50" --log-file perf/results/conv_r50.jsonl \
   > perf/results/conv_r50.out 2>&1
 note "phase C exited rc=$?"

@@ -78,6 +78,16 @@ def test_maybe_normalize_passthrough_f32():
     assert train_mod._maybe_normalize(_tiny_cfg(), x) is x
 
 
+def test_cifar_u8_end_to_end():
+    cfg = get_config("cifar10_resnet18").with_overrides(
+        total_steps=2, global_batch=8, warmup_steps=1, log_every=1,
+        eval_every=2, eval_batches=1,
+        dataset_kwargs={"synthetic_size": 64, "keep_u8": True})
+    metrics = train_mod.train(cfg)
+    assert metrics["step"] == 2
+    assert np.isfinite(metrics["loss"]) and np.isfinite(metrics["eval_loss"])
+
+
 def test_label_range_vs_head_mismatch_rejected():
     """A head smaller than the label range used to 'train' on all-zero
     one-hot rows (garbage loss, NaN eval); the harness now rejects it at

@@ -110,9 +110,12 @@ IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
 
 
-def cifar10(data_dir: str | None = None, *, synthetic_size: int = 2048):
-    """[B, 32, 32, 3] float32 normalized, int32 labels.  Reads the python
-    pickle batches of the standard ``cifar-10-batches-py`` layout."""
+def cifar10(data_dir: str | None = None, *, synthetic_size: int = 2048,
+            keep_u8: bool = False):
+    """[B, 32, 32, 3] float32 normalized (or uint8 raw with ``keep_u8`` —
+    see :func:`imagenet`; the pickles are uint8 natively), int32 labels.
+    Reads the python pickle batches of the standard
+    ``cifar-10-batches-py`` layout."""
     if data_dir is not None:
         def load(names):
             xs, ys = [], []
@@ -122,16 +125,23 @@ def cifar10(data_dir: str | None = None, *, synthetic_size: int = 2048):
                 xs.append(np.asarray(d[b"data"], np.uint8))
                 ys.append(np.asarray(d[b"labels"], np.int64))
             x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-            x = (x.astype(np.float32) / 255.0 - CIFAR_MEAN) / CIFAR_STD
-            return ArrayDataset({"image": x,
+            if not keep_u8:
+                x = (x.astype(np.float32) / 255.0 - CIFAR_MEAN) / CIFAR_STD
+            return ArrayDataset({"image": np.ascontiguousarray(x),
                                  "label": np.concatenate(ys).astype(np.int32)})
 
         train = load([f"data_batch_{i}" for i in range(1, 6)])
         test = load(["test_batch"])
         return train, test
-    return (_synthetic_images(synthetic_size, (32, 32, 3), 10, seed=2),
-            _synthetic_images(max(synthetic_size // 8, 64), (32, 32, 3), 10,
-                              seed=3, template_seed=2))
+    train, test = (
+        _synthetic_images(synthetic_size, (32, 32, 3), 10, seed=2),
+        _synthetic_images(max(synthetic_size // 8, 64), (32, 32, 3), 10,
+                          seed=3, template_seed=2))
+    if keep_u8:
+        for ds in (train, test):
+            ds.columns["image"] = np.round(
+                ds.columns["image"] * 255.0).astype(np.uint8)
+    return train, test
 
 
 # ---------------------------------------------------------------------------
