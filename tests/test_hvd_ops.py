@@ -1,0 +1,257 @@
+"""Reduction-op surface parity: hvd.Min/Max/Product/Adasum + ProcessSet.
+
+Horovod exposes a reduction-op enum on ``hvd.allreduce`` and subgroup
+collectives via ``hvd.ProcessSet`` (SURVEY.md §3b op set; the Adasum op is
+arXiv:2006.02924).  These tests pin the SPMD realizations on the 8-device
+virtual CPU mesh:
+
+  - Min/Max/Product against numpy reductions over the replica axis;
+  - Adasum's butterfly against an independent numpy model of the same
+    pairing tree, plus the op's two DEFINING properties — identical
+    vectors -> identity (scale-insensitive), orthogonal vectors -> sum;
+  - ProcessSet masked semantics: members get the subgroup result,
+    non-members' tensors are untouched (Horovod's contract).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpuframe.parallel import collectives, hvd
+
+
+def _run8(body, x, mesh8, out_spec=P("data")):
+    f = jax.jit(jax.shard_map(body, mesh=mesh8, in_specs=P("data"),
+                              out_specs=out_spec))
+    return np.asarray(f(x))
+
+
+def _adasum_pair(a, b):
+    dot = float(np.dot(a.ravel(), b.ravel()))
+    na = float(np.dot(a.ravel(), a.ravel()))
+    nb = float(np.dot(b.ravel(), b.ravel()))
+    ca = dot / (2 * na) if na > 0 else 0.0
+    cb = dot / (2 * nb) if nb > 0 else 0.0
+    return (1 - ca) * a + (1 - cb) * b
+
+
+def _adasum_butterfly(rows):
+    rows = [r.astype(np.float64) for r in rows]
+    n = len(rows)
+    k = 1
+    while k < n:
+        rows = [_adasum_pair(rows[i], rows[i ^ k]) for i in range(n)]
+        k *= 2
+    return rows[0]
+
+
+class TestReduceOps:
+    def test_min_max(self, mesh8):
+        x = np.arange(16.0).reshape(8, 2)[np.random.default_rng(0).permutation(8)]
+
+        def body(t):
+            return (collectives.reduce_min(t, "data"),
+                    collectives.reduce_max(t, "data"))
+
+        f = jax.jit(jax.shard_map(body, mesh=mesh8, in_specs=P("data"),
+                                  out_specs=(P(), P())))
+        mn, mx = f(x)
+        np.testing.assert_allclose(np.asarray(mn)[0], x.reshape(8, 1, 2).min(0)[0])
+        np.testing.assert_allclose(np.asarray(mx)[0], x.reshape(8, 1, 2).max(0)[0])
+
+    def test_product_with_zero_and_negative(self, mesh8):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((8, 3)).astype(np.float32)
+        x[2, 1] = 0.0  # a log/exp formulation would break here
+        x[5] *= -1.0
+        out = _run8(lambda t: collectives.reduce_prod(t, "data"), x, mesh8, P())
+        np.testing.assert_allclose(out[0], np.prod(x, axis=0), rtol=1e-5)
+
+    def test_hvd_op_routing(self, mesh8):
+        x = np.arange(8.0)
+
+        def body(t):
+            return (hvd.allreduce(t, op=hvd.Min), hvd.allreduce(t, op=hvd.Max),
+                    hvd.allreduce(t, op=hvd.Sum), hvd.allreduce(t))
+
+        f = jax.jit(jax.shard_map(body, mesh=mesh8, in_specs=P("data"),
+                                  out_specs=(P(), P(), P(), P())))
+        mn, mx, s, avg = (np.asarray(v) for v in f(x))
+        assert mn[0] == 0.0 and mx[0] == 7.0 and s[0] == 28.0 and avg[0] == 3.5
+
+    def test_average_and_op_conflict(self):
+        with pytest.raises(ValueError, match="not both"):
+            hvd.allreduce(jnp.ones(3), average=True, op=hvd.Sum)
+
+
+class TestAdasum:
+    def test_identical_vectors_are_identity(self, mesh8):
+        # Scale-insensitivity: adasum(a, a) == a, so N identical replicas
+        # reduce to the vector itself (NOT N*a) — the defining contrast
+        # with Sum and the reason Adasum removes LR-by-size scaling.
+        row = np.linspace(-2, 3, 6, dtype=np.float32)
+        x = np.tile(row, (8, 1))
+        out = _run8(lambda t: collectives.adasum(t, "data"), x, mesh8, P())
+        np.testing.assert_allclose(out[0], row, rtol=1e-6)
+
+    def test_orthogonal_vectors_sum(self, mesh8):
+        # Each replica holds a distinct scaled basis vector: orthogonal at
+        # every butterfly stage, so the result is the plain sum.
+        scales = np.arange(1.0, 9.0, dtype=np.float32)
+        x = np.diag(scales).astype(np.float32)
+        out = _run8(lambda t: collectives.adasum(t, "data"), x, mesh8, P())
+        np.testing.assert_allclose(out[0], x.sum(0), rtol=1e-6)
+
+    def test_matches_numpy_butterfly(self, mesh8):
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((8, 4, 3)).astype(np.float32)
+        out = _run8(lambda t: collectives.adasum(t, "data"), x, mesh8, P())
+        ref = _adasum_butterfly([x[i] for i in range(8)])
+        np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-5)
+
+    def test_zero_replica_contribution(self, mesh8):
+        # One replica contributes a zero vector: the zero-norm guard must
+        # not NaN, and adasum(0, b) == b at the pair level.
+        rng = np.random.default_rng(8)
+        x = rng.standard_normal((8, 5)).astype(np.float32)
+        x[3] = 0.0
+        out = _run8(lambda t: collectives.adasum(t, "data"), x, mesh8, P())
+        assert np.isfinite(out).all()
+        ref = _adasum_butterfly([x[i] for i in range(8)])
+        np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-5)
+
+    def test_all_replicas_agree(self, mesh8):
+        rng = np.random.default_rng(9)
+        x = rng.standard_normal((8, 4)).astype(np.float32)
+        out = _run8(lambda t: collectives.adasum(t, "data"), x, mesh8,
+                    P("data"))
+        for i in range(1, 8):
+            np.testing.assert_allclose(out[i], out[0], rtol=1e-6)
+
+    def test_distributed_optimizer_adasum(self, mesh8):
+        # op=Adasum routes grads through the butterfly: with per-replica
+        # orthogonal grads the applied update is the SUM of contributions.
+        import optax
+
+        x = np.diag(np.arange(1.0, 9.0)).astype(np.float32)
+        tx = hvd.DistributedOptimizer(optax.sgd(1.0), op=hvd.Adasum,
+                                      axis="data")
+
+        def body(g):
+            params = jnp.zeros((8,), jnp.float32)
+            state = tx.init(params)
+            updates, _ = tx.update(g, state, params)
+            return updates
+
+        out = _run8(body, x, mesh8, P())
+        np.testing.assert_allclose(out[0], -x.sum(0), rtol=1e-5)
+
+    def test_adasum_rejects_compression(self):
+        import optax
+
+        with pytest.raises(ValueError, match="compression"):
+            hvd.DistributedOptimizer(optax.sgd(1.0), op=hvd.Adasum,
+                                     compression="bf16")
+
+    def test_presummed_leaves_degrade_to_sum(self, mesh8):
+        # Grads of replicated params arrive already psum'd (vma-unvarying).
+        # The documented contract: adasum passes them through unchanged
+        # (i.e. the value IS the cross-replica sum) instead of crashing in
+        # ppermute's vma check.
+        x = np.arange(8.0, dtype=np.float32)
+
+        def body(t):
+            presummed = jax.lax.psum(t, "data")
+            return collectives.adasum(presummed, "data")
+
+        out = _run8(body, x, mesh8, P())
+        assert out[0] == pytest.approx(28.0)
+
+
+class TestUnitAxisMesh:
+    """The single-device 'config 1' mode: a bound size-1 axis must come back
+    vma-replicated from every op so out_specs=P() still compiles."""
+
+    @pytest.fixture
+    def mesh1(self):
+        from jax.sharding import Mesh
+
+        return Mesh(np.asarray(jax.devices()[:1]), ("data",))
+
+    def test_ops_clear_unit_axis(self, mesh1):
+        ps = hvd.ProcessSet([0])
+
+        def body(t):
+            return (collectives.allreduce(t, "data"),
+                    collectives.broadcast(t, "data"),
+                    collectives.reduce_min(t, "data"),
+                    collectives.reduce_prod(t, "data"),
+                    collectives.adasum(t, "data"),
+                    hvd.allreduce(t, process_set=ps, axis="data"))
+
+        f = jax.jit(jax.shard_map(body, mesh=mesh1, in_specs=P("data"),
+                                  out_specs=tuple([P()] * 6)))
+        outs = f(np.arange(4.0, dtype=np.float32))
+        for o in outs:
+            np.testing.assert_allclose(np.asarray(o), np.arange(4.0))
+
+
+class TestProcessSet:
+    def test_members_reduced_others_untouched(self, mesh8):
+        ps = hvd.ProcessSet([1, 3, 5])
+        x = np.arange(8.0, dtype=np.float32)
+        out = _run8(lambda t: hvd.allreduce(t, process_set=ps), x, mesh8)
+        want_mean = (1 + 3 + 5) / 3.0
+        for r in range(8):
+            expect = want_mean if r in (1, 3, 5) else float(r)
+            assert out[r] == pytest.approx(expect), r
+
+    def test_sum_op(self, mesh8):
+        ps = hvd.ProcessSet([0, 7])
+        x = np.arange(8.0, dtype=np.float32)
+        out = _run8(lambda t: hvd.allreduce(t, op=hvd.Sum, process_set=ps),
+                    x, mesh8)
+        assert out[0] == 7.0 and out[7] == 7.0
+        for r in range(1, 7):
+            assert out[r] == float(r)
+
+    def test_broadcast_to_subset(self, mesh8):
+        ps = hvd.ProcessSet([2, 4, 6])
+        x = np.arange(8.0, dtype=np.float32)
+        out = _run8(
+            lambda t: hvd.broadcast_parameters(t, root_rank=4, process_set=ps),
+            x, mesh8)
+        for r in range(8):
+            expect = 4.0 if r in (2, 4, 6) else float(r)
+            assert out[r] == pytest.approx(expect), r
+
+    def test_broadcast_root_must_be_member(self, mesh8):
+        ps = hvd.ProcessSet([2, 4])
+
+        def body(t):
+            return hvd.broadcast_parameters(t, root_rank=0, process_set=ps)
+
+        with pytest.raises(ValueError, match="not a member"):
+            jax.jit(jax.shard_map(body, mesh=mesh8, in_specs=P("data"),
+                                  out_specs=P("data")))(np.arange(8.0))
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            hvd.ProcessSet([])
+        with pytest.raises(ValueError):
+            hvd.ProcessSet([-1, 2])
+        assert hvd.ProcessSet([3, 1, 3, 2]).ranks == (1, 2, 3)
+
+    def test_out_of_range_rank_raises(self, mesh8):
+        # Rank 8 on an 8-replica axis never matches any index; without the
+        # trace-time check the mean divisor would silently be wrong.
+        ps = hvd.ProcessSet([0, 1, 8])
+
+        def body(t):
+            return hvd.allreduce(t, process_set=ps)
+
+        with pytest.raises(ValueError, match="out of range"):
+            jax.jit(jax.shard_map(body, mesh=mesh8, in_specs=P("data"),
+                                  out_specs=P("data")))(np.arange(8.0))

@@ -71,8 +71,15 @@ def allreduce(x: PyTree, axis: AxisName = "data", *, average: bool = True) -> Py
     bound = _bound_axes(axis)
     if not bound:
         return x
+    sized = _sized_axes(bound)
+    if not sized:
+        return jax.tree.map(lambda t: _clear_unit_axes(t, bound), x)
     op = lax.pmean if average else lax.psum
-    return jax.tree.map(lambda t: op(t, bound), x)
+    # _vary_over: a leaf replicated along one sized axis but varying along
+    # another would otherwise present a mixed vma state psum rejects;
+    # counting it once per mesh position is Horovod's rank-space semantics.
+    return jax.tree.map(
+        lambda t: _clear_unit_axes(op(_vary_over(t, sized), sized), bound), x)
 
 
 def average_gradients(grads: PyTree, axis: AxisName = "data") -> PyTree:
@@ -158,6 +165,55 @@ def allgather(x: jax.Array, axis: AxisName = "data", *, tiled: bool = True) -> j
     return lax.all_gather(x, bound, axis=0, tiled=tiled)
 
 
+def _linear_index(bound: tuple[str, ...]) -> jax.Array:
+    """Row-major linearized replica index over the bound axes — the single
+    rank space Horovod exposes (``hvd.rank()`` in its one-process-per-GPU
+    model), reconstructed from the mesh position.
+
+    Size-1 axes are skipped: their index is identically 0, and touching
+    ``axis_index`` on them would mark the result varying over axes it
+    cannot actually vary over (breaking callers' out_specs inference).
+    """
+    sized = _sized_axes(bound)
+    if not sized:
+        return jnp.zeros((), jnp.int32)
+    if len(sized) == 1:
+        return lax.axis_index(sized[0])
+    idx = jnp.zeros((), jnp.int32)
+    for name in sized:
+        idx = idx * lax.axis_size(name) + lax.axis_index(name)
+    return idx
+
+
+def _sized_axes(bound: tuple[str, ...]) -> tuple[str, ...]:
+    """Bound axes with size > 1 — the axes a reduction can actually act on.
+    Size-1 axes are no-ops whose inclusion only confuses vma inference."""
+    return tuple(n for n in bound if lax.axis_size(n) > 1)
+
+
+def _vary_over(t, axes: tuple[str, ...]):
+    """Make ``t`` vma-varying over every axis in ``axes`` so a collective can
+    legally reduce over all of them at once (a replicated leaf counts once
+    per mesh position — Horovod's rank-space semantics, where duplicate
+    values on distinct ranks are still distinct contributions)."""
+    missing = tuple(a for a in axes if a not in jax.typeof(t).vma)
+    return lax.pcast(t, missing, to="varying") if missing else t
+
+
+def _clear_unit_axes(t, bound: tuple[str, ...]):
+    """Mark ``t`` reduced over any size-1 bound axes it is vma-varying on.
+
+    Reductions here act only on the >1-sized axes, but a reduction over the
+    whole ``bound`` tuple must still come back replicated over ALL of it —
+    callers' ``out_specs`` rely on that (the single-device "config 1" mode
+    maps a size-1 data axis).  psum over a size-1 axis is a value identity
+    the compiler elides; it exists purely to update the vma state.
+    """
+    small = tuple(a for a in bound
+                  if lax.axis_size(a) == 1 and a in jax.typeof(t).vma)
+    return lax.psum(t, small) if small else t
+
+
 def broadcast(x: PyTree, axis: AxisName = "data", *, root: int = 0) -> PyTree:
     """Every member takes root's value (Horovod broadcast).
 
@@ -167,17 +223,15 @@ def broadcast(x: PyTree, axis: AxisName = "data", *, root: int = 0) -> PyTree:
     bound = _bound_axes(axis)
     if not bound:
         return x
-    if len(bound) == 1:
-        idx = lax.axis_index(bound[0])
-    else:
-        # Linearized index over the bound axes, row-major.
-        idx = jnp.zeros((), jnp.int32)
-        for name in bound:
-            idx = idx * lax.axis_size(name) + lax.axis_index(name)
+    sized = _sized_axes(bound)
+    if not sized:
+        return jax.tree.map(lambda t: _clear_unit_axes(t, bound), x)
+    idx = _linear_index(bound)
 
     def _bcast(t):
-        masked = jnp.where(idx == root, t, jnp.zeros_like(t))
-        return lax.psum(masked, bound)
+        masked = jnp.where(idx == root, _vary_over(t, sized),
+                           jnp.zeros_like(t))
+        return _clear_unit_axes(lax.psum(masked, sized), bound)
 
     return jax.tree.map(_bcast, x)
 
@@ -402,6 +456,209 @@ def psum_scalar(value: float | jax.Array, axis: AxisName = "data") -> jax.Array:
     if not _in_mapped_context(axis):
         return jnp.asarray(value)
     return lax.psum(jnp.asarray(value), axis)
+
+
+def reduce_min(x: PyTree, axis: AxisName = "data") -> PyTree:
+    """Elementwise cross-replica minimum (Horovod ``op=hvd.Min``)."""
+    return _minmax_reduce(x, axis, lax.pmin)
+
+
+def reduce_max(x: PyTree, axis: AxisName = "data") -> PyTree:
+    """Elementwise cross-replica maximum (Horovod ``op=hvd.Max``)."""
+    return _minmax_reduce(x, axis, lax.pmax)
+
+
+def _minmax_reduce(x: PyTree, axis: AxisName, op) -> PyTree:
+    bound = _bound_axes(axis)
+    if not bound:
+        return x
+    sized = _sized_axes(bound)
+    if not sized:
+        return jax.tree.map(lambda t: _clear_unit_axes(t, bound), x)
+    return jax.tree.map(
+        lambda t: _clear_unit_axes(op(_vary_over(t, sized), sized), bound), x)
+
+
+def reduce_prod(x: PyTree, axis: AxisName = "data") -> PyTree:
+    """Elementwise cross-replica product (Horovod ``op=hvd.Product``).
+
+    XLA has no product all-reduce HLO; the sound formulation (zeros and
+    negative values included — a log/exp trick would not be) is all_gather
+    then a local product over the gathered axis.  Product reductions are a
+    metrics-sized verb in practice, so the gather's N× wire traffic does
+    not matter.
+    """
+    bound = _bound_axes(axis)
+    if not bound:
+        return x
+    sized = _sized_axes(bound)
+    if not sized:
+        return jax.tree.map(lambda t: _clear_unit_axes(t, bound), x)
+
+    def _prod(t):
+        gathered = lax.all_gather(_vary_over(t, sized), sized, axis=0,
+                                  tiled=False)
+        # Every replica computes the identical product from the gathered
+        # copies, but vma can't see through all_gather: pmax of identical
+        # values is a bit-exact identity that marks the result reduced.
+        return _clear_unit_axes(lax.pmax(jnp.prod(gathered, axis=0), sized),
+                                bound)
+
+    return jax.tree.map(_prod, x)
+
+
+def adasum(tree: PyTree, axis: AxisName = "data") -> PyTree:
+    """Adaptive summation (Horovod ``op=hvd.Adasum``, arXiv:2006.02924).
+
+    The pairwise combine is scale-insensitive: for gradients ``a, b``
+
+        adasum(a, b) = (1 - a.b / 2|a|^2) a  +  (1 - a.b / 2|b|^2) b
+
+    which is the *mean* when a == b (each coefficient becomes 1/2) and the
+    *sum* when a ⟂ b — interpolating between LR-scaling regimes, which is
+    the whole point of the op.  Horovod runs it as a recursive-halving
+    tree in its C++ runtime; the SPMD-native realization is a ppermute
+    BUTTERFLY: at stage k every replica exchanges with ``index XOR 2^k``
+    and applies the (symmetric) combine, so all replicas hold the identical
+    reduction after log2(N) stages — same pairing tree, no runtime thread.
+
+    Norm/dot accumulation is f32 regardless of input dtype.  Requires a
+    power-of-two replica count (TPU mesh axes are powers of two); the
+    butterfly pairing has no remainder path.
+
+    Arrival-state caveat (cf. ``average_gradients``): Adasum needs the RAW
+    per-replica gradients.  Under shard_map autodiff, grads of replicated
+    (unvarying) params arrive ALREADY psum'd — identical on every replica —
+    and adasum of identical vectors is the identity, so a pre-summed leaf
+    passes through as the cross-replica SUM, not the adaptive combine.  To
+    get true Adasum semantics compute per-shard losses against ``pvary``-ed
+    params so grads stay varying (the harness's step builder does).
+    """
+    names = _bound_axes(axis)
+    if not names:
+        return tree
+    # Multiple bound axes: sequential per-axis butterflies (equivalent to
+    # one big butterfly up to Adasum's own pairing-tree dependence — the op
+    # is not associative, and Horovod's own result likewise depends on its
+    # reduction-tree shape).
+    if len(names) > 1:
+        out = tree
+        for a in names:
+            out = adasum(out, a)
+        return out
+    (name,) = names
+    n = lax.axis_size(name)
+    if n & (n - 1):
+        raise ValueError(f"adasum butterfly needs a power-of-two replica "
+                         f"count, got {n} over {name!r}")
+    if n == 1:
+        return jax.tree.map(lambda t: _clear_unit_axes(t, names), tree)
+
+    def _ada(x):
+        # Pre-summed (unvarying) leaves enter the butterfly as identical
+        # vectors and come out unchanged — the documented degrade-to-sum;
+        # without the cast, ppermute rejects the unvarying operand outright.
+        v = _vary_over(x.astype(jnp.float32), (name,))
+        for k in range(n.bit_length() - 1):
+            dist = 1 << k
+            perm = [(i, i ^ dist) for i in range(n)]
+            other = lax.ppermute(v, name, perm)
+            dot = jnp.vdot(v, other)
+            na = jnp.vdot(v, v)
+            nb = jnp.vdot(other, other)
+            ca = jnp.where(na > 0, dot / (2.0 * na), 0.0)
+            cb = jnp.where(nb > 0, dot / (2.0 * nb), 0.0)
+            v = (1.0 - ca) * v + (1.0 - cb) * other
+        # All replicas now hold the identical combined value, but the vma
+        # system cannot infer that through ppermute.  pmax of identical
+        # values is a BIT-EXACT identity (unlike pmean, whose re-summation
+        # can round) and marks the leaf reduced over the axis — at the cost
+        # of one extra gradient-sized collective, which is in the spirit of
+        # the op (Horovod's Adasum tree is likewise pricier than a ring).
+        return lax.pmax(v, name).astype(x.dtype)
+
+    return jax.tree.map(_ada, tree)
+
+
+def _member_mask(bound: tuple[str, ...], ranks: Sequence[int]) -> jax.Array:
+    """Boolean scalar: is this replica's linearized rank in ``ranks``?"""
+    idx = _linear_index(bound)
+    member = jnp.zeros((), bool)
+    for r in ranks:
+        member = member | (idx == r)
+    return member
+
+
+def _check_ranks(bound: tuple[str, ...], ranks: Sequence[int]) -> None:
+    """Trace-time validation: every rank must exist in the linearized rank
+    space, else masked collectives silently drop contributions (an
+    out-of-range rank never matches any replica's index) — Horovod raises
+    for invalid ranks too."""
+    world = 1
+    for a in _sized_axes(bound):
+        world *= lax.axis_size(a)
+    bad = [int(r) for r in ranks if int(r) >= world]
+    if bad:
+        raise ValueError(f"process-set ranks {bad} out of range for a "
+                         f"{world}-replica axis {bound}")
+
+
+def masked_allreduce(x: PyTree, axis: AxisName, ranks: Sequence[int], *,
+                     average: bool = True) -> PyTree:
+    """Allreduce restricted to the replicas in ``ranks`` (Horovod
+    ``process_set=``): members receive the subgroup sum/mean, NON-members
+    keep their input unchanged — Horovod's op simply never runs on ranks
+    outside the set.
+
+    Realized as a masked reduction over the full axis (zero contributions
+    from non-members, static divisor ``len(ranks)``) — one full-axis psum
+    instead of a subgroup communicator, which XLA then routes over the same
+    ICI links a subgroup ring would use.
+    """
+    bound = _bound_axes(axis)
+    if not bound:
+        return x
+    sized = _sized_axes(bound)
+    if not sized:
+        return jax.tree.map(lambda t: _clear_unit_axes(t, bound), x)
+    _check_ranks(bound, ranks)
+    m = _member_mask(bound, ranks)
+    count = len(set(int(r) for r in ranks))
+
+    def _f(t):
+        contrib = jnp.where(m, _vary_over(t, sized), jnp.zeros_like(t))
+        total = lax.psum(contrib, sized)
+        if average:
+            total = (total.astype(jnp.float32) / count).astype(t.dtype)
+        return _clear_unit_axes(jnp.where(m, total, t), bound)
+
+    return jax.tree.map(_f, x)
+
+
+def masked_broadcast(x: PyTree, axis: AxisName, ranks: Sequence[int], *,
+                     root: int) -> PyTree:
+    """Broadcast ``root``'s value to the replicas in ``ranks`` only; others
+    keep their input (Horovod ``broadcast(..., process_set=...)``)."""
+    bound = _bound_axes(axis)
+    if not bound:
+        return x
+    sized = _sized_axes(bound)
+    if not sized:
+        return jax.tree.map(lambda t: _clear_unit_axes(t, bound), x)
+    if root not in set(int(r) for r in ranks):
+        raise ValueError(f"root {root} is not a member of the process set "
+                         f"{sorted(set(int(r) for r in ranks))}")
+    _check_ranks(bound, ranks)
+    m = _member_mask(bound, ranks)
+    idx = _linear_index(bound)
+
+    def _f(t):
+        rooted = lax.psum(
+            jnp.where(idx == root, _vary_over(t, sized), jnp.zeros_like(t)),
+            sized)
+        return _clear_unit_axes(jnp.where(m, rooted, t), bound)
+
+    return jax.tree.map(_f, x)
 
 
 def global_norm(tree: PyTree, axis: AxisName | None = None) -> jax.Array:

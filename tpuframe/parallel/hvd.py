@@ -72,19 +72,99 @@ def is_primary() -> bool:
     return bootstrap.is_primary()
 
 
-def allreduce(tensor: PyTree, average: bool = True, name: str | None = None,
-              axis=_DEFAULT_AXIS) -> PyTree:
+class _ReduceOp:
+    """Reduction-op sentinel, mirroring ``horovod.torch``'s op constants."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self):
+        return f"hvd.{self.name}"
+
+
+Average = _ReduceOp("Average")
+Sum = _ReduceOp("Sum")
+Adasum = _ReduceOp("Adasum")
+Min = _ReduceOp("Min")
+Max = _ReduceOp("Max")
+Product = _ReduceOp("Product")
+
+
+class ProcessSet:
+    """Subgroup for collectives (Horovod ``hvd.ProcessSet``).
+
+    Horovod builds a sub-communicator per set; under SPMD the set is a
+    static membership list over the linearized replica index, and the
+    collective is a masked full-axis reduction (non-members keep their
+    input untouched, matching Horovod's "op never runs outside the set").
+    """
+
+    def __init__(self, ranks):
+        ranks = tuple(sorted(set(int(r) for r in ranks)))
+        if not ranks:
+            raise ValueError("ProcessSet needs at least one rank")
+        if any(r < 0 for r in ranks):
+            raise ValueError(f"negative rank in ProcessSet: {ranks}")
+        self.ranks = ranks
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def __repr__(self):
+        return f"ProcessSet(ranks={list(self.ranks)})"
+
+
+def allreduce(tensor: PyTree, average: bool | None = None,
+              name: str | None = None, axis=_DEFAULT_AXIS,
+              op: _ReduceOp | None = None,
+              process_set: ProcessSet | None = None) -> PyTree:
     """``hvd.allreduce`` — inside a mapped step fn this is a traced collective;
-    outside, identity (single-host value already global under SPMD)."""
+    outside, identity (single-host value already global under SPMD).
+
+    ``op`` selects the reduction (``hvd.Average`` default / ``Sum`` /
+    ``Adasum`` / ``Min`` / ``Max`` / ``Product``); the legacy ``average=``
+    boolean is honored but, as in Horovod, may not be combined with ``op``.
+    ``process_set`` restricts the op to a replica subgroup — members get the
+    subgroup result, non-members keep their input.
+    """
     del name  # Horovod used names for its fusion table; XLA needs none.
-    return collectives.allreduce(tensor, axis=axis, average=average)
+    if average is not None and op is not None:
+        raise ValueError("specify either average= or op=, not both "
+                         "(Horovod raises here too)")
+    if op is None:
+        op = Sum if average is False else Average
+    if process_set is not None:
+        if op is Average or op is Sum:
+            return collectives.masked_allreduce(
+                tensor, axis, process_set.ranks, average=op is Average)
+        raise NotImplementedError(
+            f"process_set is supported for Average/Sum, not {op!r}")
+    if op is Average:
+        return collectives.allreduce(tensor, axis=axis, average=True)
+    if op is Sum:
+        return collectives.allreduce(tensor, axis=axis, average=False)
+    if op is Adasum:
+        return collectives.adasum(tensor, axis=axis)
+    if op is Min:
+        return collectives.reduce_min(tensor, axis=axis)
+    if op is Max:
+        return collectives.reduce_max(tensor, axis=axis)
+    if op is Product:
+        return collectives.reduce_prod(tensor, axis=axis)
+    raise ValueError(f"unknown reduction op {op!r}")
 
 
-def broadcast_parameters(params: PyTree, root_rank: int = 0, axis=_DEFAULT_AXIS) -> PyTree:
+def broadcast_parameters(params: PyTree, root_rank: int = 0, axis=_DEFAULT_AXIS,
+                         process_set: ProcessSet | None = None) -> PyTree:
     """``hvd.broadcast_parameters`` — under SPMD initialization, parameters are
     created identically on every chip from a shared PRNG key, so the broadcast
     is only needed when a caller deliberately diverged state; we honor the
     call inside mapped contexts and no-op otherwise."""
+    if process_set is not None:
+        return collectives.masked_broadcast(params, axis, process_set.ranks,
+                                            root=root_rank)
     return collectives.broadcast(params, axis=axis, root=root_rank)
 
 
@@ -103,6 +183,7 @@ def DistributedOptimizer(
     axis=_DEFAULT_AXIS,
     average: bool = True,
     compression: str | None = None,
+    op: _ReduceOp | None = None,
 ) -> optax.GradientTransformation:
     """Wrap ``tx`` so updates see cross-replica-averaged gradients.
 
@@ -117,12 +198,30 @@ def DistributedOptimizer(
     reduction); "int8" is the EQuARX-style further step (PAPERS.md:7) —
     shared-scale int8 quantization summed in int16 on the wire
     (collectives.quantized_mean; requires ``average=True``).
+
+    ``op=hvd.Adasum`` selects adaptive summation (collectives.adasum) in
+    place of the mean — Horovod's scale-insensitive large-batch reduction.
+    Adasum's combine is norm-based, so wire compression is disallowed with
+    it (as in Horovod, where Adasum + fp16 compression is unsupported).
     """
+    if op is not None and op not in (Average, Sum, Adasum):
+        raise ValueError(f"DistributedOptimizer supports Average/Sum/Adasum, "
+                         f"got {op!r}")
+    if op is Adasum and compression is not None:
+        raise ValueError("Adasum's norm-based combine does not compose with "
+                         "wire compression")
+    if op is not None:
+        average = op is Average
 
     def init_fn(params):
         return _DistState(inner=tx.init(params))
 
     def update_fn(grads, state, params=None, **extra):
+        if op is Adasum:
+            updates, inner = tx.update(
+                collectives.adasum(grads, axis=axis), state.inner, params,
+                **extra)
+            return updates, _DistState(inner=inner)
         if compression == "int8":
             # Quantized wire path (EQuARX-style): shared-scale int8
             # quantization psum'd in int16 (collectives.quantized_mean) —
