@@ -52,6 +52,14 @@ run_failed_by_outage() { # rc errfile — did this failure look like an outage?
   # in an unrelated failure just costs one harmless retry.
   [ -f "$err" ] && tail -c 4000 "$err" \
     | grep -q "Unable to initialize backend\|UNAVAILABLE" && return 0
+  # mode 3: timeout kill (rc 124).  Observed 2026-07-31: a SIGTERM'd
+  # client wedges the grant such that the NEXT client hangs in backend
+  # init with the tunnel ports still listening and no UNAVAILABLE within
+  # a 20-min timeout — every later run then burns its full timeout.  A
+  # timeout is treated as outage-suspect: the re-claim probe is ~10s when
+  # the relay is actually healthy, so the false-positive cost is one
+  # retry of a genuinely-slow run.
+  [ "$rc" = 124 ] && return 0
   return 1
 }
 

@@ -27,17 +27,26 @@ from tpuframe import models
 from tpuframe.models import losses
 from tpuframe.parallel import step as step_lib
 
-BATCH = int(os.environ.get("B", "512"))
+# 256 is the measured throughput optimum (BASELINE.md round 3) and half the
+# compile surface of 512 — the byte ATTRIBUTION (which tensors inflate) is
+# batch-proportional either way.  Override with B=512 for the exact
+# roofline-measurement shape.
+BATCH = int(os.environ.get("B", "256"))
 log = make_log("hlo-dump")
 
 
 def main():
     model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(0.5, 0.25, size=(BATCH, 224, 224, 3)),
-                    jnp.bfloat16)
+    log(f"building host batch (B={BATCH})...")
+    x_host = rng.normal(0.5, 0.25, size=(BATCH, 224, 224, 3)).astype(np.float32)
+    log("transferring to device...")
+    x = jnp.asarray(x_host, jnp.bfloat16)
     y = jnp.asarray(rng.integers(0, 1000, size=(BATCH,)), jnp.int32)
+    jax.block_until_ready(x)
+    log("init model params (device)...")
     variables = model.init(jax.random.key(0), x[:2])
+    jax.block_until_ready(variables)
     tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
 
     def loss_fn(params, model_state, batch, step_rng):

@@ -1,0 +1,94 @@
+#!/bin/bash
+# Recovery continuation of run_all_tpu4.sh (2026-07-31): the original
+# queue's hlo_dump run hung >30 min, its timeout SIGTERM wedged the chip
+# grant, and the next runs burned their timeouts against the wedge without
+# matching the old outage signatures.  This queue:
+#   - carries every remaining queue-4 item (bench regeneration, s2d,
+#     convergence + crash/resume, honest attention/breakdown timings,
+#     transformer A/Bs, autotune demo), then chains queue 5 unchanged;
+#   - moves the byte census (hlo_dump — the hang suspect) to the END,
+#     at B=256 with per-phase progress logging;
+#   - relies on claim.sh's new mode-3 outage rule (rc=124 => re-claim +
+#     retry once) so a wedge can no longer cascade.
+# Relay rules (PERF.md §0): ONE client, strictly serial.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p perf/results
+LOG=perf/results/run_all4.log
+echo "=== run_all_tpu4b $(date -u +%FT%TZ) ===" >> "$LOG"
+. perf/claim.sh
+
+note() { echo "[run_all4b $(date -u +%T)] $*" | tee -a "$LOG"; }
+
+claim_wait_for_others | tee -a "$LOG"
+
+note "phase 0: probing for chip claim (retry loop)..."
+if ! claim_chip 96 "$LOG"; then
+  note "phase 0 FAILED — relay wedged for the whole window; giving up"
+  exit 1
+fi
+note "chip claimed — running queue 4b"
+
+run() { queue_run "$@"; }
+
+# --- bench regeneration (corrected MFU accounting, honest timing) --------
+for b in 256 192 320 384 512 768 1024; do
+  TPUFRAME_BENCH_BATCH=$b run bench_b$b 1200 python bench.py
+done
+TPUFRAME_BENCH_BATCH=256 TPUFRAME_BENCH_STEM=space_to_depth \
+    run bench_s2d_256 1200 python bench.py
+TPUFRAME_BENCH_BATCH=512 TPUFRAME_BENCH_STEM=space_to_depth \
+    run bench_s2d_512 1200 python bench.py
+ok_bench() { python - "$1" <<'EOF'
+import json, sys
+try:
+    rec = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+    sys.exit(0 if rec.get("value", 0) > 0 and not rec.get("degraded") else 1)
+except Exception:
+    sys.exit(1)
+EOF
+}
+if ok_bench perf/results/bench_b512.out; then
+  rm -f perf/results/bench_default.out perf/results/bench_default.err
+fi
+if ok_bench perf/results/bench_s2d_512.out; then
+  rm -f perf/results/bench_s2d.out perf/results/bench_s2d.err
+fi
+
+# --- convergence + crash/resume proof ------------------------------------
+note "START exp_convergence (sub-script, has its own claim/retry phases)"
+bash perf/exp_convergence.sh >> "$LOG" 2>&1
+note "END exp_convergence rc=$?"
+
+# --- honest attention + breakdown timings --------------------------------
+run attn_bench2 2400 python perf/bench_attention.py
+run breakdown2 1800 python perf/exp_breakdown.py
+
+# --- transformer A/Bs ----------------------------------------------------
+MODEL=lm XENT=fused run tf_lm_fusedxent 2400 python perf/bench_transformer.py
+MODEL=lm XENT=fused LM_BATCH=2 LM_SEQ=8192 \
+    run tf_lm_8k 2400 python perf/bench_transformer.py
+MODEL=lm XENT=fused LM_BATCH=1 LM_SEQ=32768 ATTN_ONLY=pallas \
+    run tf_lm_32k 2400 python perf/bench_transformer.py
+MODEL=bert BERT_BATCH=256 run tf_bert_b256 1800 python perf/bench_transformer.py
+MODEL=lm XENT=fused REMAT=0 run tf_lm_noremat 2400 python perf/bench_transformer.py
+MODEL=lm REMAT=0 run tf_lm_noremat_dense 2400 python perf/bench_transformer.py
+
+# --- live autotune demo --------------------------------------------------
+TPUFRAME_BENCH_BATCH=256 TPUFRAME_BENCH_STEPS=8 TPUFRAME_BENCH_WARMUP=2 \
+    TPUFRAME_BENCH_BUDGET_S=850 \
+    run autotune_demo 4200 python -m tpuframe.obs.autotune \
+    --out perf/results/autotune_report.json --budget 4 --timeout 900 \
+    --axis "TPUFRAME_FUSION_THRESHOLD=,0,67108864" \
+    -- python bench.py
+
+note "queue 4b complete"
+if [ -f perf/run_all_tpu5.sh ]; then
+  note "chaining queue 5"
+  bash perf/run_all_tpu5.sh
+fi
+
+# --- byte census LAST (the 2026-07-31 hang suspect) ----------------------
+run hlo_dump 2400 python perf/exp_hlo_dump.py
+
+note "queue 4b + census complete"

@@ -170,6 +170,71 @@ class TestAdasum:
         assert out[0] == pytest.approx(28.0)
 
 
+class TestAdasumStep:
+    """grad_reduce='adasum' through the real step builder."""
+
+    def _setup(self):
+        import optax
+
+        from tpuframe.parallel import step as step_lib
+
+        def loss_fn(params, model_state, batch, rng):
+            pred = batch["x"] @ params["w"]
+            return jnp.mean((pred - batch["y"]) ** 2), (model_state, {})
+
+        rng = np.random.default_rng(3)
+        w = {"w": jnp.asarray(rng.standard_normal((6, 2)), jnp.float32)}
+        tx = optax.sgd(0.1)
+        state = step_lib.TrainState.create(w, tx)
+        return step_lib, loss_fn, tx, state, rng
+
+    def test_identical_shards_match_single_device(self, mesh8):
+        # Adasum of identical per-replica grads is the IDENTITY, so feeding
+        # every replica the same batch must reproduce the unmapped step
+        # exactly — the end-to-end form of the scale-insensitivity property.
+        step_lib, loss_fn, tx, state, rng = self._setup()
+        xb = rng.standard_normal((4, 6)).astype(np.float32)
+        yb = (xb @ np.ones((6, 2))).astype(np.float32)
+
+        ada_step = step_lib.make_train_step(loss_fn, tx, mesh8, donate=False,
+                                            grad_reduce="adasum")
+        big = {"x": jnp.asarray(np.tile(xb, (8, 1))),
+               "y": jnp.asarray(np.tile(yb, (8, 1)))}
+        new_ada, m_ada = ada_step(state, big)
+
+        solo_step = step_lib.make_train_step(loss_fn, tx, None, donate=False)
+        new_solo, m_solo = solo_step(state, {"x": jnp.asarray(xb),
+                                             "y": jnp.asarray(yb)})
+        np.testing.assert_allclose(np.asarray(new_ada.params["w"]),
+                                   np.asarray(new_solo.params["w"]),
+                                   rtol=2e-6, atol=1e-7)
+        assert float(m_ada["loss"]) == pytest.approx(float(m_solo["loss"]),
+                                                     rel=1e-5)
+
+    def test_composes_with_accum(self, mesh8):
+        step_lib, loss_fn, tx, state, rng = self._setup()
+        x = rng.standard_normal((32, 6)).astype(np.float32)
+        y = rng.standard_normal((32, 2)).astype(np.float32)
+        step = step_lib.make_train_step(loss_fn, tx, mesh8, donate=False,
+                                        grad_reduce="adasum", accum_steps=2)
+        new_state, metrics = step(state, {"x": jnp.asarray(x),
+                                          "y": jnp.asarray(y)})
+        assert np.isfinite(np.asarray(new_state.params["w"])).all()
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_rejects_fusion_threshold(self, mesh8):
+        step_lib, loss_fn, tx, state, rng = self._setup()
+        with pytest.raises(ValueError, match="adasum"):
+            step_lib.make_train_step(loss_fn, tx, mesh8,
+                                     grad_reduce="adasum",
+                                     fusion_threshold=1 << 20)
+
+    def test_rejects_unknown_reduce(self, mesh8):
+        step_lib, loss_fn, tx, state, rng = self._setup()
+        with pytest.raises(ValueError, match="grad_reduce"):
+            step_lib.make_train_step(loss_fn, tx, mesh8, grad_reduce="nope")
+
+
 class TestUnitAxisMesh:
     """The single-device 'config 1' mode: a bound size-1 axis must come back
     vma-replicated from every op so out_specs=P() still compiles."""
@@ -243,6 +308,42 @@ class TestProcessSet:
         with pytest.raises(ValueError):
             hvd.ProcessSet([-1, 2])
         assert hvd.ProcessSet([3, 1, 3, 2]).ranks == (1, 2, 3)
+
+    def test_negative_rank_raises_at_collectives_level(self, mesh8):
+        # hvd.ProcessSet rejects negatives itself; the public collectives
+        # API must too, else the mean divisor silently over-counts.
+        def body(t):
+            return collectives.masked_allreduce(t, "data", [-1, 0, 1])
+
+        with pytest.raises(ValueError, match="out of range"):
+            jax.jit(jax.shard_map(body, mesh=mesh8, in_specs=P("data"),
+                                  out_specs=P("data")))(np.arange(8.0))
+
+    def test_broadcast_root_out_of_range_raises(self, mesh8):
+        # An unmatched root would psum to zeros on every replica —
+        # silent parameter corruption.
+        def body(t):
+            return collectives.broadcast(t, "data", root=8)
+
+        with pytest.raises(ValueError, match="out of range"):
+            jax.jit(jax.shard_map(body, mesh=mesh8, in_specs=P("data"),
+                                  out_specs=P()))(np.arange(8.0))
+
+    def test_distributed_optimizer_average_op_conflict(self):
+        import optax
+
+        with pytest.raises(ValueError, match="not both"):
+            hvd.DistributedOptimizer(optax.sgd(1.0), average=False,
+                                     op=hvd.Average)
+
+    def test_pp_rejects_adasum(self):
+        from tpuframe import train as train_lib
+        from tpuframe.utils import config as config_lib
+
+        cfg = config_lib.get_config("lm_pp_smoke").with_overrides(
+            grad_reduce="adasum")
+        with pytest.raises(ValueError, match="grad_reduce"):
+            train_lib.build_harness(cfg)
 
     def test_out_of_range_rank_raises(self, mesh8):
         # Rank 8 on an 8-replica axis never matches any index; without the

@@ -68,18 +68,7 @@ def allreduce(x: PyTree, axis: AxisName = "data", *, average: bool = True) -> Py
     so the same step function runs unmapped in config 1's single-process mode
     (SURVEY.md §7 build order step 1).
     """
-    bound = _bound_axes(axis)
-    if not bound:
-        return x
-    sized = _sized_axes(bound)
-    if not sized:
-        return jax.tree.map(lambda t: _clear_unit_axes(t, bound), x)
-    op = lax.pmean if average else lax.psum
-    # _vary_over: a leaf replicated along one sized axis but varying along
-    # another would otherwise present a mixed vma state psum rejects;
-    # counting it once per mesh position is Horovod's rank-space semantics.
-    return jax.tree.map(
-        lambda t: _clear_unit_axes(op(_vary_over(t, sized), sized), bound), x)
+    return _elementwise_reduce(x, axis, lax.pmean if average else lax.psum)
 
 
 def average_gradients(grads: PyTree, axis: AxisName = "data") -> PyTree:
@@ -226,6 +215,7 @@ def broadcast(x: PyTree, axis: AxisName = "data", *, root: int = 0) -> PyTree:
     sized = _sized_axes(bound)
     if not sized:
         return jax.tree.map(lambda t: _clear_unit_axes(t, bound), x)
+    _check_ranks(bound, (root,))  # an unmatched root would psum to zeros
     idx = _linear_index(bound)
 
     def _bcast(t):
@@ -460,15 +450,23 @@ def psum_scalar(value: float | jax.Array, axis: AxisName = "data") -> jax.Array:
 
 def reduce_min(x: PyTree, axis: AxisName = "data") -> PyTree:
     """Elementwise cross-replica minimum (Horovod ``op=hvd.Min``)."""
-    return _minmax_reduce(x, axis, lax.pmin)
+    return _elementwise_reduce(x, axis, lax.pmin)
 
 
 def reduce_max(x: PyTree, axis: AxisName = "data") -> PyTree:
     """Elementwise cross-replica maximum (Horovod ``op=hvd.Max``)."""
-    return _minmax_reduce(x, axis, lax.pmax)
+    return _elementwise_reduce(x, axis, lax.pmax)
 
 
-def _minmax_reduce(x: PyTree, axis: AxisName, op) -> PyTree:
+def _elementwise_reduce(x: PyTree, axis: AxisName, op) -> PyTree:
+    """Shared guard chain for psum/pmean/pmin/pmax-style reductions.
+
+    ``_vary_over``: a leaf replicated along one sized axis but varying along
+    another would otherwise present a mixed vma state the collective
+    rejects; counting it once per mesh position is Horovod's rank-space
+    semantics.  ``_clear_unit_axes``: outputs come back replicated over the
+    size-1 bound axes too, preserving callers' out_specs expectations.
+    """
     bound = _bound_axes(axis)
     if not bound:
         return x
@@ -591,15 +589,15 @@ def _member_mask(bound: tuple[str, ...], ranks: Sequence[int]) -> jax.Array:
 
 def _check_ranks(bound: tuple[str, ...], ranks: Sequence[int]) -> None:
     """Trace-time validation: every rank must exist in the linearized rank
-    space, else masked collectives silently drop contributions (an
-    out-of-range rank never matches any replica's index) — Horovod raises
-    for invalid ranks too."""
+    space, else masked/rooted collectives silently drop contributions (an
+    out-of-range or negative rank never matches any replica's index) —
+    Horovod raises for invalid ranks too."""
     world = 1
     for a in _sized_axes(bound):
         world *= lax.axis_size(a)
-    bad = [int(r) for r in ranks if int(r) >= world]
+    bad = [int(r) for r in ranks if int(r) >= world or int(r) < 0]
     if bad:
-        raise ValueError(f"process-set ranks {bad} out of range for a "
+        raise ValueError(f"ranks {bad} out of range for a "
                          f"{world}-replica axis {bound}")
 
 

@@ -181,7 +181,7 @@ def DistributedOptimizer(
     tx: optax.GradientTransformation,
     *,
     axis=_DEFAULT_AXIS,
-    average: bool = True,
+    average: bool | None = None,
     compression: str | None = None,
     op: _ReduceOp | None = None,
 ) -> optax.GradientTransformation:
@@ -204,14 +204,18 @@ def DistributedOptimizer(
     Adasum's combine is norm-based, so wire compression is disallowed with
     it (as in Horovod, where Adasum + fp16 compression is unsupported).
     """
-    if op is not None and op not in (Average, Sum, Adasum):
+    if average is not None and op is not None:
+        raise ValueError("specify either average= or op=, not both "
+                         "(same contract as hvd.allreduce)")
+    if op is None:
+        op = Sum if average is False else Average
+    if op not in (Average, Sum, Adasum):
         raise ValueError(f"DistributedOptimizer supports Average/Sum/Adasum, "
                          f"got {op!r}")
     if op is Adasum and compression is not None:
         raise ValueError("Adasum's norm-based combine does not compose with "
                          "wire compression")
-    if op is not None:
-        average = op is Average
+    average = op is Average
 
     def init_fn(params):
         return _DistState(inner=tx.init(params))

@@ -64,6 +64,7 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
                axes: tuple[str, ...] | None,
                fusion_threshold: int | None,
                accum_steps: int,
+               grad_reduce: str,
                state: TrainState, batch: PyTree):
     """Shared body for both modes. ``axes`` bound ⇒ explicit collectives."""
     step_rng = jax.random.fold_in(state.rng, state.step)
@@ -74,7 +75,8 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
 
     if accum_steps > 1:
         return _accum_grad_step(loss_fn, tx, axes, fusion_threshold,
-                                accum_steps, state, batch, step_rng)
+                                accum_steps, grad_reduce, state, batch,
+                                step_rng)
 
     # The reference's raison d'être: synchronous gradient averaging.
     # Horovod: per-tensor async NCCL ring-allreduce with fusion buffer.
@@ -93,7 +95,11 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     # (tpuframe.parallel.fusion) perform the only cross-replica averaging —
     # one psum per ≤threshold-byte bucket, 0 → one per leaf.  Same math
     # (psum is linear); observable in the compiled HLO's all-reduce count.
-    explicit = bool(axes) and fusion_threshold is not None
+    # ``grad_reduce="adasum"`` also needs LOCAL per-replica grads — the
+    # adaptive combine is computed from them, so the implicit
+    # pmean-of-loss transpose (which pre-averages) cannot be used.
+    explicit = bool(axes) and (fusion_threshold is not None
+                               or grad_reduce == "adasum")
     diff_params = state.params
     if explicit:
         diff_params = jax.tree.map(
@@ -108,20 +114,24 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     (loss, (model_state, metrics)), grads = jax.value_and_grad(
         global_loss, has_aux=True)(diff_params, state.model_state, batch, step_rng)
 
-    return _reduce_and_apply(tx, axes, fusion_threshold, state,
+    return _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, state,
                              grads, loss, metrics, model_state,
                              reduce_grads=explicit)
 
 
-def _reduce_and_apply(tx, axes, fusion_threshold, state, grads, loss,
-                      metrics, model_state, *, reduce_grads: bool):
+def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, state, grads,
+                      loss, metrics, model_state, *, reduce_grads: bool):
     """Shared step tail: cross-replica reductions + optimizer update.
 
     ``reduce_grads``: True when ``grads``/``loss`` are still per-replica
-    (explicit-fusion and accumulation paths); False when the pmean-of-loss
-    transpose already reduced them (the implicit default)."""
+    (explicit-fusion, adasum and accumulation paths); False when the
+    pmean-of-loss transpose already reduced them (the implicit default)."""
     if reduce_grads and axes:
-        if fusion_threshold is not None:
+        if grad_reduce == "adasum":
+            from tpuframe.parallel import collectives
+
+            grads = collectives.adasum(grads, axes)
+        elif fusion_threshold is not None:
             from tpuframe.parallel import fusion
 
             grads = fusion.fused_pmean(grads, axes,
@@ -151,7 +161,7 @@ def _reduce_and_apply(tx, axes, fusion_threshold, state, grads, loss,
 
 
 def _accum_grad_step(loss_fn, tx, axes, fusion_threshold, accum_steps,
-                     state, batch, step_rng):
+                     grad_reduce, state, batch, step_rng):
     """Gradient accumulation — Horovod's ``backward_passes_per_step``
     (DistributedOptimizer option; the reference's recipe for batches that
     exceed device memory).  The local batch is split into ``accum_steps``
@@ -215,7 +225,7 @@ def _accum_grad_step(loss_fn, tx, axes, fusion_threshold, accum_steps,
     loss = loss / accum_steps
     metrics = jax.tree.map(lambda m: m / accum_steps, metrics)
 
-    return _reduce_and_apply(tx, axes, fusion_threshold, state,
+    return _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, state,
                              grads, loss, metrics, model_state,
                              reduce_grads=True)
 
@@ -232,8 +242,18 @@ def make_train_step(
     state_shardings: PyTree | None = None,
     fusion_threshold: int | None = None,
     accum_steps: int = 1,
+    grad_reduce: str = "mean",
 ):
     """Build the compiled train step.
+
+    ``grad_reduce``: ``"mean"`` (default — Horovod's averaged allreduce) or
+    ``"adasum"`` (adaptive summation, Horovod's ``op=hvd.Adasum``): local
+    per-replica gradients are combined with the scale-insensitive ppermute
+    butterfly (tpuframe.parallel.collectives.adasum) instead of averaged.
+    With adasum, keep ``scale_lr_by_batch`` off — removing the LR-by-size
+    rule is the op's purpose.  shard_map mode only; composes with
+    ``accum_steps`` (local f32 accumulation, one adasum at the end) but not
+    with ``fusion_threshold`` (the butterfly is its own wire pattern).
 
     ``fusion_threshold``: byte size of the explicit gradient-fusion buffers
     (HOROVOD_FUSION_THRESHOLD parity, tpuframe.parallel.fusion); ``None``
@@ -267,9 +287,17 @@ def make_train_step(
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if grad_reduce not in ("mean", "adasum"):
+        raise ValueError(f"grad_reduce must be 'mean' or 'adasum', "
+                         f"got {grad_reduce!r}")
+    if grad_reduce == "adasum" and fusion_threshold is not None:
+        raise ValueError("grad_reduce='adasum' does not compose with "
+                         "fusion_threshold — the butterfly is its own wire "
+                         "pattern")
     if mesh is None:
+        # World of 1: adasum degrades to identity like every collective.
         body = functools.partial(_grad_step, loss_fn, tx, None, None,
-                                 accum_steps)
+                                 accum_steps, "mean")
         return jax.jit(body, donate_argnums=(0,) if donate else ())
 
     # Reduce over every batch-like axis, including size-1 ones: a size-1 pmean
@@ -291,9 +319,12 @@ def make_train_step(
         repl = NamedSharding(any_leaf.mesh, P())
         batch_sh = NamedSharding(any_leaf.mesh, batch_part)
     if mode == "jit":
+        if grad_reduce != "mean":
+            raise ValueError("grad_reduce='adasum' needs shard_map mode — "
+                             "auto-SPMD has no per-replica grads to combine")
         # Auto-SPMD: annotate shardings, let the partitioner insert collectives.
         body = functools.partial(_grad_step, loss_fn, tx, None, None,
-                                 accum_steps)
+                                 accum_steps, "mean")
         state_sh = repl if state_shardings is None else state_shardings
         return jax.jit(
             body,
@@ -306,7 +337,7 @@ def make_train_step(
         raise ValueError(f"unknown step mode {mode!r}")
 
     body = functools.partial(_grad_step, loss_fn, tx, axes, fusion_threshold,
-                             accum_steps)
+                             accum_steps, grad_reduce)
     mapped = jax.shard_map(
         body, mesh=mesh,
         in_specs=(P(), batch_part),

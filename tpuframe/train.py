@@ -212,6 +212,10 @@ def build_harness(cfg: TrainConfig) -> Harness:
         if cfg.accum_steps != 1:
             raise ValueError("pipe parallelism has its own microbatching "
                              "(pp_microbatches); accum_steps must be 1")
+        if cfg.grad_reduce != "mean":
+            raise ValueError("pipe parallelism supports grad_reduce='mean' "
+                             "only (the pp step has its own cross-stage "
+                             "reduction)")
         if cfg.shard_seq:
             raise ValueError("pipe parallelism does not compose with "
                              "shard_seq sequence parallelism yet")
@@ -248,7 +252,8 @@ def build_harness(cfg: TrainConfig) -> Harness:
             loss_fn, tx, mesh, batch_partition=step_part,
             reduce_axes=reduce_axes, state_shardings=state_shardings,
             fusion_threshold=tuning.step_threshold(),
-            accum_steps=cfg.accum_steps)
+            accum_steps=cfg.accum_steps,
+            grad_reduce=cfg.grad_reduce)
         eval_step = step_lib.make_eval_step(
             make_metric_fn(cfg, model), mesh, batch_partition=step_part,
             reduce_axes=reduce_axes, state_shardings=state_shardings)
@@ -298,15 +303,16 @@ def _lm_reduce_axis(cfg: TrainConfig, *, for_grad: bool):
                      or cfg.mesh.expert > 1)
     shard_map_mode = cfg.distributed and not sharded_state
     explicit = shard_map_mode and (tuning.step_threshold() is not None
-                                   or cfg.accum_steps > 1)
+                                   or cfg.accum_steps > 1
+                                   or cfg.grad_reduce == "adasum")
     if not explicit:
         return axes
     if bool(cfg.dataset_kwargs.get("padded_docs")):
         raise ValueError(
-            "padded_docs with TPUFRAME_FUSION_THRESHOLD or accum_steps>1 "
-            "in shard_map mode: these paths need a local loss, and a "
-            "per-shard valid-token mean would be biased by unequal "
-            "padding across shards")
+            "padded_docs with TPUFRAME_FUSION_THRESHOLD, accum_steps>1 or "
+            "grad_reduce='adasum' in shard_map mode: these paths need a "
+            "local loss, and a per-shard valid-token mean would be biased "
+            "by unequal padding across shards")
     return None  # local loss; no -100 labels, so per-shard mean is exact
 
 

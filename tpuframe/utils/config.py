@@ -49,6 +49,10 @@ class TrainConfig:
     # Gradient accumulation (Horovod's backward_passes_per_step): microbatch
     # count per optimizer step; global_batch is split by this on-device.
     accum_steps: int = 1
+    # Cross-replica gradient combine: "mean" (Horovod's averaged allreduce)
+    # or "adasum" (op=hvd.Adasum — scale-insensitive adaptive summation;
+    # pair it with scale_lr_by_batch=False, which is its purpose).
+    grad_reduce: str = "mean"
     # GPipe microbatches per step when the mesh's pipe axis > 1
     # (model='transformer-lm-pp'; tpuframe.parallel.pp_lm).
     pp_microbatches: int = 4
