@@ -69,6 +69,13 @@ queue_run() { # name timeout cmd...  (expects caller-defined note() + $LOG)
     note "STOP sentinel present; skipping $name and exiting"
     exit 0
   fi
+  # Preserve a prior result before the redirect truncates it: a rerun
+  # that hangs on a dead relay must not destroy the only committed
+  # measurement (this happened to round-3's bench_b256.out on
+  # 2026-07-31; restored from git).
+  if [ -s "perf/results/$name.out" ]; then
+    cp "perf/results/$name.out" "perf/results/$name.out.prev"
+  fi
   note "START $name"
   timeout "$tmo" "$@" > "perf/results/$name.out" 2> "perf/results/$name.err"
   local rc=$?
@@ -86,8 +93,18 @@ queue_run() { # name timeout cmd...  (expects caller-defined note() + $LOG)
     fi
     note "chip re-claimed — retrying $name once"
     timeout "$tmo" "$@" > "perf/results/$name.out" 2> "perf/results/$name.err"
-    note "END $name (retry) rc=$?"
+    rc=$?
+    note "END $name (retry) rc=$rc"
   fi
+  # Failed final attempt (even with partial output): put the preserved
+  # result back so the artifact always carries the best available
+  # measurement.  .prev is transient — deleted on both paths, so a stale
+  # backup can never masquerade as a later round's data.
+  if [ "$rc" != 0 ] && [ -s "perf/results/$name.out.prev" ]; then
+    note "restoring prior $name.out (final rc=$rc)"
+    cp "perf/results/$name.out.prev" "perf/results/$name.out"
+  fi
+  rm -f "perf/results/$name.out.prev"
 }
 
 claim_wait_for_others() {
