@@ -208,10 +208,13 @@ def run(batch_per_chip: int, warmup: int, measure: int) -> float:
     # reformulation (models/resnet.py; exact-function-preserving).
     # TPUFRAME_BENCH_REMAT=1 A/Bs per-block rematerialization (trades idle
     # MXU flops for HBM bytes on the bandwidth-bound step).
+    # TPUFRAME_BENCH_BN=folded A/Bs the census-driven BN whose
+    # activation-sized math stays bf16 (models/folded_bn.py; PERF.md §7).
     stem = os.environ.get("TPUFRAME_BENCH_STEM", "conv")
     remat = os.environ.get("TPUFRAME_BENCH_REMAT", "0") == "1"
+    bn = os.environ.get("TPUFRAME_BENCH_BN", "flax")
     model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem,
-                            remat=remat)
+                            remat=remat, bn=bn)
     rng = np.random.default_rng(0)
     # bf16 on the host: halves infeed bytes and skips the on-device cast.
     x = rng.normal(0.5, 0.25, size=(global_batch, IMAGE_SIZE, IMAGE_SIZE, 3)

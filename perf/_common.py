@@ -88,3 +88,58 @@ def timeit_chain(make_chain, *args, chain: int = 16, reps: int = 3,
                 f"(chain times {[round(t * 1e3, 1) for t in t_n]} ms vs "
                 f"chain1 {[round(t * 1e3, 1) for t in t_1]} ms)")
         n = min(n * 4, max_chain)
+
+
+# ---------------------------------------------------------------------------
+# HLO text census (shared by exp_hlo_dump [on-chip] and exp_hlo_offline
+# [AOT topology compile] so the two censuses can only disagree for
+# compiler reasons, never tooling drift)
+# ---------------------------------------------------------------------------
+
+def hlo_shape_census(txt: str):
+    """Group HLO tensor mentions by dtype/shape/layout, largest total
+    padded bytes first.  TPU layouts look like
+    ``bf16[512,112,112,64]{3,2,1,0:T(8,128)(2,1)}``."""
+    import re
+
+    shapes = re.findall(r"(bf16|f32|s32|u8|pred)\[([0-9,]*)\]\{([^}]*)\}", txt)
+    census: dict = {}
+    for dt, dims, layout in shapes:
+        key = f"{dt}[{dims}]{{{layout}}}"
+        census[key] = census.get(key, 0) + 1
+    return sorted(census.items(), key=lambda kv: -hlo_nbytes(kv[0]) * kv[1])
+
+
+def hlo_nbytes(key: str) -> float:
+    """Padded-byte estimate for one census key: the layout's minor dim
+    rounds to 128 lanes, the next-minor to 8 sublanes (the (8,128) tile;
+    bf16's (2,1) sublane packing does not change the 8-row estimate)."""
+    import re
+
+    m = re.match(r"(bf16|f32|s32|u8|pred)\[([0-9,]*)\]\{([^:}]*)", key)
+    if not m:
+        return 0.0
+    dt, dims, perm = m.groups()
+    if not dims:
+        return 0.0
+    sz = {"bf16": 2, "f32": 4, "s32": 4, "u8": 1, "pred": 1}[dt]
+    parts = [int(d) for d in dims.split(",") if d]
+    if not parts:
+        return 0.0
+    try:
+        mtm = [int(p) for p in perm.split(",") if p.strip() != ""]
+    except ValueError:
+        mtm = []
+    if len(mtm) != len(parts):
+        mtm = list(range(len(parts) - 1, -1, -1))
+    padded = list(parts)
+    if mtm:
+        minor = mtm[0]
+        padded[minor] = (padded[minor] + 127) // 128 * 128
+        if len(mtm) > 1:
+            nxt = mtm[1]
+            padded[nxt] = (padded[nxt] + 7) // 8 * 8
+    n = 1.0
+    for d in padded:
+        n *= d
+    return n * sz

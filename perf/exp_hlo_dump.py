@@ -82,51 +82,13 @@ def main():
         f.write(txt)
     log(f"wrote {out_path} ({len(txt)/1e6:.1f} MB)")
 
-    # Quick shape census: total padded vs logical bytes per dtype-shape.
-    # TPU layouts appear as e.g. bf16[512,112,112,64]{3,2,1,0:T(8,128)(2,1)}.
-    shapes = re.findall(r"(bf16|f32|s32|pred)\[([0-9,]*)\]\{([^}]*)\}", txt)
-    census: dict = {}
-    for dt, dims, layout in shapes:
-        key = f"{dt}[{dims}]{{{layout}}}"
-        census[key] = census.get(key, 0) + 1
-    big = sorted(census.items(),
-                 key=lambda kv: -_nbytes(kv[0]) * kv[1])[:25]
+    # Quick shape census: total padded vs logical bytes per dtype-shape
+    # (helpers shared with exp_hlo_offline via _common).
+    from _common import hlo_shape_census, hlo_nbytes
+
     log("top shapes by total bytes (count x padded-est):")
-    for k, n in big:
-        log(f"  {n:5d} x {k}  ~{_nbytes(k)/1e6:.1f} MB each")
-
-
-def _nbytes(key: str) -> float:
-    m = re.match(r"(bf16|f32|s32|pred)\[([0-9,]*)\]\{([^:}]*)", key)
-    if not m:
-        return 0.0
-    dt, dims, perm = m.groups()
-    if not dims:
-        return 0.0
-    sz = {"bf16": 2, "f32": 4, "s32": 4, "pred": 1}[dt]
-    parts = [int(d) for d in dims.split(",") if d]
-    if not parts:
-        return 0.0
-    # The layout's minor-to-major list says which LOGICAL dim is physically
-    # minor — that dim gets the 128-lane rounding, the next-minor the
-    # 8-sublane rounding.  Falling back to logical order when unparsable.
-    try:
-        mtm = [int(p) for p in perm.split(",") if p.strip() != ""]
-    except ValueError:
-        mtm = []
-    if len(mtm) != len(parts):
-        mtm = list(range(len(parts) - 1, -1, -1))
-    padded = list(parts)
-    if mtm:
-        minor = mtm[0]
-        padded[minor] = (padded[minor] + 127) // 128 * 128
-        if len(mtm) > 1:
-            nxt = mtm[1]
-            padded[nxt] = (padded[nxt] + 7) // 8 * 8
-    n = 1.0
-    for d in padded:
-        n *= d
-    return n * sz
+    for k, n in hlo_shape_census(txt)[:25]:
+        log(f"  {n:5d} x {k}  ~{hlo_nbytes(k)/1e6:.1f} MB each")
 
 
 if __name__ == "__main__":

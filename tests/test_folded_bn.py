@@ -1,0 +1,180 @@
+"""FoldedBatchNorm parity vs nn.BatchNorm (tpuframe/models/folded_bn.py).
+
+The census-driven BN must be a numerical drop-in: identical statistics,
+identical running-stat updates, and f32 outputs matching flax's to float
+tolerance.  In bf16 the activation-sized math deliberately rounds the
+per-channel affine before the FMA — bounded by bf16 eps — which is the
+entire point (no f32 activation-sized values in the compiled step).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuframe.models.folded_bn import FoldedBatchNorm
+
+
+def _pair(dtype):
+    ref = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                       epsilon=1e-5, dtype=dtype, param_dtype=jnp.float32)
+    fold = FoldedBatchNorm(use_running_average=False, momentum=0.9,
+                           epsilon=1e-5, dtype=dtype,
+                           param_dtype=jnp.float32)
+    return ref, fold
+
+
+def _random_variables(rng, c):
+    # Non-trivial scale/bias/running stats so the affine actually matters.
+    return {
+        "params": {"scale": jnp.asarray(rng.uniform(0.5, 2.0, c), jnp.float32),
+                   "bias": jnp.asarray(rng.normal(0, 1, c), jnp.float32)},
+        "batch_stats": {"mean": jnp.asarray(rng.normal(0, 1, c), jnp.float32),
+                        "var": jnp.asarray(rng.uniform(0.5, 2, c), jnp.float32)},
+    }
+
+
+class TestParity:
+    def test_f32_train_output_and_stats(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(3.0, 2.0, (8, 6, 6, 16)), jnp.float32)
+        ref, fold = _pair(jnp.float32)
+        v = _random_variables(rng, 16)
+        y_ref, m_ref = ref.apply(v, x, mutable=["batch_stats"])
+        y_fold, m_fold = fold.apply(v, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(y_fold), np.asarray(y_ref),
+                                   rtol=2e-5, atol=2e-5)
+        for k in ("mean", "var"):
+            np.testing.assert_allclose(
+                np.asarray(m_fold["batch_stats"][k]),
+                np.asarray(m_ref["batch_stats"][k]), rtol=1e-5, atol=1e-6)
+
+    def test_f32_eval_output(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(0, 1, (4, 5, 5, 8)), jnp.float32)
+        v = _random_variables(rng, 8)
+        ref = nn.BatchNorm(use_running_average=True, epsilon=1e-5,
+                           dtype=jnp.float32)
+        fold = FoldedBatchNorm(use_running_average=True, epsilon=1e-5,
+                               dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(fold.apply(v, x)),
+                                   np.asarray(ref.apply(v, x)),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bf16_close_to_f32_reference(self):
+        # The bf16 path rounds the per-channel affine once; the output must
+        # stay within bf16-eps-class distance of the exact f32 result.
+        rng = np.random.default_rng(2)
+        x32 = jnp.asarray(rng.normal(1.0, 2.0, (8, 4, 4, 32)), jnp.float32)
+        v = _random_variables(rng, 32)
+        ref = nn.BatchNorm(use_running_average=False, epsilon=1e-5,
+                           dtype=jnp.float32)
+        y_exact, _ = ref.apply(v, x32, mutable=["batch_stats"])
+        fold = FoldedBatchNorm(use_running_average=False, epsilon=1e-5,
+                               dtype=jnp.bfloat16)
+        y_b, _ = fold.apply(v, x32.astype(jnp.bfloat16),
+                            mutable=["batch_stats"])
+        err = np.abs(np.asarray(y_b, np.float32) - np.asarray(y_exact))
+        scale = np.abs(np.asarray(y_exact)).max()
+        assert err.max() <= 0.03 * max(scale, 1.0), err.max()
+
+    def test_large_mean_small_std_channel(self):
+        # The cancellation regime: |mean| >> std.  The statistics must be
+        # computed from the f32-CONVERTED input: squaring in bf16 first
+        # makes E[x^2]-E[x]^2 quantization noise (x~50 has bf16 step
+        # ~0.2 >> std 0.05), collapsing the variance toward the eps clamp.
+        # The exact property: folded's batch variance equals the f64
+        # variance OF THE bf16-QUANTIZED INPUT (input rounding is
+        # unavoidable; destroying the remaining signal in the square is
+        # the bug this pins).
+        rng = np.random.default_rng(5)
+        x32 = rng.normal(50.0, 0.05, (64, 4, 4, 8)).astype(np.float32)
+        xb = jnp.asarray(x32, jnp.bfloat16)
+        fold = FoldedBatchNorm(use_running_average=False, epsilon=1e-5,
+                               momentum=0.9, dtype=jnp.bfloat16)
+        v = fold.init(jax.random.key(0), xb)
+        _, m = fold.apply(v, xb, mutable=["batch_stats"])
+        # init stats are mean=0/var=1; one update mixes with momentum 0.9.
+        var = (np.asarray(m["batch_stats"]["var"], np.float64) - 0.9) / 0.1
+        # Parity target is FLAX on the same input: f32 E[x^2]-E[x]^2 at
+        # |mean|~50 carries ~f32-eps*mean^2 noise for both modules alike;
+        # the bf16-squaring bug this pins loses the signal entirely.
+        ref = nn.BatchNorm(use_running_average=False, epsilon=1e-5,
+                           momentum=0.9, dtype=jnp.bfloat16)
+        _, mr = ref.apply(v, xb, mutable=["batch_stats"])
+        want = (np.asarray(mr["batch_stats"]["var"], np.float64) - 0.9) / 0.1
+        np.testing.assert_allclose(var, want, rtol=1e-3, atol=1e-6)
+        assert (var > 1e-4).all()  # not collapsed to the eps clamp
+
+    def test_init_variable_layout_matches_flax(self):
+        x = jnp.zeros((2, 4, 4, 8), jnp.float32)
+        ref, fold = _pair(jnp.float32)
+        vr = ref.init(jax.random.key(0), x)
+        vf = fold.init(jax.random.key(0), x)
+        assert jax.tree.map(jnp.shape, vf) == jax.tree.map(jnp.shape, vr)
+
+    def test_f32_activation_values_reduced_in_bf16_graph(self):
+        # The module's reason to exist: the bf16 apply's only
+        # activation-shaped f32 values are the two stats-reduction converts
+        # (which XLA fuses into the reduces — no HBM materialization; the
+        # offline AOT census is the byte-level proof), while nn.BatchNorm
+        # runs the whole normalize chain in f32.
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(0, 1, (16, 8, 8, 32)), jnp.bfloat16)
+
+        def f32_activation_eqns(mod):
+            v = mod.init(jax.random.key(0), x)
+            jaxpr = jax.make_jaxpr(
+                lambda vv, xx: mod.apply(vv, xx, mutable=["batch_stats"]))(v, x)
+            out = []
+            for eqn in jaxpr.jaxpr.eqns:
+                for var in eqn.outvars:
+                    aval = var.aval
+                    if (getattr(aval, "dtype", None) == jnp.float32
+                            and getattr(aval, "ndim", 0) == 4
+                            and aval.shape[0] == 16):
+                        out.append(eqn.primitive.name)
+            return out
+
+        fold = f32_activation_eqns(
+            FoldedBatchNorm(use_running_average=False, dtype=jnp.bfloat16))
+        ref = f32_activation_eqns(
+            nn.BatchNorm(use_running_average=False, dtype=jnp.bfloat16))
+        # Only the stats-chain values (convert + square, both feeding the
+        # reduces) — no f32 normalize arithmetic.
+        assert set(fold) <= {"convert_element_type", "square",
+                             "integer_pow"}, fold
+        assert len(fold) <= 3
+        assert len(ref) > len(fold)  # the census finding
+
+
+class TestInResNet:
+    def test_resnet18_forward_backward_folded(self):
+        from tpuframe import models
+        from tpuframe.models import losses
+
+        model = models.ResNet18(num_classes=10, bn="folded",
+                                dtype=jnp.bfloat16)
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.normal(0, 1, (4, 32, 32, 3)), jnp.bfloat16)
+        y = jnp.asarray(rng.integers(0, 10, 4), jnp.int32)
+        v = model.init(jax.random.key(0), x)
+
+        def loss_fn(params):
+            logits, mut = model.apply({"params": params,
+                                       "batch_stats": v["batch_stats"]},
+                                      x, train=True, mutable=["batch_stats"])
+            return losses.softmax_cross_entropy(logits, y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(v["params"])
+        assert np.isfinite(float(loss))
+        gn = sum(float(jnp.sum(jnp.abs(g))) for g in jax.tree.leaves(grads))
+        assert np.isfinite(gn) and gn > 0
+
+    def test_bad_bn_name_raises(self):
+        from tpuframe import models
+
+        with pytest.raises(ValueError, match="unknown bn"):
+            models.ResNet18(bn="nope").init(
+                jax.random.key(0), jnp.zeros((1, 32, 32, 3)))

@@ -1,0 +1,96 @@
+"""Folded BatchNorm — the byte-census-driven BN (PERF.md §6/§7).
+
+The offline HLO census (`perf/exp_hlo_offline.py`, v5e AOT compile) showed
+that with ``nn.BatchNorm(dtype=bfloat16)`` the normalize chain still runs
+in float32: flax upcasts the ACTIVATION for ``(x - mean) * rsqrt(var+eps)
+* gamma + beta``, so the compiled step is dominated by f32
+activation-sized converts/multiplies/adds (74% of activation-sized HLO
+values) — on a bandwidth-bound step (81% of the HBM roofline,
+PERF.md §2) every f32 materialization costs 2x the bytes of bf16.
+
+This module keeps every NUMERICALLY DELICATE quantity in f32 — the
+mean/variance reductions (f32 accumulation via ``jnp.mean(..., dtype)``,
+which XLA fuses into the reduce, no f32 activation materializes), the
+running statistics, and the derivation of the per-channel affine — but
+folds the normalization into exactly one activation-sized FMA in the
+compute dtype:
+
+    a = gamma * rsqrt(var + eps)        # f32, C-sized
+    b = beta - mean * a                 # f32, C-sized
+    y = x * a.astype(x.dtype) + b.astype(x.dtype)   # bf16, one pass
+
+Difference vs ``nn.BatchNorm``: ``a``/``b`` are rounded to bf16 BEFORE
+the activation math instead of after — one extra rounding of a per-channel
+scalar, bounded by bf16 eps (~0.4%), with the activation-sized math
+otherwise identical (parity pinned by tests/test_folded_bn.py; the f32
+path agrees with ``nn.BatchNorm`` to 1e-5).
+
+Interface parity with ``nn.BatchNorm``: same ``batch_stats`` collection
+with ``mean``/``var`` entries and same param names (``scale``/``bias``).
+(Flax auto-names modules by class — ``FoldedBatchNorm_N`` vs
+``BatchNorm_N`` — so a whole-model checkpoint still re-keys when the BN
+implementation is toggled; the per-module variable layout matches.)
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class FoldedBatchNorm(nn.Module):
+    """Drop-in BatchNorm whose activation-sized math stays in ``dtype``.
+
+    Supports the feature subset the model zoo uses: last-axis features,
+    scale+bias on, zeros/ones initializers.
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: jnp.dtype | None = None
+    param_dtype: jnp.dtype = jnp.float32
+    scale_init: nn.initializers.Initializer = nn.initializers.ones
+    bias_init: nn.initializers.Initializer = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool | None = None):
+        use_avg = nn.merge_param("use_running_average",
+                                 self.use_running_average,
+                                 use_running_average)
+        features = x.shape[-1]
+        scale = self.param("scale", self.scale_init, (features,),
+                           self.param_dtype)
+        bias = self.param("bias", self.bias_init, (features,),
+                          self.param_dtype)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((features,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((features,), jnp.float32))
+
+        if use_avg:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            # f32 ACCUMULATION without f32 materialization: the convert
+            # and square feed straight into the reduces, so XLA fuses the
+            # whole chain into one pass that reads the bf16 activation
+            # once — only C-sized f32 lands in HBM.  The square must be
+            # taken AFTER the f32 convert: squaring in bf16 first would
+            # make E[x^2]-E[x]^2 catastrophically cancellative for
+            # channels with |mean| >> std (bf16's ~2^-9 relative error on
+            # x^2 swamps a small variance).
+            axes = tuple(range(x.ndim - 1))
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            mean2 = jnp.mean(jnp.square(xf), axis=axes)
+            var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+
+        a = scale.astype(jnp.float32) * jax.lax.rsqrt(var + self.epsilon)
+        b = bias.astype(jnp.float32) - mean * a
+        out_dtype = self.dtype or x.dtype
+        return x.astype(out_dtype) * a.astype(out_dtype) + b.astype(out_dtype)

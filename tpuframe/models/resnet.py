@@ -118,15 +118,32 @@ class ResNet(nn.Module):
     # ~29% busy) this trades idle MXU flops for HBM bytes — A/B'd on-chip
     # via TPUFRAME_BENCH_REMAT.
     remat: bool = False
+    # "flax" = nn.BatchNorm; "folded" = FoldedBatchNorm, whose
+    # activation-sized normalize math runs in the compute dtype instead of
+    # f32 (the offline HLO census found 74% of activation-sized values in
+    # f32 from the flax BN chain — PERF.md §7).  NOTE: flax auto-naming
+    # keys modules by class (BatchNorm_N vs FoldedBatchNorm_N), so
+    # toggling re-keys the param tree — pick per run, like `remat`.
+    bn: str = "flax"
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
                        kernel_init=nn.initializers.variance_scaling(
                            2.0, "fan_out", "normal"))
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
-                       param_dtype=jnp.float32)
+        if self.bn == "folded":
+            from tpuframe.models.folded_bn import FoldedBatchNorm
+
+            norm = partial(FoldedBatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                           param_dtype=jnp.float32)
+        elif self.bn == "flax":
+            norm = partial(nn.BatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                           param_dtype=jnp.float32)
+        else:
+            raise ValueError(f"unknown bn {self.bn!r}; "
+                             f"expected 'flax' or 'folded'")
 
         if self.stem not in ("conv", "space_to_depth"):
             raise ValueError(f"unknown stem {self.stem!r}; "
@@ -165,17 +182,18 @@ class ResNet(nn.Module):
 
 
 def ResNet18(num_classes: int = 10, *, cifar_stem: bool = True,
-             dtype: jnp.dtype = jnp.float32, remat: bool = False) -> ResNet:
+             dtype: jnp.dtype = jnp.float32, remat: bool = False,
+             bn: str = "flax") -> ResNet:
     """Config 2 default: ResNet-18 with the CIFAR stem ([B:8])."""
     return ResNet(stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock,
                   num_classes=num_classes, cifar_stem=cifar_stem, dtype=dtype,
-                  remat=remat)
+                  remat=remat, bn=bn)
 
 
 def ResNet50(num_classes: int = 1000, *, cifar_stem: bool = False,
              dtype: jnp.dtype = jnp.float32, stem: str = "conv",
-             remat: bool = False) -> ResNet:
+             remat: bool = False, bn: str = "flax") -> ResNet:
     """Configs 3/5: ResNet-50 v1.5 for ImageNet ([B:9][B:11])."""
     return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck,
                   num_classes=num_classes, cifar_stem=cifar_stem, dtype=dtype,
-                  stem=stem, remat=remat)
+                  stem=stem, remat=remat, bn=bn)
