@@ -143,3 +143,32 @@ def hlo_nbytes(key: str) -> float:
     for d in padded:
         n *= d
     return n * sz
+
+
+def ensure_cpu_backend():
+    """Re-exec the current script on the plain CPU backend when the axon
+    TPU plugin would otherwise register (it self-registers whenever
+    PALLAS_AXON_POOL_IPS is set, even with JAX_PLATFORMS unset) — the
+    offline AOT-census scripts must never touch the relay.  Call BEFORE
+    importing jax."""
+    import os
+    import sys
+
+    os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    if (os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu")
+            or os.environ.get("PALLAS_AXON_POOL_IPS", "")):
+        print("re-exec without axon platform...", flush=True)
+        os.environ.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        os.execvpe(sys.executable, [sys.executable] + sys.argv, os.environ)
+
+
+def to_shape_structs(tree, sharding):
+    """Map a pytree of shaped values (arrays or ShapeDtypeStructs, e.g.
+    from jax.eval_shape) to sharding-annotated ShapeDtypeStructs for AOT
+    lowering against a compile-only topology."""
+    import jax
+
+    return jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding)
+        if hasattr(s, "shape") else s, tree,
+        is_leaf=lambda l: isinstance(l, jax.ShapeDtypeStruct))

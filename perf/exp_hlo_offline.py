@@ -23,16 +23,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# Must run on the plain CPU backend with local (not remote) compile: the
-# axon PJRT plugin registers itself whenever PALLAS_AXON_POOL_IPS is set,
-# even with JAX_PLATFORMS unset — and would route this census to the very
-# relay it exists to avoid.
-os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
-if (os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu")
-        or os.environ.get("PALLAS_AXON_POOL_IPS", "")):
-    print("re-exec without axon platform...", flush=True)
-    os.environ.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
-    os.execvpe(sys.executable, [sys.executable] + sys.argv, os.environ)
+from _common import ensure_cpu_backend, to_shape_structs  # noqa: E402
+
+ensure_cpu_backend()
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
@@ -94,16 +87,10 @@ def main():
         variables)
     train_step = step_lib.make_train_step(loss_fn, tx, None, donate=False)
 
-    def _shard(tree):
-        return jax.tree.map(
-            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl)
-            if hasattr(s, "shape") else s, tree,
-            is_leaf=lambda l: isinstance(l, jax.ShapeDtypeStruct))
-
     batch = {"image": jax.ShapeDtypeStruct((BATCH, 224, 224, 3), jnp.bfloat16,
                                            sharding=repl),
              "label": jax.ShapeDtypeStruct((BATCH,), jnp.int32, sharding=repl)}
-    state = _shard(state)
+    state = to_shape_structs(state, repl)
 
     log(f"AOT lower+compile (B={BATCH}) against {dev!r}...")
     compiled = jax.jit(train_step._fun if hasattr(train_step, "_fun")

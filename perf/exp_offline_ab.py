@@ -1,0 +1,241 @@
+"""Offline AOT A/Bs on the compile-only v5e topology (PERF.md §7).
+
+Extends `exp_hlo_offline.py`'s discovery to the transformer workloads and
+the multi-chip DP program — compiler-measured evidence (bytes accessed,
+flops, temp memory, collective payloads) with the relay out of the loop:
+
+  lm_xent  — TransformerLM 124M b=8 s=2048: dense head+loss vs the
+             chunked fused softmax-xent (tpuframe/ops/fused_xent.py).
+             The fused op's claim is that the [B,S,V] logits never land
+             in HBM; `bytes accessed` is the direct check.
+  lm_8k    — b=2 s=8192: XLA full attention vs the pallas flash kernel.
+             On-chip the XLA variant FAILS TO COMPILE (S^2 scores at
+             seq 8k, BASELINE.md round 3); AOT memory_analysis shows the
+             footprint both ways without needing 16 GB of real HBM.
+  dp32     — ResNet-50 DP train step over 32 compile-only v5e devices
+             (topology 4x8): the all-reduce payloads of the ACTUAL TPU
+             lowering, cross-checking tests/test_scaling32.py's
+             CPU-mesh HLO and the scaling projection's traffic input.
+
+Usage:  python perf/exp_offline_ab.py [lm_xent|lm_8k|dp32|all]
+Appends JSON lines to perf/results/offline_ab.jsonl.
+"""
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _common import ensure_cpu_backend, to_shape_structs  # noqa: E402
+
+ensure_cpu_backend()
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+from jax.experimental import topologies  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results",
+                   "offline_ab.jsonl")
+
+
+def log(m):
+    print(f"[offline-ab] {m}", file=sys.stderr, flush=True)
+
+
+def record(row):
+    row["source"] = "offline AOT v5e topology compile"
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print(json.dumps(row), flush=True)
+
+
+def _topo_mesh(shape="v5e:2x2", n=1, axes=("data",)):
+    topo = topologies.get_topology_desc(shape, platform="tpu")
+    devs = np.array(topo.devices[:n]).reshape([n] if len(axes) == 1 else None)
+    return Mesh(devs, axes)
+
+
+def _analyze(compiled, tag, extra=None):
+    ca = compiled.cost_analysis() or {}
+    row = {"tag": tag, "flops": ca.get("flops", 0.0),
+           "bytes": ca.get("bytes accessed", 0.0),
+           "gb": round(ca.get("bytes accessed", 0.0) / 1e9, 2)}
+    try:
+        ma = compiled.memory_analysis()
+        row["temp_gb"] = round(ma.temp_size_in_bytes / 1e9, 2)
+        row["arg_gb"] = round(ma.argument_size_in_bytes / 1e9, 2)
+    except Exception as e:  # noqa: BLE001
+        row["memory_analysis_error"] = str(e)[:120]
+    if extra:
+        row.update(extra)
+    return row
+
+
+def _lm_step(seq, batch_size, attn_impl, fused, repl):
+    from tpuframe.models import losses
+    from tpuframe.models.transformer_lm import LMConfig, TransformerLM
+    from tpuframe.parallel import step as step_lib
+
+    cfg = LMConfig(vocab_size=32000, hidden_size=768, num_layers=12,
+                   num_heads=12, intermediate_size=3072, max_seq=seq,
+                   dtype="bfloat16", attn_impl=attn_impl, remat=True)
+    model = TransformerLM(cfg)
+    ids = jax.ShapeDtypeStruct((batch_size, seq), jnp.int32, sharding=repl)
+    variables = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, seq), jnp.int32)),
+        jax.random.key(0))
+    tx = optax.adamw(1e-4)
+
+    if fused:
+        from tpuframe.ops import fused_xent as fx
+
+        def loss_fn(params, model_state, b, rng):
+            hidden = model.apply({"params": params}, b["input_ids"],
+                                 train=True, rngs={"dropout": rng},
+                                 hidden_only=True)
+            w = params["lm_head"]["kernel"]
+            loss = jnp.mean(fx.fused_softmax_xent(hidden, w, b["labels"]))
+            return loss, ({}, {})
+    else:
+        def loss_fn(params, model_state, b, rng):
+            logits = model.apply({"params": params}, b["input_ids"],
+                                 train=True, rngs={"dropout": rng})
+            return losses.softmax_cross_entropy(logits, b["labels"]), ({}, {})
+
+    state = jax.eval_shape(
+        lambda v: step_lib.TrainState.create(v["params"], tx), variables)
+    state = to_shape_structs(state, repl)
+    step = step_lib.make_train_step(loss_fn, tx, None, donate=False)
+    batch = {"input_ids": ids, "labels": ids}
+    return step, state, batch
+
+
+def lm_xent():
+    mesh = _topo_mesh(n=1)
+    repl = NamedSharding(mesh, P())
+    # Third variant is the PERF.md §8 headline row: flash attention +
+    # fused head — the byte-minimal LM step.
+    for attn, fused, tag in (("xla", False, "lm_2k_dense_xent"),
+                             ("xla", True, "lm_2k_fused_xent"),
+                             ("pallas", True, "lm_2k_pallas_fusedxent")):
+        log(f"compiling {tag}...")
+        step, state, batch = _lm_step(2048, 8, attn, fused, repl)
+        compiled = jax.jit(step).lower(state, batch).compile()
+        record(_analyze(compiled, tag,
+                        {"batch": 8, "seq": 2048, "attn": attn}))
+
+
+def lm_8k():
+    mesh = _topo_mesh(n=1)
+    repl = NamedSharding(mesh, P())
+    for attn in ("xla", "pallas"):
+        tag = f"lm_8k_{attn}_attn"
+        log(f"compiling {tag}...")
+        try:
+            step, state, batch = _lm_step(8192, 2, attn, True, repl)
+            compiled = jax.jit(step).lower(state, batch).compile()
+            record(_analyze(compiled, tag, {"batch": 2, "seq": 8192}))
+        except Exception as e:  # noqa: BLE001
+            record({"tag": tag, "batch": 2, "seq": 8192,
+                    "compile_error": str(e)[:300]})
+
+
+def dp32():
+    from tpuframe import models
+    from tpuframe.models import losses
+    from tpuframe.parallel import step as step_lib
+
+    from tpuframe.parallel import mesh as mesh_lib
+
+    topo = topologies.get_topology_desc("v5e:4x8", platform="tpu")
+    n = len(topo.devices)
+    # The framework mesh (all six axes; only data sized) so the step's
+    # default batch partition P(('data','fsdp')) resolves.
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=n),
+                              devices=list(topo.devices))
+    repl = NamedSharding(mesh, P())
+    dsh = NamedSharding(mesh, mesh_lib.batch_spec())
+    log(f"dp32: {n} compile-only devices")
+
+    model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    variables = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((2, 224, 224, 3), jnp.bfloat16)),
+        jax.random.key(0))
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+
+    def loss_fn(params, model_state, batch, step_rng):
+        logits, mutated = model.apply(
+            {"params": params, **model_state}, batch["image"], train=True,
+            mutable=["batch_stats"])
+        loss = losses.softmax_cross_entropy(logits, batch["label"])
+        return loss, (dict(mutated), {})
+
+    state = jax.eval_shape(
+        lambda v: step_lib.TrainState.create(
+            v["params"], tx, model_state={"batch_stats": v["batch_stats"]}),
+        variables)
+    state = to_shape_structs(state, repl)
+    # Per-chip batch 8 keeps the compile tractable; collective payloads
+    # depend on the GRADIENT tree, not the batch size.
+    batch = {"image": jax.ShapeDtypeStruct((8 * n, 224, 224, 3),
+                                           jnp.bfloat16, sharding=dsh),
+             "label": jax.ShapeDtypeStruct((8 * n,), jnp.int32,
+                                           sharding=dsh)}
+    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False)
+    log("compiling the 32-device DP step (this is the big one)...")
+    compiled = jax.jit(step).lower(state, batch).compile()
+    txt = compiled.as_text()
+
+    # Sum all-reduce payloads from the TPU lowering: every all-reduce(-start)
+    # instruction's result shapes (XLA emits one variadic tuple all-reduce
+    # per fusion bucket), bf16/f32, counted once.  Line-based parse — the
+    # tuple type contains layout parens that defeat a single regex.
+    payload = {"bf16": 0.0, "f32": 0.0}
+    ops = 0
+    for line in txt.splitlines():
+        stripped = line.strip()
+        m_ = re.match(r"%?[\w.-]+ = (.*?) all-reduce(-start)?\(", stripped)
+        if not m_:
+            continue
+        # Async form: an all-reduce-start's result tuple holds BOTH the
+        # aliased operand and the result — shapes appear twice, so halve
+        # (the latency-hiding scheduler converts to start/done pairs).
+        factor = 0.5 if m_.group(2) else 1.0
+        for dt, dims in re.findall(r"(bf16|f32)\[([0-9,]*)\]", m_.group(1)):
+            sz = {"bf16": 2, "f32": 4}[dt]
+            k = 1
+            for d in dims.split(","):
+                if d:
+                    k *= int(d)
+            payload[dt] += k * sz * factor
+        ops += 1
+    record(_analyze(compiled, "resnet50_dp32", {
+        "devices": n, "allreduce_ops": ops,
+        "allreduce_payload_mb": round(sum(payload.values()) / 1e6, 2),
+        "payload_bf16_mb": round(payload["bf16"] / 1e6, 2),
+        "payload_f32_mb": round(payload["f32"] / 1e6, 2),
+        "grad_tree_f32_mb": 102.4}))
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    steps = {"lm_xent": lm_xent, "lm_8k": lm_8k, "dp32": dp32}
+    if which == "all":
+        for name, fn in steps.items():
+            log(f"=== {name} ===")
+            fn()
+    else:
+        steps[which]()
+
+
+if __name__ == "__main__":
+    main()

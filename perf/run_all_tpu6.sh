@@ -1,0 +1,54 @@
+#!/bin/bash
+# Round-4 TPU queue #6 — the SCHEDULING levers (PERF.md §7 finding 4a).
+#
+# The offline census closed the bytes question: 143.5 GB/step is
+# structural, layout is already good, folded-BN is a null, remat is
+# negative.  What remains between measured 218 ms and the 177 ms HBM
+# roofline is a 23% SCHEDULING gap — prefetch depth, compute/DMA
+# overlap.  These are runtime A/Bs that only the chip can measure:
+#   1. latency-hiding scheduler on/off at the bench optimum (batch 256)
+#   2. scoped-vmem limit sweep (VMEM reserved for the scheduler's
+#      prefetch buffers; too little starves overlap, too much starves
+#      fusion scratch)
+#   3. best-combo confirmation run at 512 for the roofline comparison
+# Run AFTER queues 4b/5 (chip claim + one-client rules via claim.sh).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p perf/results
+LOG=perf/results/run_all6.log
+echo "=== run_all_tpu6 $(date -u +%FT%TZ) ===" >> "$LOG"
+. perf/claim.sh
+
+note() { echo "[run_all6 $(date -u +%T)] $*" | tee -a "$LOG"; }
+
+claim_wait_for_others | tee -a "$LOG"
+note "phase 0: chip claim"
+if ! claim_chip 96 "$LOG"; then
+  note "claim FAILED; giving up"
+  exit 1
+fi
+
+run() { queue_run "$@"; }
+
+# 1. latency-hiding scheduler A/B at batch 256.
+TPUFRAME_BENCH_BATCH=256 \
+    run bench_b256_lhs 1200 env \
+    XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true" \
+    python bench.py
+
+# 2. scoped-vmem sweep (default is compiler-chosen; KiB per core).
+for kib in 16384 32768 65536; do
+  TPUFRAME_BENCH_BATCH=256 \
+      run bench_b256_vmem$kib 1200 env \
+      XLA_FLAGS="--xla_tpu_scoped_vmem_limit_kib=$kib" \
+      python bench.py
+done
+
+# 3. combine the winners (re-edit after reading 1-2 if needed) and
+#    confirm at 512 for the roofline table.
+TPUFRAME_BENCH_BATCH=512 \
+    run bench_b512_lhs 1200 env \
+    XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true" \
+    python bench.py
+
+note "queue 6 complete"
