@@ -1,0 +1,35 @@
+"""Side-effect-free HLO text parsing helpers (importable from tests).
+
+Kept separate from ``_common`` (whose ``setup`` path pulls jax config)
+and from the experiment scripts (whose import guards re-exec the
+process): this module is pure text parsing.
+"""
+
+import re
+
+
+def allreduce_payload(txt: str):
+    """Sum all-reduce payload bytes from optimized-HLO text.
+
+    Returns ``({"bf16": bytes, "f32": bytes}, op_count)``.  Handles
+    XLA's variadic tuple all-reduces; an ``all-reduce-start``'s result
+    tuple aliases the operand (shapes appear twice — the form the
+    latency-hiding scheduler emits), so those instructions are halved.
+    """
+    payload = {"bf16": 0.0, "f32": 0.0}
+    ops = 0
+    for line in txt.splitlines():
+        stripped = line.strip()
+        m = re.match(r"%?[\w.-]+ = (.*?) all-reduce(-start)?\(", stripped)
+        if not m:
+            continue
+        factor = 0.5 if m.group(2) else 1.0
+        for dt, dims in re.findall(r"(bf16|f32)\[([0-9,]*)\]", m.group(1)):
+            sz = {"bf16": 2, "f32": 4}[dt]
+            k = 1
+            for d in dims.split(","):
+                if d:
+                    k *= int(d)
+            payload[dt] += k * sz * factor
+        ops += 1
+    return payload, ops

@@ -195,29 +195,11 @@ def dp32():
     compiled = jax.jit(step).lower(state, batch).compile()
     txt = compiled.as_text()
 
-    # Sum all-reduce payloads from the TPU lowering: every all-reduce(-start)
-    # instruction's result shapes (XLA emits one variadic tuple all-reduce
-    # per fusion bucket), bf16/f32, counted once.  Line-based parse — the
-    # tuple type contains layout parens that defeat a single regex.
-    payload = {"bf16": 0.0, "f32": 0.0}
-    ops = 0
-    for line in txt.splitlines():
-        stripped = line.strip()
-        m_ = re.match(r"%?[\w.-]+ = (.*?) all-reduce(-start)?\(", stripped)
-        if not m_:
-            continue
-        # Async form: an all-reduce-start's result tuple holds BOTH the
-        # aliased operand and the result — shapes appear twice, so halve
-        # (the latency-hiding scheduler converts to start/done pairs).
-        factor = 0.5 if m_.group(2) else 1.0
-        for dt, dims in re.findall(r"(bf16|f32)\[([0-9,]*)\]", m_.group(1)):
-            sz = {"bf16": 2, "f32": 4}[dt]
-            k = 1
-            for d in dims.split(","):
-                if d:
-                    k *= int(d)
-            payload[dt] += k * sz * factor
-        ops += 1
+    # Sum all-reduce payloads from the TPU lowering (shared parser —
+    # pinned by tests/test_offline_ab_parser.py).
+    from _hlo_parse import allreduce_payload
+
+    payload, ops = allreduce_payload(txt)
     record(_analyze(compiled, "resnet50_dp32", {
         "devices": n, "allreduce_ops": ops,
         "allreduce_payload_mb": round(sum(payload.values()) / 1e6, 2),

@@ -1,4 +1,4 @@
-"""Pin perf/exp_offline_ab.py's all-reduce payload parser.
+"""Pin perf/_hlo_parse.allreduce_payload (used by perf/exp_offline_ab.py).
 
 The parser feeds PERF.md §8 finding 4 (32-device wire bytes); it has to
 handle XLA's variadic tuple all-reduces, skip non-collective lines, and
@@ -6,68 +6,39 @@ halve async start/done pairs (whose result tuple aliases the operand) —
 the exact shape the latency-hiding scheduler emits.
 """
 
-import importlib
 import pathlib
-import re
 import sys
 
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1] / "perf"))
 
-def _load():
-    perf = pathlib.Path(__file__).resolve().parents[1] / "perf"
-    if str(perf) not in sys.path:
-        sys.path.insert(0, str(perf))
-    # Importing exp_offline_ab would trigger its CPU re-exec guard inside
-    # pytest; extract the parser by running it on text instead.
-    return perf / "exp_offline_ab.py"
-
-
-def _parse(txt: str):
-    payload = {"bf16": 0.0, "f32": 0.0}
-    ops = 0
-    for line in txt.splitlines():
-        stripped = line.strip()
-        m_ = re.match(r"%?[\w.-]+ = (.*?) all-reduce(-start)?\(", stripped)
-        if not m_:
-            continue
-        factor = 0.5 if m_.group(2) else 1.0
-        for dt, dims in re.findall(r"(bf16|f32)\[([0-9,]*)\]", m_.group(1)):
-            sz = {"bf16": 2, "f32": 4}[dt]
-            k = 1
-            for d in dims.split(","):
-                if d:
-                    k *= int(d)
-            payload[dt] += k * sz * factor
-        ops += 1
-    return payload, ops
-
-
-def test_parser_source_matches_this_copy():
-    # The test re-implements the parser to run it without the module's
-    # re-exec side effects; fail loudly if the source drifts from what is
-    # being pinned here.
-    src = _load().read_text()
-    assert r"all-reduce(-start)?\(" in src
-    assert "factor = 0.5 if m_.group(2) else 1.0" in src
+from _hlo_parse import allreduce_payload  # noqa: E402
 
 
 def test_sync_variadic_tuple():
     txt = """
   %all-reduce = (bf16[100]{0:T(128)(2,1)}, f32[10]{0:T(128)S(1)}) all-reduce(%a, %b), replica_groups={{0,1}}
 """
-    payload, ops = _parse(txt)
+    payload, ops = allreduce_payload(txt)
     assert ops == 1
     assert payload["bf16"] == 200 and payload["f32"] == 40
 
 
 def test_async_start_halved():
-    # start's result tuple aliases the operand: shapes appear twice.
+    # start's result tuple aliases the operand: shapes appear twice; the
+    # -done line carries the result shape but is not an extra payload.
     txt = """
   %all-reduce-start = (bf16[100]{0}, bf16[100]{0}) all-reduce-start(%a), replica_groups={{0,1}}
   %all-reduce-done = bf16[100]{0} all-reduce-done(%all-reduce-start)
 """
-    payload, ops = _parse(txt)
-    assert ops == 1  # -done has no '(-start)?(' match shape... see below
+    payload, ops = allreduce_payload(txt)
+    assert ops == 1
     assert payload["bf16"] == 200  # (100*2 + 100*2) * 0.5
+
+
+def test_multidim_product():
+    txt = "  %all-reduce.1 = f32[4,25]{1,0} all-reduce(%g), replica_groups={}\n"
+    payload, ops = allreduce_payload(txt)
+    assert ops == 1 and payload["f32"] == 400
 
 
 def test_non_collective_lines_ignored():
@@ -76,5 +47,5 @@ def test_non_collective_lines_ignored():
   %convert.5 = f32[64]{0} convert(%c)
   ROOT %tuple = (bf16[8]{0}) tuple(%x)
 """
-    payload, ops = _parse(txt)
+    payload, ops = allreduce_payload(txt)
     assert ops == 0 and payload["bf16"] == 0 and payload["f32"] == 0

@@ -125,3 +125,59 @@ def is_primary() -> bool:
     """True on the host that should own logging/eval-summary duties
     (reference: ``if hvd.rank() == 0`` gates, SURVEY.md §4.4/§5.5)."""
     return jax.process_index() == 0
+
+
+def broadcast_object(obj, root: int = 0):
+    """Send a picklable host object from ``root`` to every process
+    (Horovod ``hvd.broadcast_object`` — sampler state, config dicts,
+    vocabulary metadata).  Two-phase: the payload LENGTH is broadcast at
+    a fixed shape first, then the pickled bytes at that shape — every
+    process must call this collectively, like the Horovod original.
+
+    Pickle is the wire format, as in Horovod/torch.distributed: peers of
+    a training job are mutually trusted by construction.
+    """
+    if jax.process_count() == 1:
+        return obj
+    if not 0 <= root < jax.process_count():
+        raise ValueError(f"broadcast_object root {root} out of range for "
+                         f"{jax.process_count()} processes")
+    import pickle
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    is_root = jax.process_index() == root
+    payload = (np.frombuffer(pickle.dumps(obj), np.uint8) if is_root
+               else np.zeros((0,), np.uint8))
+    n = multihost_utils.broadcast_one_to_all(
+        np.array([payload.size], np.int64), is_source=is_root)
+    buf = np.zeros((int(n[0]),), np.uint8)
+    if is_root:
+        buf[:] = payload
+    data = multihost_utils.broadcast_one_to_all(buf, is_source=is_root)
+    return pickle.loads(np.asarray(data).tobytes())
+
+
+def allgather_object(obj) -> list:
+    """Gather one picklable object per process, returning the list in
+    process order on EVERY process (Horovod ``hvd.allgather_object``).
+    Ragged payloads are length-gathered first, padded to the global max,
+    gathered, then sliced back."""
+    if jax.process_count() == 1:
+        return [obj]
+    import pickle
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    lengths = multihost_utils.process_allgather(
+        np.array([payload.size], np.int64))
+    lengths = np.asarray(lengths).reshape(-1)
+    buf = np.zeros((int(lengths.max()),), np.uint8)
+    buf[:payload.size] = payload
+    rows = np.asarray(multihost_utils.process_allgather(buf))
+    rows = rows.reshape(jax.process_count(), -1)
+    return [pickle.loads(rows[i, :int(lengths[i])].tobytes())
+            for i in range(jax.process_count())]

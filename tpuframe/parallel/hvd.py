@@ -308,6 +308,18 @@ def shutdown() -> None:
     bootstrap.shutdown()
 
 
+def broadcast_object(obj, root_rank: int = 0):
+    """``hvd.broadcast_object`` — picklable host object from ``root_rank``
+    to every process (collective; see bootstrap.broadcast_object)."""
+    return bootstrap.broadcast_object(obj, root=root_rank)
+
+
+def allgather_object(obj) -> list:
+    """``hvd.allgather_object`` — one picklable object per process,
+    returned in process order everywhere."""
+    return bootstrap.allgather_object(obj)
+
+
 def _maybe_compress(grads: PyTree, compression: str | None):
     """Cast float32 leaves down for the reduction; returns the original
     dtypes so decompression restores exactly what arrived (bf16-native
