@@ -23,7 +23,6 @@ Appends JSON lines to perf/results/offline_ab.jsonl.
 
 import json
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

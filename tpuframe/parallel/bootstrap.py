@@ -137,11 +137,11 @@ def broadcast_object(obj, root: int = 0):
     Pickle is the wire format, as in Horovod/torch.distributed: peers of
     a training job are mutually trusted by construction.
     """
-    if jax.process_count() == 1:
-        return obj
     if not 0 <= root < jax.process_count():
         raise ValueError(f"broadcast_object root {root} out of range for "
                          f"{jax.process_count()} processes")
+    if jax.process_count() == 1:
+        return obj
     import pickle
 
     import numpy as np

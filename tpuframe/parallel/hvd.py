@@ -308,15 +308,17 @@ def shutdown() -> None:
     bootstrap.shutdown()
 
 
-def broadcast_object(obj, root_rank: int = 0):
+def broadcast_object(obj, root_rank: int = 0, name: str | None = None):
     """``hvd.broadcast_object`` — picklable host object from ``root_rank``
     to every process (collective; see bootstrap.broadcast_object)."""
+    del name  # Horovod tags; no fusion table here
     return bootstrap.broadcast_object(obj, root=root_rank)
 
 
-def allgather_object(obj) -> list:
+def allgather_object(obj, name: str | None = None) -> list:
     """``hvd.allgather_object`` — one picklable object per process,
     returned in process order everywhere."""
+    del name
     return bootstrap.allgather_object(obj)
 
 
