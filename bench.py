@@ -235,7 +235,18 @@ def run(batch_per_chip: int, warmup: int, measure: int) -> float:
     state = step_lib.TrainState.create(
         variables["params"], tx,
         model_state={"batch_stats": variables["batch_stats"]})
-    train_step = step_lib.make_train_step(loss_fn, tx, mesh, donate=True)
+    # TPUFRAME_XLA_OPTS="k=v,k=v" -> per-compile XLA options (e.g.
+    # xla_tpu_enable_latency_hiding_scheduler=true).  compiler_options
+    # travels inside the compile request, so it survives the relay's
+    # remote-compile hop where env vars (XLA_FLAGS / LIBTPU_INIT_ARGS)
+    # either crash the local flag parser or never reach the compiler.
+    xla_opts = None
+    opts_env = os.environ.get("TPUFRAME_XLA_OPTS", "")
+    if opts_env:
+        xla_opts = dict(kv.split("=", 1) for kv in opts_env.split(",") if kv)
+        _log(f"compiler_options: {xla_opts}")
+    train_step = step_lib.make_train_step(loss_fn, tx, mesh, donate=True,
+                                          compiler_options=xla_opts)
 
     if mesh is not None:
         state = step_lib.replicate_state(state, mesh)

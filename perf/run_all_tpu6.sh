@@ -30,25 +30,28 @@ fi
 
 run() { queue_run "$@"; }
 
+# Flags ride TPUFRAME_XLA_OPTS -> jit compiler_options: XLA_FLAGS would
+# crash the local parser (TPU flags unknown to the host XLA) and
+# LIBTPU_INIT_ARGS does not cross the relay's remote-compile boundary;
+# compiler_options is part of the compile request itself (verified
+# accepted by the v5e compiler via the offline topology).
+
 # 1. latency-hiding scheduler A/B at batch 256.
 TPUFRAME_BENCH_BATCH=256 \
-    run bench_b256_lhs 1200 env \
-    XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true" \
-    python bench.py
+    TPUFRAME_XLA_OPTS="xla_tpu_enable_latency_hiding_scheduler=true" \
+    run bench_b256_lhs 1200 python bench.py
 
 # 2. scoped-vmem sweep (default is compiler-chosen; KiB per core).
 for kib in 16384 32768 65536; do
   TPUFRAME_BENCH_BATCH=256 \
-      run bench_b256_vmem$kib 1200 env \
-      XLA_FLAGS="--xla_tpu_scoped_vmem_limit_kib=$kib" \
-      python bench.py
+      TPUFRAME_XLA_OPTS="xla_tpu_scoped_vmem_limit_kib=$kib" \
+      run bench_b256_vmem$kib 1200 python bench.py
 done
 
 # 3. combine the winners (re-edit after reading 1-2 if needed) and
 #    confirm at 512 for the roofline table.
 TPUFRAME_BENCH_BATCH=512 \
-    run bench_b512_lhs 1200 env \
-    XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true" \
-    python bench.py
+    TPUFRAME_XLA_OPTS="xla_tpu_enable_latency_hiding_scheduler=true" \
+    run bench_b512_lhs 1200 python bench.py
 
 note "queue 6 complete"

@@ -243,6 +243,7 @@ def make_train_step(
     fusion_threshold: int | None = None,
     accum_steps: int = 1,
     grad_reduce: str = "mean",
+    compiler_options: dict | None = None,
 ):
     """Build the compiled train step.
 
@@ -298,7 +299,8 @@ def make_train_step(
         # World of 1: adasum degrades to identity like every collective.
         body = functools.partial(_grad_step, loss_fn, tx, None, None,
                                  accum_steps, "mean")
-        return jax.jit(body, donate_argnums=(0,) if donate else ())
+        return jax.jit(body, donate_argnums=(0,) if donate else (),
+                       compiler_options=compiler_options)
 
     # Reduce over every batch-like axis, including size-1 ones: a size-1 pmean
     # is free after compilation but tells shard_map's replication checker the
@@ -331,6 +333,7 @@ def make_train_step(
             in_shardings=(state_sh, batch_sh),
             out_shardings=(state_sh, repl),
             donate_argnums=(0,) if donate else (),
+            compiler_options=compiler_options,
         )
 
     if mode != "shard_map":
@@ -343,7 +346,8 @@ def make_train_step(
         in_specs=(P(), batch_part),
         out_specs=(P(), P()),
     )
-    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+    return jax.jit(mapped, donate_argnums=(0,) if donate else (),
+                   compiler_options=compiler_options)
 
 
 def make_eval_step(
