@@ -243,7 +243,12 @@ def run(batch_per_chip: int, warmup: int, measure: int) -> float:
     xla_opts = None
     opts_env = os.environ.get("TPUFRAME_XLA_OPTS", "")
     if opts_env:
-        xla_opts = dict(kv.split("=", 1) for kv in opts_env.split(",") if kv)
+        pairs = [kv for kv in opts_env.split(",") if kv]
+        bad = [kv for kv in pairs if "=" not in kv]
+        if bad:
+            raise SystemExit(f"TPUFRAME_XLA_OPTS entries need key=value, "
+                             f"got {bad!r}")
+        xla_opts = dict(kv.split("=", 1) for kv in pairs)
         _log(f"compiler_options: {xla_opts}")
     train_step = step_lib.make_train_step(loss_fn, tx, mesh, donate=True,
                                           compiler_options=xla_opts)
