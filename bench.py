@@ -243,12 +243,15 @@ def run(batch_per_chip: int, warmup: int, measure: int) -> float:
     xla_opts = None
     opts_env = os.environ.get("TPUFRAME_XLA_OPTS", "")
     if opts_env:
-        pairs = [kv for kv in opts_env.split(",") if kv]
-        bad = [kv for kv in pairs if "=" not in kv]
+        pairs = [kv.strip() for kv in opts_env.split(",") if kv.strip()]
+        bad = [kv for kv in pairs
+               if "=" not in kv or not kv.split("=", 1)[0].strip()
+               or not kv.split("=", 1)[1].strip()]
         if bad:
             raise SystemExit(f"TPUFRAME_XLA_OPTS entries need key=value, "
                              f"got {bad!r}")
-        xla_opts = dict(kv.split("=", 1) for kv in pairs)
+        xla_opts = {k.strip(): v.strip() for k, v in
+                    (kv.split("=", 1) for kv in pairs)}
         _log(f"compiler_options: {xla_opts}")
     train_step = step_lib.make_train_step(loss_fn, tx, mesh, donate=True,
                                           compiler_options=xla_opts)

@@ -64,6 +64,36 @@ def test_ring_attention_matches_full(seq_mesh, causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_q_chunked_matches_full(seq_mesh, causal):
+    # q_chunk smaller than the local chunk (16 < 64/4): exercises the
+    # lax.map sub-chunking that bounds the per-stage score block at long
+    # context (the 32k OOM fix, PERF.md §9) — must be exact.
+    q, k, v = _qkv()
+    mask = None if causal else _padding_mask()
+
+    def fn(q, k, v, m):
+        return seq_parallel.ring_attention(q, k, v, axis="seq", mask=m,
+                                           causal=causal, q_chunk=8)
+
+    got = _run_sharded(fn, seq_mesh, q, k, v, mask)
+    want = _reference(q, k, v, mask=mask, causal=causal)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_q_chunk_indivisible_falls_back(seq_mesh):
+    # Local chunk 16 with q_chunk=10: indivisible -> whole-chunk path.
+    q, k, v = _qkv()
+
+    def fn(q, k, v, m):
+        return seq_parallel.ring_attention(q, k, v, axis="seq",
+                                           causal=True, q_chunk=10)
+
+    got = _run_sharded(fn, seq_mesh, q, k, v, None)
+    want = _reference(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_matches_full(seq_mesh, causal):
     q, k, v = _qkv()
     mask = None if causal else _padding_mask()
@@ -93,6 +123,35 @@ def test_ring_attention_gradients(seq_mesh):
 
     def loss_full(q, k, v):
         return jnp.sum(_reference(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ring, g_full, "qkv"):
+        np.testing.assert_allclose(gr, gf, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("q_chunk", [4, 3])  # 3: ragged tail (8 = 2*3 + 2)
+def test_ring_attention_q_chunked_gradients(seq_mesh, q_chunk):
+    """Gradients through the lax.map + double-checkpoint sub-chunk path —
+    the 32k memory fix's backward (PERF.md §9) — must match full attention
+    exactly, including with a ragged tail sub-chunk."""
+    q, k, v = _qkv(b=2, s=32, n=2, d=8)  # local chunk 32/4 = 8 > q_chunk
+    mask = _padding_mask(b=2, s=32, seed=3)
+
+    def loss_ring(q, k, v):
+        def fn(q, k, v, m):
+            return seq_parallel.ring_attention(q, k, v, axis="seq", mask=m,
+                                               causal=False,
+                                               q_chunk=q_chunk)
+        act = P("data", "seq")
+        mapped = jax.shard_map(fn, mesh=seq_mesh,
+                               in_specs=(act, act, act, P("data", "seq")),
+                               out_specs=act)
+        return jnp.sum(mapped(q, k, v, mask) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(_reference(q, k, v, mask=mask) ** 2)
 
     g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
     g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)

@@ -33,8 +33,8 @@ from jax import lax
 NEG_INF = -1e30  # matches tpuframe.ops.flash_attention.NEG_INF
 
 
-def _chunk_attn(q, k, v, keep, scale):
-    """Unnormalized blockwise attention in f32.
+def _chunk_attn_whole(q, k, v, keep, scale):
+    """Unnormalized blockwise attention in f32 (scores fully materialized).
 
     q: [B, Cq, N, D]; k/v: [B, Ck, N, D]; keep: [B, 1, Cq, Ck] bool or None.
     Returns (acc [B, Cq, N, D] f32, m [B, N, Cq] f32, l [B, N, Cq] f32).
@@ -53,10 +53,56 @@ def _chunk_attn(q, k, v, keep, scale):
     return acc, m, l
 
 
+def _chunk_attn(q, k, v, keep, scale, q_chunk=None):
+    """``_chunk_attn_whole`` with a bounded score footprint.
+
+    The whole-chunk scores are [B, N, Cq, Ck] f32 — at 32k over 4 devices
+    that is 12 x 8192^2 x 4 B = 3.2 GB per ring stage, which OOMs the chip
+    (found by the offline v5e AOT compile, PERF.md §9).  ``q_chunk`` caps
+    the live score block at [B, N, q_chunk, Ck] by lax.map-ing over query
+    sub-chunks: rows are independent given a fixed K/V chunk, so results
+    concatenate exactly — no extra merging, bit-identical math.
+    """
+    b, cq, nh, d = q.shape
+    if q_chunk is None or cq <= q_chunk:
+        return _chunk_attn_whole(q, k, v, keep, scale)
+    n_sub, tail = divmod(cq, q_chunk)
+    head = n_sub * q_chunk
+    # jax.checkpoint: without it, lax.map's transpose STACKS each
+    # sub-chunk's softmax residuals ([n_sub, B, N, q_chunk, Ck] f32 — and
+    # the enclosing ring scan stacks that again per stage), which is the
+    # multi-GB saved-buffer class the chunking exists to eliminate.  With
+    # it, the backward recomputes one sub-chunk's scores at a time.
+    core = jax.checkpoint(
+        lambda qi, kp: _chunk_attn_whole(qi, k, v, kp, scale))
+    qs = q[:, :head].reshape(b, n_sub, q_chunk, nh, d).transpose(
+        1, 0, 2, 3, 4)
+    if keep is not None:
+        ck = keep.shape[-1]
+        ks = keep[:, :, :head].reshape(
+            b, 1, n_sub, q_chunk, ck).transpose(2, 0, 1, 3, 4)
+        acc, m, l = lax.map(lambda xs: core(xs[0], xs[1]), (qs, ks))
+    else:
+        acc, m, l = lax.map(lambda qi: core(qi, None), qs)
+    acc = acc.transpose(1, 0, 2, 3, 4).reshape(b, head, nh, d)
+    m = m.transpose(1, 2, 0, 3).reshape(b, nh, head)
+    l = l.transpose(1, 2, 0, 3).reshape(b, nh, head)
+    if tail:
+        # Ragged remainder: rows are independent, so one extra sub-chunk
+        # keeps the result exact without re-admitting whole-chunk scores.
+        acc_t, m_t, l_t = core(
+            q[:, head:], None if keep is None else keep[:, :, head:])
+        acc = jnp.concatenate([acc, acc_t], axis=1)
+        m = jnp.concatenate([m, m_t], axis=-1)
+        l = jnp.concatenate([l, l_t], axis=-1)
+    return acc, m, l
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    axis: str = "seq",
                    mask: jax.Array | None = None,
-                   causal: bool = False) -> jax.Array:
+                   causal: bool = False,
+                   q_chunk: int | None = 1024) -> jax.Array:
     """Exact attention over a sequence sharded across the ``axis`` ring.
 
     Must be called inside ``shard_map`` with ``axis`` bound.  Per-device
@@ -66,6 +112,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     Causal masking uses global positions: device ``i``'s queries occupy
     ``[i*C, (i+1)*C)`` of the gathered sequence.
+
+    ``q_chunk`` bounds the per-stage score materialization (see
+    ``_chunk_attn``); identical results, identical wire traffic — only
+    the live f32 score block shrinks.  None disables.
     """
     n = lax.axis_size(axis)
     my = lax.axis_index(axis)
@@ -89,8 +139,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     def step(carry, i):
         acc, m, l, kv_k, kv_v, kv_mask = carry
         kv_owner = (my - i) % n  # whose chunk we hold after i rotations
-        acc_c, m_c, l_c = _chunk_attn(q, kv_k, kv_v,
-                                      make_keep(kv_owner, kv_mask), scale)
+        # checkpoint: the ring scan's transpose must save only the small
+        # per-stage inputs (kv chunk, [B,Ck] mask, scalar owner), not the
+        # stage's score-sized softmax residuals stacked n times — the keep
+        # mask ([B,1,Cq,Ck]) is built INSIDE so it is recomputed too.
+        def stage(qq, kk, vv, owner, kmask):
+            return _chunk_attn(qq, kk, vv, make_keep(owner, kmask), scale,
+                               q_chunk=q_chunk)
+
+        acc_c, m_c, l_c = jax.checkpoint(stage)(q, kv_k, kv_v, kv_owner,
+                                                kv_mask)
         m_new = jnp.maximum(m, m_c)
         a1 = jnp.exp(m - m_new)
         a2 = jnp.exp(m_c - m_new)
