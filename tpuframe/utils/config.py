@@ -194,7 +194,11 @@ def _lm_long() -> TrainConfig:
         model_kwargs={"seq_mode": "ring", "remat": True,
                       "max_seq": 32768, "vocab_size": 32000},
         dataset="lm_text", dataset_kwargs={"seq_len": 32768},
-        shard_seq=True, mesh=MeshSpec(data=1, seq=-1),
+        # data=2: the offline v5e capacity audit (PERF.md §9) measured
+        # dp1 x sp8 at 17.2 GB resident/device — over v5e's 15.75 GB HBM
+        # (fine on v4's 32 GB).  dp2 x sp(-1) halves the per-replica
+        # batch and fits everywhere the audit covers.
+        shard_seq=True, mesh=MeshSpec(data=2, seq=-1),
         optimizer="adamw", base_lr=3e-4, scale_lr_by_batch=False,
         warmup_steps=200, schedule="cosine", weight_decay=0.1,
         grad_clip_norm=1.0, global_batch=8, total_steps=5000,
