@@ -16,8 +16,10 @@ flops, temp memory, collective payloads) with the relay out of the loop:
              (topology 4x8): the all-reduce payloads of the ACTUAL TPU
              lowering, cross-checking tests/test_scaling32.py's
              CPU-mesh HLO and the scaling projection's traffic input.
+  bert_b256— BERT-base classification step at b=256 s=128: the
+             queue-4 on-chip A/B's byte/temp picture, offline.
 
-Usage:  python perf/exp_offline_ab.py [lm_xent|lm_8k|dp32|all]
+Usage:  python perf/exp_offline_ab.py [lm_xent|lm_8k|dp32|bert_b256|all]
 Appends JSON lines to perf/results/offline_ab.jsonl.
 """
 
@@ -148,6 +150,44 @@ def lm_8k():
                     "compile_error": str(e)[:300]})
 
 
+def bert_b256():
+    """BERT-base classification step at b=256 s=128 — the queue-4 on-chip
+    A/B's byte/residency picture, available offline."""
+    from tpuframe.models import bert as bert_lib
+    from tpuframe.models import losses
+    from tpuframe.parallel import step as step_lib
+
+    mesh = _topo_mesh(n=1)
+    repl = NamedSharding(mesh, P())
+    cfg = bert_lib.BertConfig(dtype="bfloat16")
+    model = bert_lib.BertForSequenceClassification(cfg)
+    B, S = 256, 128
+    ids = jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=repl)
+    lab = jax.ShapeDtypeStruct((B,), jnp.int32, sharding=repl)
+    variables = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, S), jnp.int32),
+                             jnp.ones((1, S), jnp.int32),
+                             jnp.zeros((1, S), jnp.int32)),
+        jax.random.key(0))
+    tx = optax.adamw(2e-5)
+
+    def loss_fn(params, model_state, b, rng):
+        logits = model.apply({"params": params}, b["input_ids"],
+                             b["attention_mask"], b["token_type_ids"],
+                             train=True, rngs={"dropout": rng})
+        return losses.softmax_cross_entropy(logits, b["label"]), ({}, {})
+
+    state = to_shape_structs(jax.eval_shape(
+        lambda v: step_lib.TrainState.create(v["params"], tx), variables),
+        repl)
+    step = step_lib.make_train_step(loss_fn, tx, None, donate=False)
+    batch = {"input_ids": ids, "attention_mask": ids,
+             "token_type_ids": ids, "label": lab}
+    log("compiling bert-base b=256 s=128...")
+    compiled = jax.jit(step).lower(state, batch).compile()
+    record(_analyze(compiled, "bert_b256", {"batch": B, "seq": S}))
+
+
 def dp32():
     from tpuframe import models
     from tpuframe.models import losses
@@ -209,7 +249,8 @@ def dp32():
 
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    steps = {"lm_xent": lm_xent, "lm_8k": lm_8k, "dp32": dp32}
+    steps = {"lm_xent": lm_xent, "lm_8k": lm_8k, "dp32": dp32,
+             "bert_b256": bert_b256}
     if which == "all":
         for name, fn in steps.items():
             log(f"=== {name} ===")
