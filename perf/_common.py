@@ -172,3 +172,66 @@ def to_shape_structs(tree, sharding):
         lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding)
         if hasattr(s, "shape") else s, tree,
         is_leaf=lambda l: isinstance(l, jax.ShapeDtypeStruct))
+
+
+_AOT_LOCK_HANDLE = None
+
+AOT_LOCK_PATH = None  # resolved lazily next to this file's repo root
+
+
+def _aot_lock_path():
+    import os
+
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".aot_compile.lock")
+
+
+def aot_lock(timeout_s: float = 7200.0):
+    """Context manager: acquire the machine-wide AOT-compile lock with a
+    bounded wait (raises TimeoutError instead of hanging CI forever
+    behind a long-running census)."""
+    import contextlib
+    import fcntl
+    import time
+
+    @contextlib.contextmanager
+    def _cm():
+        fh = open(_aot_lock_path(), "w")
+        deadline = time.monotonic() + timeout_s
+        try:
+            while True:
+                try:
+                    fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"AOT compile lock busy for >{timeout_s}s "
+                            f"({_aot_lock_path()}) — another offline "
+                            f"census/compile is holding it")
+                    time.sleep(5.0)
+            yield
+        finally:
+            fh.close()
+
+    return _cm()
+
+
+def hold_aot_lock():
+    """Serialize compile-only libtpu users machine-wide.
+
+    libtpu guards itself with a /tmp lockfile and ABORTS when a second
+    process initializes concurrently (seen 2026-07-31: overlapping AOT
+    censuses + the AOT guard tests).  Callers block here until the
+    current holder exits; the lock is held for the process lifetime
+    (the libtpu conflict window is the whole process, not just init).
+    Call AFTER ensure_cpu_backend (so the re-exec doesn't drop it).
+    """
+    global _AOT_LOCK_HANDLE
+    if _AOT_LOCK_HANDLE is not None:
+        return
+    import fcntl
+
+    fh = open(_aot_lock_path(), "w")
+    fcntl.flock(fh, fcntl.LOCK_EX)  # blocks until free
+    _AOT_LOCK_HANDLE = fh

@@ -23,9 +23,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from _common import ensure_cpu_backend, to_shape_structs  # noqa: E402
+from _common import (ensure_cpu_backend, hold_aot_lock,  # noqa: E402
+                     to_shape_structs)
 
 ensure_cpu_backend()
+hold_aot_lock()
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:

@@ -45,16 +45,26 @@ topo = topologies.get_topology_desc("v5e:2x2", platform="tpu")
 def _run(body, timeout=900, extra_env=None):
     import pathlib
 
-    repo = str(pathlib.Path(__file__).resolve().parents[1])
-    script = _PRELUDE.format(repo=repo) + textwrap.dedent(body)
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo / "perf"))
+    from _common import aot_lock
+
+    script = _PRELUDE.format(repo=str(repo)) + textwrap.dedent(body)
     env = dict(os.environ)
     env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""
     if extra_env:
         env.update(extra_env)
-    proc = subprocess.run([sys.executable, "-c", script], env=env,
-                          capture_output=True, text=True, timeout=timeout)
+    # Serialize against every other compile-only libtpu user (the perf
+    # scripts hold the same lock via hold_aot_lock): a second concurrent
+    # process ABORTS on libtpu's /tmp lockfile — seen as flaky suite
+    # failures when an offline census overlapped these tests.  Bounded
+    # wait so a stuck holder fails the test loudly instead of hanging.
+    with aot_lock(timeout_s=1800):
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, text=True,
+                              timeout=timeout)
     assert proc.returncode == 0, proc.stderr[-3000:]
     return proc.stdout
 
