@@ -176,8 +176,6 @@ def to_shape_structs(tree, sharding):
 
 _AOT_LOCK_HANDLE = None
 
-AOT_LOCK_PATH = None  # resolved lazily next to this file's repo root
-
 
 def _aot_lock_path():
     import os

@@ -16,11 +16,16 @@ behavior (numerics on the MXU, timing) still needs the bench chip.
 """
 
 import os
+import pathlib
 import subprocess
 import sys
 import textwrap
 
 import pytest
+
+_PERF_DIR = str(pathlib.Path(__file__).resolve().parents[1] / "perf")
+if _PERF_DIR not in sys.path:
+    sys.path.insert(0, _PERF_DIR)
 
 pytestmark = pytest.mark.slow
 
@@ -43,12 +48,9 @@ topo = topologies.get_topology_desc("v5e:2x2", platform="tpu")
 
 
 def _run(body, timeout=900, extra_env=None):
-    import pathlib
-
-    repo = pathlib.Path(__file__).resolve().parents[1]
-    sys.path.insert(0, str(repo / "perf"))
     from _common import aot_lock
 
+    repo = pathlib.Path(__file__).resolve().parents[1]
     script = _PRELUDE.format(repo=str(repo)) + textwrap.dedent(body)
     env = dict(os.environ)
     env.pop("PALLAS_AXON_REMOTE_COMPILE", None)

@@ -235,6 +235,32 @@ class TestAdasumStep:
             step_lib.make_train_step(loss_fn, tx, mesh8, grad_reduce="nope")
 
 
+class TestAsyncAndIntrospection:
+    def test_async_handle_roundtrip(self, mesh8):
+        # Port-compat pair: handle = allreduce_async_, synchronize(handle).
+        x = np.arange(8.0, dtype=np.float32)
+
+        def body(t):
+            h = hvd.allreduce_async_(t, op=hvd.Sum)
+            return hvd.synchronize(h)
+
+        out = _run8(body, x, mesh8, P())
+        assert out[0] == 28.0
+
+    def test_synchronize_outside_jit_blocks(self):
+        import jax.numpy as jnp
+
+        v = hvd.synchronize(jnp.arange(4.0) * 2)
+        np.testing.assert_allclose(np.asarray(v), [0, 2, 4, 6])
+
+    def test_build_introspection_is_honest(self):
+        # The reference genre queries these to pick env knobs; on TPU none
+        # of the legacy transports exist.
+        assert not hvd.mpi_built() and not hvd.nccl_built()
+        assert not hvd.gloo_built() and not hvd.cuda_built()
+        assert not hvd.rocm_built() and not hvd.mpi_enabled()
+
+
 class TestUnitAxisMesh:
     """The single-device 'config 1' mode: a bound size-1 axis must come back
     vma-replicated from every op so out_specs=P() still compiles."""

@@ -308,6 +308,60 @@ def shutdown() -> None:
     bootstrap.shutdown()
 
 
+def allreduce_async_(tensor: PyTree, average: bool | None = None,
+                     name: str | None = None, axis=_DEFAULT_AXIS,
+                     op: _ReduceOp | None = None,
+                     process_set: ProcessSet | None = None) -> PyTree:
+    """``hvd.allreduce_async_`` — returns a "handle" to pass to
+    ``synchronize``.  Under XLA the handle IS the traced value: inside a
+    compiled program every collective is already asynchronous until a
+    consumer needs it (the scheduler overlaps it with compute — the
+    overlap Horovod's handle API exists to expose), so the pair maps to
+    allreduce + identity."""
+    return allreduce(tensor, average=average, name=name, axis=axis, op=op,
+                     process_set=process_set)
+
+
+def synchronize(handle: PyTree) -> PyTree:
+    """``hvd.synchronize`` — wait on an ``allreduce_async_`` handle.
+    Inside jit: identity (tracers pass through — the data dependency is
+    the synchronization).  Outside: blocks until the device value is
+    ready, and surfaces any deferred execution error HERE, matching
+    Horovod's semantics of synchronize being where failures appear."""
+    if any(isinstance(leaf, jax.core.Tracer)
+           for leaf in jax.tree.leaves(handle)):
+        return handle
+    return jax.block_until_ready(handle)
+
+
+def mpi_built() -> bool:
+    """Horovod build introspection.  tpuframe has no MPI dependency —
+    bootstrap is jax.distributed's GRPC coordinator (SURVEY.md §4.3)."""
+    return False
+
+
+def nccl_built() -> bool:
+    """No NCCL: collectives are XLA HLOs over ICI/DCN (SURVEY.md §3b)."""
+    return False
+
+
+def gloo_built() -> bool:
+    """No Gloo: host-level rendezvous is the GRPC coordinator."""
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
 def broadcast_object(obj, root_rank: int = 0, name: str | None = None):
     """``hvd.broadcast_object`` — picklable host object from ``root_rank``
     to every process (collective; see bootstrap.broadcast_object)."""
