@@ -253,6 +253,16 @@ class TestAsyncAndIntrospection:
         v = hvd.synchronize(jnp.arange(4.0) * 2)
         np.testing.assert_allclose(np.asarray(v), [0, 2, 4, 6])
 
+    def test_compression_namespace_maps_to_string_knob(self):
+        # Horovod scripts pass hvd.Compression.fp16 — must be accepted
+        # verbatim by DistributedOptimizer.
+        import optax
+
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                      compression=hvd.Compression.fp16)
+        assert tx is not None
+        assert hvd.Compression.none is None
+
     def test_build_introspection_is_honest(self):
         # The reference genre queries these to pick env knobs; on TPU none
         # of the legacy transports exist.

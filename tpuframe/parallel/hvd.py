@@ -173,6 +173,16 @@ def broadcast_optimizer_state(opt_state: PyTree, root_rank: int = 0,
     return collectives.broadcast(opt_state, axis=axis, root=root_rank)
 
 
+class Compression:
+    """Horovod's ``hvd.Compression`` namespace: scripts pass
+    ``compression=hvd.Compression.fp16`` — map the members onto
+    ``DistributedOptimizer``'s string knob (fp16 → bf16, the TPU-native
+    half precision; see the compression docs below)."""
+
+    none = None
+    fp16 = "bf16"
+
+
 class _DistState(NamedTuple):
     inner: Any
 
