@@ -84,8 +84,8 @@ def _summarize(c, tag, extra):
     return row
 
 
-def _lm_long(tag, data, sp, batch):
-    """Shared 32k ring-attention builder (dp x sp variants)."""
+def _lm_long(tag, data, sp, batch, seq_mode="ring", attn_impl="xla"):
+    """Shared 32k sequence-parallel builder (dp x sp, ring or ulysses)."""
     from tpuframe import models
     from tpuframe.ops import fused_xent as fx
     from tpuframe.parallel import mesh as mesh_lib
@@ -98,7 +98,8 @@ def _lm_long(tag, data, sp, batch):
     model = models.get_model(
         "transformer-lm", hidden_size=768, num_layers=12, num_heads=12,
         intermediate_size=3072, vocab_size=32000, max_seq=SEQ,
-        seq_mode="ring", remat=True, dtype="bfloat16")
+        seq_mode=seq_mode, attn_impl=attn_impl, remat=True,
+        dtype="bfloat16")
     repl = NamedSharding(mesh, P())
     part = P(mesh_lib.BATCH_AXES, "seq")
     ids = jax.ShapeDtypeStruct((batch, SEQ), jnp.int32,
@@ -136,6 +137,64 @@ def lm_long_exact():
 def lm_32k_dp2sp4():
     """The PERF.md section-9 headline variant: dp2 x sp4, b=2, 32k."""
     _lm_long("lm_32k_sp_ring_dp2sp4", 2, 4, 2)
+
+
+def lm_32k_ulysses():
+    """Ulysses (all-to-all head-resharding) at the same 32k shape —
+    the other first-class SP mode, at real scale.  The inner attention
+    MUST be the flash kernel: after resharding, each device holds the
+    FULL 32k sequence on heads/sp heads, and XLA attention's S^2 scores
+    OOM (20.3 GB vs 15.75 — the audit's xla-inner row records exactly
+    that).  Pairing rule documented in PERF.md section 9."""
+    _lm_long("lm_32k_sp_ulysses_pallas_dp2sp4", 2, 4, 2,
+             seq_mode="ulysses", attn_impl="pallas")
+
+
+def lm_tp_realistic():
+    """Megatron-style tensor parallel at real shape: tp4 x dp2, 124M LM,
+    b=8 s=2048, sharded state via the fsdp/tp rule tree."""
+    from tpuframe import models
+    from tpuframe.models import losses
+    from tpuframe.parallel import fsdp as fsdp_lib
+    from tpuframe.parallel import mesh as mesh_lib
+    from tpuframe.parallel import step as step_lib
+    from tpuframe.parallel import tp as tp_lib
+
+    topo = topologies.get_topology_desc("v5e:2x4", platform="tpu")
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=2, model=4),
+                              devices=list(topo.devices))
+    model = models.get_model(
+        "transformer-lm", hidden_size=768, num_layers=12, num_heads=12,
+        intermediate_size=3072, vocab_size=32000, max_seq=2048,
+        dtype="bfloat16", remat=True)
+    variables = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, 2048), jnp.int32)),
+        jax.random.key(0))
+    tx = optax.adamw(3e-4)
+
+    def loss_fn(params, model_state, b, rng):
+        logits = model.apply({"params": params}, b["input_ids"], train=True,
+                             rngs={"dropout": rng})
+        return losses.softmax_cross_entropy(logits, b["labels"]), ({}, {})
+
+    state = jax.eval_shape(
+        lambda v: step_lib.TrainState.create(v["params"], tx), variables)
+    shardings = fsdp_lib.state_shardings(
+        state, mesh, tp_rules=tp_lib.rules_for_model("transformer-lm"))
+    state = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)
+        if hasattr(s, "shape") else s, state, shardings,
+        is_leaf=lambda l: isinstance(l, jax.ShapeDtypeStruct))
+    dmesh = fsdp_lib.auto_mesh(mesh)
+    ids = jax.ShapeDtypeStruct(
+        (8, 2048), jnp.int32,
+        sharding=NamedSharding(dmesh, mesh_lib.batch_spec()))
+    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=True,
+                                    state_shardings=shardings)
+    log("compiling TP LM (tp4 x data2, b=8 s=2048)...")
+    c = step.lower(state, {"input_ids": ids, "labels": ids}).compile()
+    record(_summarize(c, "lm_tp_tp4data2", {
+        "devices": 8, "seq": 2048, "batch": 8}))
 
 
 def lm_pp_realistic():
@@ -231,6 +290,11 @@ ENTRIES = {
     "lm_32k_dp2sp4": (lm_32k_dp2sp4, {
         "tag": "lm_32k_sp_ring_dp2sp4", "devices": 8, "seq": 32768,
         "batch": 2}),
+    "lm_32k_ulysses": (lm_32k_ulysses, {
+        "tag": "lm_32k_sp_ulysses_pallas_dp2sp4", "devices": 8,
+        "seq": 32768, "batch": 2}),
+    "lm_tp_realistic": (lm_tp_realistic, {
+        "tag": "lm_tp_tp4data2", "devices": 8, "seq": 2048, "batch": 8}),
     "lm_pp_realistic": (lm_pp_realistic, {
         "tag": "lm_pp_pipe4data2", "devices": 8, "seq": 2048, "batch": 8}),
     "lm_moe_realistic": (lm_moe_realistic, {
