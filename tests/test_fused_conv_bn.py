@@ -1,0 +1,322 @@
+"""Parity tests for the fused 1x1-conv+BN backward kernel
+(tpuframe/ops/fused_conv_bn.py, PERF.md §6.3's byte-floor lever).
+
+The kernel must be a NUMERICAL drop-in for the unfused composition: the
+forward is the same folded math, and the backward's closed-form BN
+gradient + fused matmuls must match XLA's autodiff of the reference
+expression.  f32 runs pin tight tolerances; bf16 runs bound the rounding
+introduced by keeping g in VMEM-f32 and casting once for the MXU dots.
+
+CPU runs use the pallas interpreter (module/interpret=None auto-detects).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuframe.ops import fused_conv_bn as fcb
+
+
+def _rand(rng, shape, dtype, scale=1.0, loc=0.0):
+    return jnp.asarray(rng.normal(loc, scale, shape), dtype)
+
+
+def _loss_parts(y, mean, var, t):
+    # Touch every output (incl. the stats, with stop_gradient as the
+    # module contract requires) so the vjp covers the full signature.
+    return (jnp.sum(y.astype(jnp.float32) * t)
+            + jnp.sum(jax.lax.stop_gradient(mean))
+            + jnp.sum(jax.lax.stop_gradient(var)))
+
+
+class TestCoreParity:
+    @pytest.mark.parametrize("m,k,n", [(128, 32, 48), (512, 64, 96)])
+    def test_f32_values_and_grads(self, m, k, n):
+        rng = np.random.default_rng(0)
+        a = _rand(rng, (m, k), jnp.float32, 2.0, 1.0)
+        w = _rand(rng, (k, n), jnp.float32, 0.2)
+        gamma = jnp.asarray(rng.uniform(0.5, 2.0, n), jnp.float32)
+        beta = _rand(rng, (n,), jnp.float32)
+        t = _rand(rng, (m, n), jnp.float32)
+        cfg = (1e-5, 128, True)  # block_m=128 -> multi-block at m=512
+
+        def fused_loss(a, w, g, b):
+            y, mean, var = fcb.conv1x1_bn_train(cfg, a, w, g, b)
+            return _loss_parts(y, mean, var, t)
+
+        def ref_loss(a, w, g, b):
+            y, mean, var = fcb.conv1x1_bn_reference(a, w, g, b, eps=1e-5)
+            return _loss_parts(y, mean, var, t)
+
+        lf, gf = jax.value_and_grad(fused_loss, argnums=(0, 1, 2, 3))(
+            a, w, gamma, beta)
+        lr, gr = jax.value_and_grad(ref_loss, argnums=(0, 1, 2, 3))(
+            a, w, gamma, beta)
+        np.testing.assert_allclose(lf, lr, rtol=1e-5)
+        for got, want, name in zip(gf, gr, ("da", "dw", "dgamma", "dbeta")):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4,
+                err_msg=name)
+
+    def test_bf16_values_and_grads(self):
+        rng = np.random.default_rng(1)
+        m, k, n = 256, 32, 64
+        a = _rand(rng, (m, k), jnp.bfloat16, 1.0)
+        w = _rand(rng, (k, n), jnp.float32, 0.2)
+        gamma = jnp.asarray(rng.uniform(0.5, 2.0, n), jnp.float32)
+        beta = _rand(rng, (n,), jnp.float32)
+        t = _rand(rng, (m, n), jnp.float32)
+        cfg = (1e-5, 128, True)
+
+        def fused_loss(a, w, g, b):
+            y, mean, var = fcb.conv1x1_bn_train(cfg, a, w, g, b)
+            return _loss_parts(y, mean, var, t)
+
+        def ref_loss(a, w, g, b):
+            y, mean, var = fcb.conv1x1_bn_reference(a, w, g, b, eps=1e-5)
+            return _loss_parts(y, mean, var, t)
+
+        lf, gf = jax.value_and_grad(fused_loss, argnums=(0, 1, 2, 3))(
+            a, w, gamma, beta)
+        lr, gr = jax.value_and_grad(ref_loss, argnums=(0, 1, 2, 3))(
+            a, w, gamma, beta)
+        # bf16 activations: both paths quantize at the same points except
+        # g (ours rounds once to bf16 in VMEM); grads agree to bf16 eps.
+        # atol scales with each tensor's magnitude — dW entries are sums
+        # of M bf16-rounded products, so absolute error grows with the
+        # sum's scale, not with unity.
+        np.testing.assert_allclose(lf, lr, rtol=2e-2)
+        for got, want, name in zip(gf, gr, ("da", "dw", "dgamma", "dbeta")):
+            w32 = np.asarray(want, np.float32)
+            np.testing.assert_allclose(
+                np.asarray(got, np.float32), w32,
+                rtol=3e-2, atol=3e-2 * max(np.abs(w32).max(), 1.0),
+                err_msg=name)
+
+    def test_dw_accumulates_across_row_blocks(self):
+        # m=512 with block_m=64 -> 8 sequential grid steps; dW must equal
+        # the single-block answer exactly (f32 accumulation both ways).
+        rng = np.random.default_rng(2)
+        m, k, n = 512, 16, 24
+        a = _rand(rng, (m, k), jnp.float32)
+        w = _rand(rng, (k, n), jnp.float32, 0.3)
+        gamma = jnp.ones((n,), jnp.float32)
+        beta = jnp.zeros((n,), jnp.float32)
+        t = _rand(rng, (m, n), jnp.float32)
+
+        def loss(cfg, a):
+            y, mean, var = fcb.conv1x1_bn_train(cfg, a, w, gamma, beta)
+            return _loss_parts(y, mean, var, t)
+
+        g_many = jax.grad(lambda a: loss((1e-5, 64, True), a))(a)
+        g_one = jax.grad(lambda a: loss((1e-5, 512, True), a))(a)
+        np.testing.assert_allclose(np.asarray(g_many), np.asarray(g_one),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestSupportGate:
+    def test_untileable_m_rejected(self):
+        assert not fcb.supported(9, 16, 16)       # no block divides 9
+        assert fcb.supported(128, 64, 64)
+        assert fcb.supported(25088, 2048, 512)    # layer4 conv1 @ b=512
+
+    def test_vmem_budget_rejects_huge_channels(self):
+        assert not fcb.supported(4096, 4096, 4096)
+
+
+def _unfused_pair(dtype, features, strides=1):
+    conv = nn.Conv(features, (1, 1), (strides, strides), use_bias=False,
+                   dtype=dtype, param_dtype=jnp.float32)
+    bn = nn.BatchNorm(use_running_average=False, momentum=0.9, epsilon=1e-5,
+                      dtype=dtype, param_dtype=jnp.float32)
+    return conv, bn
+
+
+class TestModuleParity:
+    @pytest.mark.parametrize("strides", [1, 2])
+    def test_f32_vs_conv_bn_pair(self, strides):
+        rng = np.random.default_rng(3)
+        k_in, c_out = 12, 20
+        x = _rand(rng, (4, 8, 8, k_in), jnp.float32, 2.0, 0.5)
+        fused = fcb.FusedConvBN(c_out, strides=strides, dtype=jnp.float32)
+        fv = fused.init(jax.random.key(0), x)
+        kernel = fv["params"]["kernel"]
+        scale = jnp.asarray(rng.uniform(0.5, 2.0, c_out), jnp.float32)
+        bias = _rand(rng, (c_out,), jnp.float32)
+        fv = {"params": {"kernel": kernel, "scale": scale, "bias": bias},
+              "batch_stats": fv["batch_stats"]}
+
+        conv, bn = _unfused_pair(jnp.float32, c_out, strides)
+        bv = {"params": {"scale": scale, "bias": bias},
+              "batch_stats": {"mean": jnp.zeros((c_out,)),
+                              "var": jnp.ones((c_out,))}}
+        # Random target decorrelated from the activations: a loss like
+        # sum(y^2) has an ~exactly-zero BN input grad (BN output stats are
+        # invariant), which would make this test compare pure f32
+        # cancellation noise between the two autodiff paths.
+        h_sp = 8 // strides
+        t = _rand(rng, (4, h_sp, h_sp, c_out), jnp.float32)
+
+        def fused_loss(variables):
+            y, mut = fused.apply(variables, x, mutable=["batch_stats"])
+            return jnp.sum(y * t), (y, mut)
+
+        def ref_loss(params):
+            h = conv.apply({"params": params["conv"]}, x)
+            y, mut = bn.apply(
+                {"params": params["bn"], "batch_stats": bv["batch_stats"]},
+                h, mutable=["batch_stats"])
+            return jnp.sum(y * t), (y, mut)
+
+        (lf, (yf, mutf)), gf = jax.value_and_grad(
+            fused_loss, has_aux=True)(fv)
+        (lr, (yr, mutr)), gr = jax.value_and_grad(ref_loss, has_aux=True)(
+            {"conv": {"kernel": kernel},
+             "bn": {"scale": scale, "bias": bias}})
+
+        np.testing.assert_allclose(np.asarray(yf), np.asarray(yr),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(lf, lr, rtol=1e-5)
+        for key in ("mean", "var"):
+            np.testing.assert_allclose(
+                np.asarray(mutf["batch_stats"][key]),
+                np.asarray(mutr["batch_stats"][key]), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(gf["params"]["kernel"]),
+            np.asarray(gr["conv"]["kernel"]), rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(gf["params"]["scale"]),
+            np.asarray(gr["bn"]["scale"]), rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(gf["params"]["bias"]),
+            np.asarray(gr["bn"]["bias"]), rtol=2e-4, atol=2e-4)
+
+    def test_eval_mode_uses_running_stats(self):
+        rng = np.random.default_rng(4)
+        x = _rand(rng, (2, 4, 4, 8), jnp.float32)
+        fused = fcb.FusedConvBN(16, use_running_average=True,
+                                dtype=jnp.float32)
+        v = fused.init(jax.random.key(1), x)
+        v["batch_stats"]["mean"] = _rand(rng, (16,), jnp.float32)
+        v["batch_stats"]["var"] = jnp.asarray(
+            rng.uniform(0.5, 2.0, 16), jnp.float32)
+        y = fused.apply(v, x)
+
+        conv, _ = _unfused_pair(jnp.float32, 16)
+        bn = nn.BatchNorm(use_running_average=True, epsilon=1e-5,
+                          dtype=jnp.float32)
+        h = conv.apply({"params": {"kernel": v["params"]["kernel"]}}, x)
+        y_ref = bn.apply({"params": {"scale": v["params"]["scale"],
+                                     "bias": v["params"]["bias"]},
+                          "batch_stats": v["batch_stats"]}, h)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_untileable_shape_falls_back(self):
+        # 1x3x3 input -> M=9 rows: kernel unsupported, reference path runs.
+        rng = np.random.default_rng(5)
+        x = _rand(rng, (1, 3, 3, 8), jnp.float32)
+        fused = fcb.FusedConvBN(16, dtype=jnp.float32)
+        v = fused.init(jax.random.key(2), x)
+        y, mut = fused.apply(v, x, mutable=["batch_stats"])
+        assert y.shape == (1, 3, 3, 16)
+        assert np.isfinite(np.asarray(y)).all()
+
+
+def _map_bottleneck_params(unf, has_ds):
+    """Unfused Bottleneck param dict -> fused layout (see Bottleneck)."""
+    out = {
+        "FusedConvBN_0": {"kernel": unf["Conv_0"]["kernel"],
+                          "scale": unf["BatchNorm_0"]["scale"],
+                          "bias": unf["BatchNorm_0"]["bias"]},
+        "Conv_0": unf["Conv_1"],
+        "BatchNorm_0": unf["BatchNorm_1"],
+        "FusedConvBN_1": {"kernel": unf["Conv_2"]["kernel"],
+                          "scale": unf["BatchNorm_2"]["scale"],
+                          "bias": unf["BatchNorm_2"]["bias"]},
+    }
+    if has_ds:
+        out["downsample_fused"] = {
+            "kernel": unf["downsample_conv"]["kernel"],
+            "scale": unf["downsample_bn"]["scale"],
+            "bias": unf["downsample_bn"]["bias"]}
+    return out
+
+
+def _map_bottleneck_stats(unf, has_ds):
+    out = {"FusedConvBN_0": unf["BatchNorm_0"],
+           "BatchNorm_0": unf["BatchNorm_1"],
+           "FusedConvBN_1": unf["BatchNorm_2"]}
+    if has_ds:
+        out["downsample_fused"] = unf["downsample_bn"]
+    return out
+
+
+class TestResNetGolden:
+    def test_tiny_resnet50_fused_equals_flax(self):
+        """Full model golden equivalence: loss + param grads of a 2-block
+        bottleneck ResNet under bn='fused' match bn='flax' with the same
+        (mapped) parameters."""
+        from tpuframe.models.resnet import Bottleneck, ResNet
+
+        rng = np.random.default_rng(6)
+        x = _rand(rng, (4, 16, 16, 3), jnp.float32, 1.0)
+        labels = jnp.asarray(rng.integers(0, 4, (4,)), jnp.int32)
+
+        def make(bn):
+            return ResNet(stage_sizes=(1, 1), block_cls=Bottleneck,
+                          num_classes=4, width=8, cifar_stem=True,
+                          dtype=jnp.float32, bn=bn)
+
+        flax_m, fused_m = make("flax"), make("fused")
+        fv = flax_m.init(jax.random.key(3), x, train=True)
+
+        params = dict(fv["params"])
+        stats = dict(fv["batch_stats"])
+        for name, has_ds in (("Bottleneck_0", True), ("Bottleneck_1", True)):
+            params[name] = _map_bottleneck_params(params[name], has_ds)
+            stats[name] = _map_bottleneck_stats(stats[name], has_ds)
+        mapped = {"params": params, "batch_stats": stats}
+
+        def loss(variables, model):
+            logits, mut = model.apply(variables, x, train=True,
+                                      mutable=["batch_stats"])
+            one_hot = jax.nn.one_hot(labels, 4)
+            l = -jnp.mean(jnp.sum(
+                jax.nn.log_softmax(logits) * one_hot, axis=-1))
+            return l, mut
+
+        (lf, mutf), gf = jax.value_and_grad(
+            lambda v: loss(v, flax_m), has_aux=True)(fv)
+        (lz, mutz), gz = jax.value_and_grad(
+            lambda v: loss(v, fused_m), has_aux=True)(mapped)
+
+        np.testing.assert_allclose(lz, lf, rtol=1e-5)
+        # Grad parity through BOTH blocks (incl. the fused downsample):
+        # compare the stem conv grad (flows through everything) and each
+        # mapped 1x1 kernel/scale/bias grad.
+        np.testing.assert_allclose(
+            np.asarray(gz["params"]["stem_conv"]["kernel"]),
+            np.asarray(gf["params"]["stem_conv"]["kernel"]),
+            rtol=5e-4, atol=5e-4)
+        for blk in ("Bottleneck_0", "Bottleneck_1"):
+            fz, ff = gz["params"][blk], gf["params"][blk]
+            np.testing.assert_allclose(
+                np.asarray(fz["FusedConvBN_0"]["kernel"]),
+                np.asarray(ff["Conv_0"]["kernel"]), rtol=5e-4, atol=5e-4)
+            np.testing.assert_allclose(
+                np.asarray(fz["FusedConvBN_1"]["scale"]),
+                np.asarray(ff["BatchNorm_2"]["scale"]),
+                rtol=5e-4, atol=5e-4)
+            np.testing.assert_allclose(
+                np.asarray(fz["downsample_fused"]["bias"]),
+                np.asarray(ff["downsample_bn"]["bias"]),
+                rtol=5e-4, atol=5e-4)
+            # batch_stats updates must match too
+            np.testing.assert_allclose(
+                np.asarray(mutz["batch_stats"][blk]
+                           ["FusedConvBN_1"]["mean"]),
+                np.asarray(mutf["batch_stats"][blk]
+                           ["BatchNorm_2"]["mean"]), rtol=1e-5, atol=1e-6)

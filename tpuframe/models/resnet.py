@@ -52,29 +52,50 @@ class BasicBlock(nn.Module):
 
 
 class Bottleneck(nn.Module):
-    """1x1 → 3x3(stride) → 1x1(4x) — ResNet-50/101/152 block (v1.5)."""
+    """1x1 → 3x3(stride) → 1x1(4x) — ResNet-50/101/152 block (v1.5).
+
+    With ``fused`` set (the ``bn="fused"`` model option), every 1x1
+    conv+BN pair — conv1, conv3 and the downsample, which carry the
+    block's LARGE-channel tensors — goes through
+    :class:`tpuframe.ops.fused_conv_bn.FusedConvBN`, whose pallas
+    backward keeps the BN input-cotangent out of HBM (PERF.md §6.3: the
+    backward's touch count is the byte lever).  The 3x3 stays on the XLA
+    path.
+    """
 
     filters: int
     strides: int
     conv: ModuleDef
     norm: ModuleDef
+    fused: ModuleDef | None = None
 
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (1, 1))(x)
-        y = self.norm()(y)
+        if self.fused is not None:
+            y = self.fused(self.filters)(x)
+        else:
+            y = self.conv(self.filters, (1, 1))(x)
+            y = self.norm()(y)
         y = nn.relu(y)
         y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters * 4, (1, 1))(y)
-        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if self.fused is not None:
+            y = self.fused(self.filters * 4,
+                           scale_init=nn.initializers.zeros)(y)
+        else:
+            y = self.conv(self.filters * 4, (1, 1))(y)
+            y = self.norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
-            residual = self.conv(self.filters * 4, (1, 1),
-                                 (self.strides, self.strides),
-                                 name="downsample_conv")(residual)
-            residual = self.norm(name="downsample_bn")(residual)
+            if self.fused is not None:
+                residual = self.fused(self.filters * 4, strides=self.strides,
+                                      name="downsample_fused")(residual)
+            else:
+                residual = self.conv(self.filters * 4, (1, 1),
+                                     (self.strides, self.strides),
+                                     name="downsample_conv")(residual)
+                residual = self.norm(name="downsample_bn")(residual)
         return nn.relu(residual + y)
 
 
@@ -121,9 +142,13 @@ class ResNet(nn.Module):
     # "flax" = nn.BatchNorm; "folded" = FoldedBatchNorm, whose
     # activation-sized normalize math runs in the compute dtype instead of
     # f32 (the offline HLO census found 74% of activation-sized values in
-    # f32 from the flax BN chain — PERF.md §7).  NOTE: flax auto-naming
-    # keys modules by class (BatchNorm_N vs FoldedBatchNorm_N), so
-    # toggling re-keys the param tree — pick per run, like `remat`.
+    # f32 from the flax BN chain — PERF.md §7).  "fused" = the 1x1
+    # conv+BN pairs in Bottleneck blocks use FusedConvBN's pallas
+    # backward (ops/fused_conv_bn.py), removing the BN input-cotangent's
+    # HBM write + two re-reads — the byte-floor lever (PERF.md §6.3);
+    # Bottleneck-only.  NOTE: flax auto-naming keys modules by class
+    # (BatchNorm_N vs FoldedBatchNorm_N vs FusedConvBN_N), so toggling
+    # re-keys the param tree — pick per run, like `remat`.
     bn: str = "flax"
 
     @nn.compact
@@ -131,19 +156,33 @@ class ResNet(nn.Module):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
                        kernel_init=nn.initializers.variance_scaling(
                            2.0, "fan_out", "normal"))
+        fused = None
         if self.bn == "folded":
             from tpuframe.models.folded_bn import FoldedBatchNorm
 
             norm = partial(FoldedBatchNorm, use_running_average=not train,
                            momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                            param_dtype=jnp.float32)
-        elif self.bn == "flax":
+        elif self.bn in ("flax", "fused"):
             norm = partial(nn.BatchNorm, use_running_average=not train,
                            momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                            param_dtype=jnp.float32)
+            if self.bn == "fused":
+                if self.block_cls is not Bottleneck:
+                    raise ValueError(
+                        "bn='fused' targets the Bottleneck 1x1 convs; "
+                        "BasicBlock models have no 1x1 compute convs")
+                from tpuframe.ops.fused_conv_bn import FusedConvBN
+
+                fused = partial(FusedConvBN,
+                                use_running_average=not train,
+                                momentum=0.9, epsilon=1e-5,
+                                dtype=self.dtype, param_dtype=jnp.float32,
+                                kernel_init=nn.initializers.
+                                variance_scaling(2.0, "fan_out", "normal"))
         else:
             raise ValueError(f"unknown bn {self.bn!r}; "
-                             f"expected 'flax' or 'folded'")
+                             f"expected 'flax', 'folded' or 'fused'")
 
         if self.stem not in ("conv", "space_to_depth"):
             raise ValueError(f"unknown stem {self.stem!r}; "
@@ -171,8 +210,10 @@ class ResNet(nn.Module):
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
+                kw = {"fused": fused} if fused is not None else {}
                 x = block_cls(self.width * 2 ** i, strides, conv, norm,
-                              name=f"{self.block_cls.__name__}_{block_idx}")(x)
+                              name=f"{self.block_cls.__name__}_{block_idx}",
+                              **kw)(x)
                 block_idx += 1
 
         x = jnp.mean(x, axis=(1, 2))  # global average pool

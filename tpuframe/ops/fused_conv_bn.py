@@ -1,0 +1,380 @@
+"""Fused 1x1-conv + BatchNorm backward — the byte-floor pallas kernel.
+
+Why this op exists (PERF.md §6.3/§7.4b): the ResNet-50 train step moves
+143.5 GB/step on-chip (offline AOT census 149.0 GB, 4% apart), ~105 GB of
+it in the backward pass, and the census showed the traffic is STRUCTURAL
+— layouts are fine, folded-BN is a null, remat is negative.  The one
+remaining lever is TOUCH COUNT: XLA's backward for a conv+BN pair
+materializes the BN input-cotangent ``g`` (activation-sized) in HBM and
+re-reads it twice (conv data-grad, conv weight-grad):
+
+    XLA:   pass1 reads (x, dy)          -> BN sums
+           pass2 reads (x, dy) writes g -> BN input grad
+           dgrad reads (g)              -> da
+           wgrad reads (g, a)           -> dW
+           = 9 activation-sized touches
+
+    here:  pass1 reads (x, dy)          -> BN sums  (XLA, fuses to one pass)
+           pass2 reads (a, x, dy) writes da; g lives only in VMEM
+           = 6 activation-sized touches
+
+Every 1x1 conv in a ResNet-50 bottleneck (conv1, conv3, downsample — the
+large-C tensors) is a matmul over ``(N*H*W, Cin) x (Cin, Cout)``, so
+"conv backward" here is two MXU dots per tile: ``da = g @ W^T`` and
+``dW += a^T @ g``, both fed by a ``g`` computed on the fly from the
+folded per-channel BN-backward coefficients
+
+    g = s*dy - u*x + c,   s = gamma*r,  u = gamma*r^2*c2,
+                          c = gamma*r^2*c2*mu - gamma*r*c1,
+    c1 = mean(dy), c2 = mean(dy * xhat), r = rsqrt(var+eps)
+
+(the exact training-mode BN backward, differentiating through the batch
+statistics).  Removing g's write + two reads is 3 activation-sized
+touches per fused pair; summed over ResNet-50's 1x1 convs at batch 512
+that is ~27 GB of the 149 GB census — verified offline by
+``perf/exp_hlo_offline.py BN=fused`` (the AOT cost model counts a pallas
+call as operands+outputs, which for this streaming kernel is the honest
+count).
+
+The 3x3 convs and the stem keep the XLA path: their g tensors are the
+small-C minority of the bytes and an implicit-GEMM halo kernel is not
+worth the risk for them (measured priority, not principle).
+
+Forward is left to XLA (matmul + folded one-FMA normalize, same touch
+count as flax BN); only training-mode backward uses the kernel.  Eval
+mode is a plain affine fold, no custom anything.
+
+Reference parity: the reference's ResNet comes from torchvision
+(SURVEY.md §3a); its conv+BN backward is cuDNN's fused
+``cudnnBatchNormalizationBackwardEx`` + conv grad kernels.  This is the
+TPU-native equivalent of that fusion, not a translation of it.
+
+CPU tests run the kernel under the pallas interpreter
+(tests/test_fused_conv_bn.py): value + gradient parity vs the
+unfused jnp composition, f32 tight / bf16 tolerance, stride-2, module
+parity vs ``nn.Conv + nn.BatchNorm``, and golden-loss equivalence of the
+full ResNet-50 step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Row-block default: 256 rows x up to 2048 channels of bf16 activations
+# keeps the worst ResNet-50 1x1 shape (~K=2048 or N=2048) near ~10 MB of
+# VMEM including the f32 dW accumulator (see _pick_bm).
+DEFAULT_BLOCK_M = 256
+_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def supported(m: int, k: int, n: int, block_m: int = DEFAULT_BLOCK_M) -> bool:
+    """True when the backward kernel's static tiling fits (else callers keep
+    the plain-XLA composition).  M must tile into whole row blocks; K/N are
+    lane/sublane padded by Mosaic but bounded so W + the f32 dW accumulator
+    stay within the VMEM budget."""
+    bm = _pick_bm(m, k, n, block_m)
+    return bm is not None
+
+
+def _pick_bm(m: int, k: int, n: int, block_m: int) -> int | None:
+    if k > 4096 or n > 4096 or k * n * 6 > _VMEM_BUDGET:  # W bf16 + acc f32
+        return None
+    bm = min(block_m, m)
+    while bm >= 8:
+        if bm % 8 == 0 and m % bm == 0 \
+                and _vmem_est(bm, k, n) <= _VMEM_BUDGET:
+            return bm
+        bm //= 2
+    return None
+
+
+def _vmem_est(bm: int, k: int, n: int) -> int:
+    # a + da tiles (bm,K) bf16; x + dy tiles (bm,N) bf16; g (bm,N) f32;
+    # W (K,N) bf16; dW acc (K,N) f32; coef rows negligible.
+    return 2 * (bm * k * 2) + 2 * (bm * n * 2) + bm * n * 4 \
+        + k * n * 2 + k * n * 4
+
+
+# ---------------------------------------------------------------------------
+# backward pass 2: the fused kernel
+# ---------------------------------------------------------------------------
+
+
+def _bwd_kernel(a_ref, w_ref, x_ref, dy_ref, coef_ref,
+                da_ref, dw_ref, dw_acc,
+                *, n_m: int, precision=None):
+    """Grid is (M/bm,), sequential.  coef rows: 0=s, 1=u, 2=c (f32).
+
+    g = s*dy - u*x + c is computed in f32 in VMEM, used by both dots, and
+    never written back; dW accumulates in f32 scratch across the row
+    blocks and is emitted once at the last block.
+    """
+    mi = pl.program_id(0)
+
+    @pl.when(mi == 0)
+    def _init():
+        dw_acc[...] = jnp.zeros_like(dw_acc)
+
+    s = coef_ref[0, :][None, :]                       # [1, N] f32
+    u = coef_ref[1, :][None, :]
+    c = coef_ref[2, :][None, :]
+    x = x_ref[...].astype(jnp.float32)                # [bm, N]
+    dy = dy_ref[...].astype(jnp.float32)
+    g = (s * dy - u * x + c).astype(w_ref.dtype)      # [bm, N] — VMEM only
+
+    da_ref[...] = jax.lax.dot_general(                # g @ W^T   [bm, K]
+        g, w_ref[...], (((1,), (1,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32).astype(da_ref.dtype)
+    dw_acc[...] += jax.lax.dot_general(               # a^T @ g   [K, N]
+        a_ref[...], g, (((0,), (0,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32)
+
+    @pl.when(mi == n_m - 1)
+    def _emit():
+        dw_ref[...] = dw_acc[...]
+
+
+def _sds(like: jax.Array, shape, dtype) -> jax.ShapeDtypeStruct:
+    """Inherit varying-mesh-axes so the op composes with shard_map (same
+    rationale as flash_attention._sds)."""
+    return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
+
+
+def _fused_bwd_matmuls(a2d, w_c, x, dy, coef, *, block_m, interpret,
+                       precision=None):
+    """da, dW for the 1x1 conv given the folded BN-backward coefficients."""
+    m, k = a2d.shape
+    n = x.shape[1]
+    bm = _pick_bm(m, k, n, block_m)
+    assert bm is not None, "caller must gate on supported()"
+    n_m = m // bm
+
+    da, dw = pl.pallas_call(
+        functools.partial(_bwd_kernel, n_m=n_m, precision=precision),
+        grid=(n_m,),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),   # a
+            pl.BlockSpec((k, n), lambda i: (0, 0)),    # W (resident)
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),   # x
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),   # dy
+            pl.BlockSpec((3, n), lambda i: (0, 0)),    # coef rows
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),   # da
+            pl.BlockSpec((k, n), lambda i: (0, 0)),    # dW (emitted last)
+        ],
+        out_shape=[
+            _sds(a2d, (m, k), a2d.dtype),
+            _sds(a2d, (k, n), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((k, n), jnp.float32)],
+        # dW carries across row blocks: the single grid dim is sequential.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(a2d, w_c, x, dy, coef)
+    return da, dw
+
+
+# ---------------------------------------------------------------------------
+# the custom-vjp core: y, mean, var = conv1x1 + train-mode BN
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def conv1x1_bn_train(cfg: tuple, a2d: jax.Array, w: jax.Array,
+                     gamma: jax.Array, beta: jax.Array):
+    """``cfg = (eps, block_m, interpret)`` (hashable statics).
+
+    a2d: [M, K] activations (rows = N*H*W), w: [K, N] f32 params,
+    gamma/beta: [N] f32.  Returns (y [M,N] in a2d.dtype, mean [N] f32,
+    var [N] f32 — biased, flax-style).  The mean/var outputs exist for
+    the running-stats update and are NOT differentiated through
+    (callers must stop_gradient them, as FusedConvBN does; their
+    cotangents are ignored in the backward, matching flax's treatment
+    of running statistics).
+    """
+    y, mean, var, _ = _fwd_math(cfg, a2d, w, gamma, beta)
+    return y, mean, var
+
+
+def _fwd_math(cfg, a2d, w, gamma, beta):
+    eps, _, _ = cfg
+    w_c = w.astype(a2d.dtype)
+    # Conv-as-matmul with f32 MXU accumulation, stored in compute dtype —
+    # the same contract as nn.Conv(dtype=bf16).
+    x = jax.lax.dot_general(a2d, w_c, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32
+                            ).astype(a2d.dtype)
+    # f32 accumulation without f32 materialization (folded_bn.py rationale:
+    # the convert feeds the reduce, only C-sized f32 lands).
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=0)
+    var = jnp.maximum(jnp.mean(jnp.square(xf), axis=0) - jnp.square(mean),
+                      0.0)
+    r = jax.lax.rsqrt(var + eps)
+    aa = gamma.astype(jnp.float32) * r
+    bb = beta.astype(jnp.float32) - mean * aa
+    y = x * aa.astype(x.dtype) + bb.astype(x.dtype)
+    return y, mean, var, x
+
+
+def _core_fwd(cfg, a2d, w, gamma, beta):
+    y, mean, var, x = _fwd_math(cfg, a2d, w, gamma, beta)
+    return (y, mean, var), (a2d, w, x, mean, var, gamma)
+
+
+def _core_bwd(cfg, res, cots):
+    eps, block_m, interpret = cfg
+    a2d, w, x, mean, var, gamma = res
+    dy, _dmean, _dvar = cots          # stats cotangents: see docstring
+    m = a2d.shape[0]
+
+    # Pass 1 (XLA): both BN reductions in one fused pass over (x, dy).
+    r = jax.lax.rsqrt(var + eps)
+    dyf = dy.astype(jnp.float32)
+    xhat = (x.astype(jnp.float32) - mean) * r
+    sum_dy = jnp.sum(dyf, axis=0)
+    sum_dyxhat = jnp.sum(dyf * xhat, axis=0)
+    dgamma = sum_dyxhat
+    dbeta = sum_dy
+
+    # Folded per-channel coefficients for g = s*dy - u*x + c.
+    gf = gamma.astype(jnp.float32)
+    c1 = sum_dy / m
+    c2 = sum_dyxhat / m
+    s = gf * r
+    u = gf * r * r * c2
+    c = u * mean - s * c1
+    coef = jnp.stack([s, u, c])                     # [3, N] f32
+
+    # Pass 2 (pallas): da + dW with g never materialized in HBM.
+    da, dw = _fused_bwd_matmuls(a2d, w.astype(a2d.dtype), x, dy, coef,
+                                block_m=block_m, interpret=interpret)
+    # w is stored f32 and cast to compute dtype inside the fwd; the f32
+    # accumulator already IS the gradient through that cast.
+    return da, dw.astype(w.dtype), dgamma.astype(gamma.dtype), \
+        dbeta.astype(gamma.dtype)
+
+
+conv1x1_bn_train.defvjp(_core_fwd, _core_bwd)
+
+
+def conv1x1_bn_reference(a2d, w, gamma, beta, *, eps):
+    """The unfused jnp composition (matmul -> flax-semantics train BN) the
+    kernel is parity-tested against; differentiable end to end by XLA."""
+    w_c = w.astype(a2d.dtype)
+    x = jax.lax.dot_general(a2d, w_c, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32
+                            ).astype(a2d.dtype)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=0)
+    var = jnp.maximum(jnp.mean(jnp.square(xf), axis=0) - jnp.square(mean),
+                      0.0)
+    r = jax.lax.rsqrt(var + eps)
+    aa = gamma.astype(jnp.float32) * r
+    bb = beta.astype(jnp.float32) - mean * aa
+    y = x * aa.astype(x.dtype) + bb.astype(x.dtype)
+    return y, mean, var
+
+
+# ---------------------------------------------------------------------------
+# flax module: drop-in for a Conv(1x1, no bias) -> BatchNorm pair
+# ---------------------------------------------------------------------------
+
+import flax.linen as nn  # noqa: E402  (after-jax import, flax convention)
+
+
+class FusedConvBN(nn.Module):
+    """1x1 conv (no bias) + BatchNorm with the fused pallas backward.
+
+    Parameter layout: ``kernel`` keeps nn.Conv's ``(1, 1, K, N)`` shape so
+    torchvision-style weight ports map unchanged; ``scale``/``bias`` and
+    the ``batch_stats`` ``mean``/``var`` entries match nn.BatchNorm, so
+    the harness's cross-replica batch-stats averaging (parallel/step.py)
+    applies unmodified.  (Flax auto-naming still re-keys module names vs
+    the unfused pair — same caveat as the ``bn="folded"`` toggle.)
+
+    Strides are handled OUTSIDE the fused core: a strided 1x1 conv is
+    exactly a spatial slice followed by the dense matmul, and the slice's
+    VJP (zero-scatter) stays with XLA.
+    """
+
+    features: int
+    strides: int = 1
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    scale_init: nn.initializers.Initializer = nn.initializers.ones
+    kernel_init: nn.initializers.Initializer = \
+        nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+    block_m: int = DEFAULT_BLOCK_M
+    interpret: bool | None = None     # None = auto (CPU -> interpreter)
+
+    @nn.compact
+    def __call__(self, x):
+        k_in = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init,
+                            (1, 1, k_in, self.features), self.param_dtype)
+        scale = self.param("scale", self.scale_init, (self.features,),
+                           self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (self.features,),
+                          self.param_dtype)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((self.features,),
+                                                  jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((self.features,),
+                                                jnp.float32))
+
+        x = x.astype(self.dtype)
+        if self.strides > 1:
+            x = x[:, ::self.strides, ::self.strides, :]
+        b, h, w_sp, _ = x.shape
+        a2d = x.reshape(b * h * w_sp, k_in)
+        w2d = kernel.reshape(k_in, self.features)
+
+        if self.use_running_average:
+            # Eval: affine fold with running stats — plain XLA.
+            mean, var = ra_mean.value, ra_var.value
+            xx = jax.lax.dot_general(a2d, w2d.astype(self.dtype),
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32
+                                     ).astype(self.dtype)
+            r = jax.lax.rsqrt(var + self.epsilon)
+            aa = scale.astype(jnp.float32) * r
+            bb = bias.astype(jnp.float32) - mean * aa
+            y2d = xx * aa.astype(self.dtype) + bb.astype(self.dtype)
+        else:
+            interpret = (_auto_interpret() if self.interpret is None
+                         else self.interpret)
+            if supported(a2d.shape[0], k_in, self.features, self.block_m) \
+                    and not self.is_initializing():
+                cfg = (float(self.epsilon), int(self.block_m),
+                       bool(interpret))
+                y2d, mean, var = conv1x1_bn_train(cfg, a2d, w2d, scale, bias)
+            else:
+                # Shape outside the kernel's tiling (or init pass): the
+                # reference composition, identical numerics.
+                y2d, mean, var = conv1x1_bn_reference(
+                    a2d, w2d, scale, bias, eps=self.epsilon)
+            if not self.is_initializing():
+                mom = self.momentum
+                ra_mean.value = mom * ra_mean.value + (1 - mom) * \
+                    jax.lax.stop_gradient(mean)
+                ra_var.value = mom * ra_var.value + (1 - mom) * \
+                    jax.lax.stop_gradient(var)
+
+        return y2d.reshape(b, h, w_sp, self.features)
