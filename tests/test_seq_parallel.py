@@ -174,6 +174,116 @@ def test_ring_fully_masked_rows(seq_mesh):
     assert float(np.max(np.abs(got[2:]))) > 0
 
 
+def _run_sharded_novma(fn, mesh, q, k, v, mask):
+    """_run_sharded with shard_map's vma check off: the pallas HLO
+    interpreter's internal slicing mixes varying operands with its own
+    unvarying loop indices, which the check rejects (the error message
+    itself prescribes check_vma=False as the workaround).  CPU-test-only
+    concession — the real-TPU Mosaic lowering doesn't interpret and
+    carries vma via flash_attention._sds."""
+    act = P("data", "seq")
+    specs = (act, act, act, P("data", "seq") if mask is not None else P())
+    mapped = jax.shard_map(fn, mesh=mesh, in_specs=specs, out_specs=act,
+                           check_vma=False)
+    args = [jax.device_put(x, NamedSharding(mesh, s))
+            for x, s in zip((q, k, v), (act,) * 3)]
+    m = (jax.device_put(mask, NamedSharding(mesh, P("data", "seq")))
+         if mask is not None else None)
+    return jax.jit(mapped)(*args, m)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_full(seq_mesh, causal):
+    """impl='pallas': flash-kernel stages + logsumexp merge vs the full
+    reference — the VERDICT round-4 #3 path (ring is the sp fallback when
+    heads don't divide the axis, so it must not be byte-penalized)."""
+    q, k, v = _qkv()
+    mask = None if causal else _padding_mask()
+
+    def fn(q, k, v, m):
+        return seq_parallel.ring_attention(q, k, v, axis="seq", mask=m,
+                                           causal=causal, impl="pallas")
+
+    got = _run_sharded_novma(fn, seq_mesh, q, k, v, mask)
+    want = _reference(q, k, v, mask=mask, causal=causal)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_ring_flash_causal_with_padding_mask(seq_mesh):
+    """Causal + key padding composed: the diagonal stage uses the kernel's
+    tri mask AND the rotated padding mask simultaneously."""
+    q, k, v = _qkv()
+    mask = _padding_mask()
+
+    def fn(q, k, v, m):
+        return seq_parallel.ring_attention(q, k, v, axis="seq", mask=m,
+                                           causal=True, impl="pallas")
+
+    got = _run_sharded_novma(fn, seq_mesh, q, k, v, mask)
+    want = _reference(q, k, v, mask=mask, causal=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_gradients(seq_mesh, causal):
+    """dq/dk/dv through the flash stages, the lse-cotangent fold
+    (flash_attention._flash_lse_vjp_bwd's delta correction) and the
+    stage-merge autodiff must match full attention."""
+    q, k, v = _qkv(b=2, s=32, n=2, d=8)
+    mask = None if causal else _padding_mask(b=2, s=32, seed=3)
+
+    def loss_ring(q, k, v):
+        def fn(q, k, v, m):
+            return seq_parallel.ring_attention(q, k, v, axis="seq", mask=m,
+                                               causal=causal, impl="pallas")
+        act = P("data", "seq")
+        mspec = P("data", "seq") if mask is not None else P()
+        mapped = jax.shard_map(fn, mesh=seq_mesh,
+                               in_specs=(act, act, act, mspec),
+                               out_specs=act, check_vma=False)
+        return jnp.sum(mapped(q, k, v, mask) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(_reference(q, k, v, mask=mask, causal=causal) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ring, g_full, "qkv"):
+        np.testing.assert_allclose(gr, gf, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name}")
+
+
+def test_ring_flash_fully_masked_rows(seq_mesh):
+    """All-padding batch entries: lse stays NEG_INF through every merge
+    and the output is exactly zero (same contract as the XLA path)."""
+    q, k, v = _qkv(b=4, s=64)
+    mask = jnp.concatenate([jnp.zeros((2, 64), jnp.int32),
+                            jnp.ones((2, 64), jnp.int32)])
+
+    def fn(q, k, v, m):
+        return seq_parallel.ring_attention(q, k, v, axis="seq", mask=m,
+                                           impl="pallas")
+
+    got = np.asarray(jax.device_get(
+        _run_sharded_novma(fn, seq_mesh, q, k, v, mask)))
+    np.testing.assert_array_equal(got[:2], np.zeros_like(got[:2]))
+    assert float(np.max(np.abs(got[2:]))) > 0
+
+
+def test_ring_flash_unsupported_shape_falls_back(seq_mesh):
+    """Local chunk not sublane-aligned (s=40 over 4 devices -> c=10): the
+    pallas request silently uses the XLA stages, still exact."""
+    q, k, v = _qkv(s=40)
+
+    def fn(q, k, v, m):
+        return seq_parallel.ring_attention(q, k, v, axis="seq",
+                                           causal=True, impl="pallas")
+
+    got = _run_sharded(fn, seq_mesh, q, k, v, None)
+    want = _reference(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
 def test_ulysses_head_divisibility(seq_mesh):
     q, k, v = _qkv(n=3)  # 3 heads not divisible by seq=4
 

@@ -105,7 +105,7 @@ class CausalSelfAttention(nn.Module):
             mode = "none"  # unmapped run of a seq-parallel config
         if mode == "ring":
             y = seq_parallel.ring_attention(q, k, v, axis=c.seq_axis,
-                                            causal=True)
+                                            causal=True, impl=c.attn_impl)
         elif mode == "ulysses":
             y = seq_parallel.ulysses_attention(q, k, v, axis=c.seq_axis,
                                                causal=True, impl=c.attn_impl)

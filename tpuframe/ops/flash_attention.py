@@ -308,7 +308,7 @@ def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
-               block_q, block_k, interpret, precision=None):
+               block_q, block_k, interpret, precision=None, dlse=None):
     bn, s_q, d = q.shape
     s_kv = k.shape[1]
     bq, bk = min(block_q, s_q), min(block_k, s_kv)
@@ -317,6 +317,13 @@ def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
     # delta_i = rowsum(dO_i * O_i) — tiny elementwise reduce; let XLA fuse it.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, None, :]
+    if dlse is not None:
+        # lse-output cotangent (ring-attention stage merging): with
+        # lse = logsumexp(s) an output, ∂lse/∂s_j = p_j adds dlse·p_j to
+        # ds — i.e. ds = p·(dp - delta + dlse).  Folding it into delta
+        # (delta_eff = delta - dlse) reuses both backward kernels
+        # untouched.
+        delta = delta - dlse[:, None, :].astype(jnp.float32)
     lse3 = lse[:, None, :]
 
     q_spec_qmajor = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
@@ -407,6 +414,69 @@ def _flash_vjp_bwd(causal, block_q, block_k, interpret, precision, res, do):
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, mask, causal, block_q, block_k, interpret, precision):
+    return _flash_fwd(q, k, v, mask, scale=q.shape[-1] ** -0.5,
+                      causal=causal, block_q=block_q, block_k=block_k,
+                      interpret=interpret, precision=precision)
+
+
+def _flash_lse_vjp_fwd(q, k, v, mask, causal, block_q, block_k, interpret,
+                       precision):
+    out, lse = _flash_fwd(q, k, v, mask, scale=q.shape[-1] ** -0.5,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          interpret=interpret, precision=precision)
+    return (out, lse), (q, k, v, mask, out, lse)
+
+
+def _flash_lse_vjp_bwd(causal, block_q, block_k, interpret, precision, res,
+                       cots):
+    q, k, v, mask, out, lse = res
+    do, dlse = cots
+    dq, dk, dv = _flash_bwd(q, k, v, mask, out, lse, do,
+                            scale=q.shape[-1] ** -0.5, causal=causal,
+                            block_q=block_q, block_k=block_k,
+                            interpret=interpret, precision=precision,
+                            dlse=dlse)
+    return dq, dk, dv, None
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+def flash_mha_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                  mask: jax.Array | None = None, causal: bool = False,
+                  block_q: int = DEFAULT_BLOCK_Q,
+                  block_k: int = DEFAULT_BLOCK_K,
+                  interpret: bool | None = None,
+                  precision=None) -> tuple[jax.Array, jax.Array]:
+    """:func:`flash_mha` that also returns the logsumexp rows.
+
+    Returns ``(out [B, S, N, D], lse [B, N, S] f32)``.  The lse output is
+    differentiable (its cotangent folds into the backward's delta), which
+    is what lets ring attention merge per-stage flash results exactly:
+    ``out = Σ_i exp(lse_i - LSE)·out_i`` with both factors carrying
+    gradient.  Fully-masked rows report ``lse = NEG_INF`` and zero
+    output, so they contribute nothing to a merge.
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    if not supported(q, k, block_q, block_k):
+        raise ValueError(
+            f"flash_mha_lse: shapes q={q.shape} k={k.shape} do not tile "
+            f"into block_q={block_q}, block_k={block_k} blocks")
+    b, s_q, n, d = q.shape
+
+    def fold(x):  # [B, S, N, D] → [B*N, S, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * n, x.shape[1], d)
+
+    mask = None if mask is None else mask.astype(jnp.int32)
+    out, lse = _flash_lse(fold(q), fold(k), fold(v), mask, causal,
+                          block_q, block_k, interpret, precision)
+    return (out.reshape(b, n, s_q, d).transpose(0, 2, 1, 3),
+            lse.reshape(b, n, s_q))
 
 
 def flash_mha(q: jax.Array, k: jax.Array, v: jax.Array, *,

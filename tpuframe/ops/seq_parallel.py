@@ -102,7 +102,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    axis: str = "seq",
                    mask: jax.Array | None = None,
                    causal: bool = False,
-                   q_chunk: int | None = 1024) -> jax.Array:
+                   q_chunk: int | None = 1024,
+                   impl: str | None = None) -> jax.Array:
     """Exact attention over a sequence sharded across the ``axis`` ring.
 
     Must be called inside ``shard_map`` with ``axis`` bound.  Per-device
@@ -116,7 +117,36 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     ``q_chunk`` bounds the per-stage score materialization (see
     ``_chunk_attn``); identical results, identical wire traffic — only
     the live f32 score block shrinks.  None disables.
+
+    ``impl`` selects the per-stage attention kernel — explicit argument,
+    else ``TPUFRAME_ATTN_IMPL``, else ``xla``:
+
+      * ``"xla"`` — the chunked einsum stages below (always available).
+      * ``"pallas"`` — each stage is the flash kernel
+        (:func:`tpuframe.ops.flash_attention.flash_mha_lse`); stages
+        merge via logsumexp weights instead of raw (m, l).  The
+        capacity audit (PERF.md §9) found the XLA stages lower-bound
+        ring at ≥2x Ulysses+flash bytes at 32k — and ring is the
+        documented FALLBACK exactly when heads don't divide the sp
+        degree, so the fallback path gets the kernel too.  Causal
+        masking is a stage-level trichotomy (owner below / on / above
+        the diagonal), so above-diagonal stages skip all compute and
+        the diagonal stage reuses the kernel's own block-skipping tri
+        mask.  Unsupported shapes fall back to ``xla`` (same contract
+        as tpuframe.ops.attention).
     """
+    import os
+
+    impl = impl or os.environ.get("TPUFRAME_ATTN_IMPL", "xla")
+    if impl == "pallas":
+        from tpuframe.ops import flash_attention as fa
+
+        if fa.supported(q, k) and (mask is None or mask.ndim == 2):
+            return _ring_flash(q, k, v, axis=axis, mask=mask, causal=causal)
+        impl = "xla"
+    elif impl != "xla":
+        raise ValueError(f"unknown ring attention impl {impl!r}")
+
     n = lax.axis_size(axis)
     my = lax.axis_index(axis)
     b, c, heads, d = q.shape
@@ -176,6 +206,75 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     (acc, m, l, *_), _ = lax.scan(step, init, jnp.arange(n))
     l = l.transpose(0, 2, 1)[..., None]                       # [B, Cq, N, 1]
     return (acc / jnp.where(l == 0.0, 1.0, l)).astype(q.dtype)
+
+
+def _ring_flash(q, k, v, *, axis, mask, causal):
+    """Ring attention with flash-kernel stages (see ring_attention docs).
+
+    Each stage returns the kernel's normalized output plus its logsumexp
+    rows; stages merge exactly via
+
+        LSE' = logaddexp(LSE, lse_i)
+        out' = out·exp(LSE - LSE') + out_i·exp(lse_i - LSE')
+
+    which equals the (acc, m, l) online-softmax merge of the XLA path.
+    Both merge factors carry gradient: flash_mha_lse's backward folds the
+    lse cotangent into its delta rows, so XLA autodiff of this merge +
+    the per-stage custom_vjp is the exact ring backward.  Stages sit
+    under jax.checkpoint like the XLA path — the scan saves only rotated
+    kv chunks, never per-stage kernel residuals.
+    """
+    from tpuframe.ops import flash_attention as fa
+
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    b, c, heads, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    vary = lambda x: lax.pcast(  # noqa: E731
+        x, tuple(jax.typeof(q).vma), to="varying")
+
+    def stage(qq, kk, vv, owner, kmask):
+        def run(causal_flag):
+            def f(_):
+                return fa.flash_mha_lse(qq, kk, vv, mask=kmask,
+                                        causal=causal_flag)
+            return f
+
+        if not causal:
+            return run(False)(None)
+
+        def above(_):
+            # Strictly above the diagonal: nothing attends — no kernel
+            # launch, zero contribution, zero gradient to this kv chunk.
+            return (vary(jnp.zeros((b, c, heads, d), qq.dtype)),
+                    vary(jnp.full((b, heads, c), NEG_INF, jnp.float32)))
+
+        idx = jnp.where(owner < my, 0, jnp.where(owner == my, 1, 2))
+        return lax.switch(idx, [run(False), run(True), above], None)
+
+    def step(carry, i):
+        out_acc, lse_acc, kv_k, kv_v, kv_mask = carry
+        owner = (my - i) % n
+        o_i, lse_i = jax.checkpoint(stage)(q, kv_k, kv_v, owner, kv_mask)
+        lse_new = jnp.logaddexp(lse_acc, lse_i)            # [B, N, C]
+        w1 = jnp.exp(lse_acc - lse_new)
+        w2 = jnp.exp(lse_i - lse_new)
+        t = lambda x: x.transpose(0, 2, 1)[..., None]  # noqa: E731
+        out_acc = out_acc * t(w1) + o_i.astype(jnp.float32) * t(w2)
+        kv_k = lax.ppermute(kv_k, axis, perm)
+        kv_v = lax.ppermute(kv_v, axis, perm)
+        if kv_mask is not None:
+            kv_mask = lax.ppermute(kv_mask, axis, perm)
+        return (out_acc, lse_new, kv_k, kv_v, kv_mask), None
+
+    init = (
+        vary(jnp.zeros((b, c, heads, d), jnp.float32)),
+        vary(jnp.full((b, heads, c), NEG_INF, jnp.float32)),
+        k, v, mask,
+    )
+    (out, _lse, *_), _ = lax.scan(step, init, jnp.arange(n))
+    return out.astype(q.dtype)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
