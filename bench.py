@@ -108,23 +108,37 @@ def _best_recorded() -> float | None:
     return best
 
 
-def _relay_probe(ports=(8083, 8082, 8081)) -> bool | None:
+def _relay_ports() -> tuple[int, ...]:
+    """Relay tunnel ports to probe — ``TPUFRAME_RELAY_PORTS`` (comma-sep)
+    overrides the defaults.  The axon client package exposes no port
+    constant (the :8081-:8083 set appears only in its docstrings), so the
+    defaults are pinned here but operator-overridable rather than
+    silently rotting if the relay layout changes."""
+    raw = os.environ.get("TPUFRAME_RELAY_PORTS", "8083,8082,8081")
+    try:
+        ports = tuple(int(p) for p in raw.split(",") if p.strip())
+    except ValueError:
+        ports = ()
+    return ports or (8083, 8082, 8081)
+
+
+def _relay_probe(ports=None) -> bool | None:
     """Fast health probe of the loopback TPU relay BEFORE importing jax.
 
-    The relay tunnel serves on localhost ports (:8081-:8083); during an
-    outage every one refuses instantly, while a wedged-but-listening relay
-    still accepts TCP.  Returns True (some port accepts), False (all
-    refused), or None (not the loopback-relay environment — nothing to
-    probe).  Advisory only: a False shrinks the import-stage deadline
-    (the tunnel could in principle come up lazily), it never skips the
-    real claim attempt.
+    The relay tunnel serves on localhost ports (see ``_relay_ports``);
+    during an outage every one refuses instantly, while a wedged-but-
+    listening relay still accepts TCP.  Returns True (some port accepts),
+    False (all refused), or None (not the loopback-relay environment —
+    nothing to probe).  Advisory only: a False shrinks the import-stage
+    deadline (the tunnel could in principle come up lazily), it never
+    skips the real claim attempt.
     """
     import socket
 
     if os.environ.get("AXON_LOOPBACK_RELAY") != "1":
         return None
     host = (os.environ.get("PALLAS_AXON_POOL_IPS") or "127.0.0.1").split(",")[0]
-    for port in ports:
+    for port in (ports if ports is not None else _relay_ports()):
         s = socket.socket()
         s.settimeout(2.0)
         try:

@@ -139,6 +139,22 @@ def lm_32k_dp2sp4():
     _lm_long("lm_32k_sp_ring_dp2sp4", 2, 4, 2)
 
 
+def lm_32k_ring_pallas():
+    """Ring attention with FLASH stages (round-5: flash_mha_lse + the
+    logsumexp stage merge) at the same dp2 x sp4 32k shape — the direct
+    A/B against both the xla-stage ring (round-4 row: the >=2x byte
+    penalty) and Ulysses+flash.  Ring is the documented fallback when
+    heads don't divide sp, so its stages must not be byte-penalized."""
+    _lm_long("lm_32k_sp_ring_pallas_dp2sp4", 2, 4, 2,
+             seq_mode="ring", attn_impl="pallas")
+
+
+def lm_long_exact_pallas():
+    """lm_long verbatim (dp1 x sp8, b=8, 32k) with flash ring stages."""
+    _lm_long("lm_long_exact_pallas_dp1sp8", 1, 8, 8,
+             seq_mode="ring", attn_impl="pallas")
+
+
 def lm_32k_ulysses():
     """Ulysses (all-to-all head-resharding) at the same 32k shape —
     the other first-class SP mode, at real scale.  The inner attention
@@ -290,6 +306,12 @@ ENTRIES = {
     "lm_32k_dp2sp4": (lm_32k_dp2sp4, {
         "tag": "lm_32k_sp_ring_dp2sp4", "devices": 8, "seq": 32768,
         "batch": 2}),
+    "lm_32k_ring_pallas": (lm_32k_ring_pallas, {
+        "tag": "lm_32k_sp_ring_pallas_dp2sp4", "devices": 8, "seq": 32768,
+        "batch": 2}),
+    "lm_long_exact_pallas": (lm_long_exact_pallas, {
+        "tag": "lm_long_exact_pallas_dp1sp8", "devices": 8, "seq": 32768,
+        "batch": 8}),
     "lm_32k_ulysses": (lm_32k_ulysses, {
         "tag": "lm_32k_sp_ulysses_pallas_dp2sp4", "devices": 8,
         "seq": 32768, "batch": 2}),
