@@ -556,6 +556,18 @@ def adasum(tree: PyTree, axis: AxisName = "data") -> PyTree:
         # Pre-summed (unvarying) leaves enter the butterfly as identical
         # vectors and come out unchanged — the documented degrade-to-sum;
         # without the cast, ppermute rejects the unvarying operand outright.
+        # Trace-time warning (PORTING.md Adasum caveat 2): statically
+        # detectable, and silent sum-semantics is exactly the surprise a
+        # porting user hits — the harness's local-grads path never does.
+        if name not in jax.typeof(x).vma:
+            import warnings
+
+            warnings.warn(
+                f"adasum over {name!r}: leaf is unvarying (already reduced "
+                f"over the axis) — the butterfly is an identity on it, so "
+                f"you get SUM semantics, not the adaptive combine. Feed "
+                f"adasum the raw per-replica gradients (see PORTING.md).",
+                stacklevel=3)
         v = _vary_over(x.astype(jnp.float32), (name,))
         for k in range(n.bit_length() - 1):
             dist = 1 << k
