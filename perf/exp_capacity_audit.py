@@ -43,13 +43,23 @@ from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results",
                    "offline_ab.jsonl")
 
+# 8-device compile-only topology: "v5e:2x4" (16 GB HBM) by default;
+# TOPO=v4:2x2x2 re-audits every entry against the v4 family (32 GB HBM,
+# the BASELINE.json:5 north-star hardware) — VERDICT r4 #5.
+TOPO = os.environ.get("TOPO", "v5e:2x4")
+
 
 def log(m):
     print(f"[capacity] {m}", file=sys.stderr, flush=True)
 
 
+def _tag(base):
+    return base if TOPO == "v5e:2x4" else (
+        base + "_" + TOPO.replace(":", "_").replace("x", ""))
+
+
 def record(row):
-    row["source"] = "offline AOT v5e topology compile"
+    row["source"] = f"offline AOT {TOPO} topology compile"
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
     print(json.dumps(row), flush=True)
@@ -91,7 +101,7 @@ def _lm_long(tag, data, sp, batch, seq_mode="ring", attn_impl="xla"):
     from tpuframe.parallel import mesh as mesh_lib
     from tpuframe.parallel import step as step_lib
 
-    topo = topologies.get_topology_desc("v5e:2x4", platform="tpu")
+    topo = topologies.get_topology_desc(TOPO, platform="tpu")
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=data, seq=sp),
                               devices=list(topo.devices))
     SEQ = 32768
@@ -126,7 +136,7 @@ def _lm_long(tag, data, sp, batch, seq_mode="ring", attn_impl="xla"):
     # step is already jitted WITH donation; an outer jax.jit would wrap
     # it in a donation-less jit and erase the aliasing from the audit.
     c = step.lower(state, {"input_ids": ids, "labels": ids}).compile()
-    record(_summarize(c, tag, {"devices": 8, "seq": SEQ, "batch": batch}))
+    record(_summarize(c, _tag(tag), {"devices": 8, "seq": SEQ, "batch": batch}))
 
 
 def lm_long_exact():
@@ -176,7 +186,7 @@ def lm_tp_realistic():
     from tpuframe.parallel import step as step_lib
     from tpuframe.parallel import tp as tp_lib
 
-    topo = topologies.get_topology_desc("v5e:2x4", platform="tpu")
+    topo = topologies.get_topology_desc(TOPO, platform="tpu")
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=2, model=4),
                               devices=list(topo.devices))
     model = models.get_model(
@@ -209,7 +219,7 @@ def lm_tp_realistic():
                                     state_shardings=shardings)
     log("compiling TP LM (tp4 x data2, b=8 s=2048)...")
     c = step.lower(state, {"input_ids": ids, "labels": ids}).compile()
-    record(_summarize(c, "lm_tp_tp4data2", {
+    record(_summarize(c, _tag("lm_tp_tp4data2"), {
         "devices": 8, "seq": 2048, "batch": 8}))
 
 
@@ -220,7 +230,7 @@ def lm_pp_realistic():
     from tpuframe.parallel import pp_lm
     from tpuframe.parallel import step as step_lib
 
-    topo = topologies.get_topology_desc("v5e:2x4", platform="tpu")
+    topo = topologies.get_topology_desc(TOPO, platform="tpu")
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=2, pipe=4),
                               devices=list(topo.devices))
     cfg = LMConfig(vocab_size=32000, hidden_size=768, num_layers=12,
@@ -245,7 +255,7 @@ def lm_pp_realistic():
         sharding=NamedSharding(mesh, P(mesh_lib.BATCH_AXES)))
     log("compiling pp LM (pipe4 x data2, 124M-class, b=8 s=2048)...")
     c = step.lower(state, {"input_ids": ids, "labels": ids}).compile()
-    record(_summarize(c, "lm_pp_pipe4data2", {
+    record(_summarize(c, _tag("lm_pp_pipe4data2"), {
         "devices": 8, "seq": 2048, "batch": 8}))
 
 
@@ -258,7 +268,7 @@ def lm_moe_realistic():
     from tpuframe.parallel import step as step_lib
     from tpuframe.parallel import tp as tp_lib
 
-    topo = topologies.get_topology_desc("v5e:2x4", platform="tpu")
+    topo = topologies.get_topology_desc(TOPO, platform="tpu")
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=2, expert=4),
                               devices=list(topo.devices))
     model = models.get_model(
@@ -295,7 +305,7 @@ def lm_moe_realistic():
                                     state_shardings=shardings)
     log("compiling MoE LM (ep4 x data2, 8 experts, b=8 s=2048)...")
     c = step.lower(state, {"input_ids": ids, "labels": ids}).compile()
-    record(_summarize(c, "lm_moe_ep4data2", {
+    record(_summarize(c, _tag("lm_moe_ep4data2"), {
         "devices": 8, "seq": 2048, "batch": 8, "experts": 8}))
 
 

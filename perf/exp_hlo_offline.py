@@ -41,9 +41,13 @@ from jax.experimental import topologies  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
 
 BATCH = int(os.environ.get("B", "512"))
-BN = os.environ.get("BN", "flax")          # flax | folded (PERF.md §7 A/B)
+BN = os.environ.get("BN", "flax")   # flax | folded | fused (PERF.md §7 A/B)
 REMAT = os.environ.get("REMAT", "0") == "1"
 STEM = os.environ.get("STEM", "conv")
+# Compile-only topology target.  "v5e:2x2" = the bench chip's family;
+# "v4:2x2x2" = the north-star v4 family (32 GB HBM/chip, 275 TFLOPs
+# bf16 peak — several v5e capacity verdicts flip there, VERDICT r4 #5).
+TOPO = os.environ.get("TOPO", "v5e:2x2")
 
 
 from _common import hlo_shape_census, hlo_nbytes  # noqa: E402
@@ -58,8 +62,8 @@ def main():
     from tpuframe.models import losses
     from tpuframe.parallel import step as step_lib
 
-    log("building v5e compile-only topology...")
-    topo = topologies.get_topology_desc("v5e:2x2", platform="tpu")
+    log(f"building {TOPO} compile-only topology...")
+    topo = topologies.get_topology_desc(TOPO, platform="tpu")
     dev = topo.devices[0]
     mesh = Mesh(np.array([dev]), ("data",))
     repl = NamedSharding(mesh, P())
@@ -115,6 +119,8 @@ def main():
     suffix = "" if (BN, REMAT, STEM) == ("flax", False, "conv") else (
         f"_{BN}" + ("_remat" if REMAT else "") +
         ("_s2d" if STEM != "conv" else ""))
+    if TOPO != "v5e:2x2":
+        suffix += "_" + TOPO.replace(":", "_").replace("x", "")
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "results", f"resnet_step_hlo_offline{suffix}.txt")
     with open(out_path, "w") as f:
@@ -130,7 +136,7 @@ def main():
                       "gb_per_step": round(byts / 1e9, 2),
                       "mb_per_image": round(byts / BATCH / 1e6, 2),
                       "hlo_chars": len(txt),
-                      "source": "offline AOT v5e topology compile"}))
+                      "source": f"offline AOT {TOPO} topology compile"}))
 
 
 if __name__ == "__main__":
