@@ -32,28 +32,29 @@ def _loss_parts(y, mean, var, t):
 
 
 class TestCoreParity:
-    @pytest.mark.parametrize("m,k,n", [(128, 32, 48), (512, 64, 96)])
-    def test_f32_values_and_grads(self, m, k, n):
+    @pytest.mark.parametrize("b,h,w,k,c", [(4, 8, 8, 12, 20),
+                                           (8, 8, 8, 16, 48)])
+    def test_f32_values_and_grads(self, b, h, w, k, c):
         rng = np.random.default_rng(0)
-        a = _rand(rng, (m, k), jnp.float32, 2.0, 1.0)
-        w = _rand(rng, (k, n), jnp.float32, 0.2)
-        gamma = jnp.asarray(rng.uniform(0.5, 2.0, n), jnp.float32)
-        beta = _rand(rng, (n,), jnp.float32)
-        t = _rand(rng, (m, n), jnp.float32)
-        cfg = (1e-5, 128, True)  # block_m=128 -> multi-block at m=512
+        a = _rand(rng, (b, h, w, k), jnp.float32, 2.0, 1.0)
+        wk = _rand(rng, (k, c), jnp.float32, 0.2)
+        gamma = jnp.asarray(rng.uniform(0.5, 2.0, c), jnp.float32)
+        beta = _rand(rng, (c,), jnp.float32)
+        t = _rand(rng, (b, h, w, c), jnp.float32)
+        cfg = (1e-5, 64, True)  # small row budget -> multi-step grid
 
-        def fused_loss(a, w, g, b):
-            y, mean, var = fcb.conv1x1_bn_train(cfg, a, w, g, b)
+        def fused_loss(a, wk, g, bb):
+            y, mean, var = fcb.conv1x1_bn_train(cfg, a, wk, g, bb)
             return _loss_parts(y, mean, var, t)
 
-        def ref_loss(a, w, g, b):
-            y, mean, var = fcb.conv1x1_bn_reference(a, w, g, b, eps=1e-5)
+        def ref_loss(a, wk, g, bb):
+            y, mean, var = fcb.conv1x1_bn_reference(a, wk, g, bb, eps=1e-5)
             return _loss_parts(y, mean, var, t)
 
         lf, gf = jax.value_and_grad(fused_loss, argnums=(0, 1, 2, 3))(
-            a, w, gamma, beta)
+            a, wk, gamma, beta)
         lr, gr = jax.value_and_grad(ref_loss, argnums=(0, 1, 2, 3))(
-            a, w, gamma, beta)
+            a, wk, gamma, beta)
         np.testing.assert_allclose(lf, lr, rtol=1e-5)
         for got, want, name in zip(gf, gr, ("da", "dw", "dgamma", "dbeta")):
             np.testing.assert_allclose(
@@ -62,26 +63,26 @@ class TestCoreParity:
 
     def test_bf16_values_and_grads(self):
         rng = np.random.default_rng(1)
-        m, k, n = 256, 32, 64
-        a = _rand(rng, (m, k), jnp.bfloat16, 1.0)
-        w = _rand(rng, (k, n), jnp.float32, 0.2)
-        gamma = jnp.asarray(rng.uniform(0.5, 2.0, n), jnp.float32)
-        beta = _rand(rng, (n,), jnp.float32)
-        t = _rand(rng, (m, n), jnp.float32)
+        b, h, w, k, c = 4, 8, 8, 32, 64
+        a = _rand(rng, (b, h, w, k), jnp.bfloat16, 1.0)
+        wk = _rand(rng, (k, c), jnp.float32, 0.2)
+        gamma = jnp.asarray(rng.uniform(0.5, 2.0, c), jnp.float32)
+        beta = _rand(rng, (c,), jnp.float32)
+        t = _rand(rng, (b, h, w, c), jnp.float32)
         cfg = (1e-5, 128, True)
 
-        def fused_loss(a, w, g, b):
-            y, mean, var = fcb.conv1x1_bn_train(cfg, a, w, g, b)
+        def fused_loss(a, wk, g, bb):
+            y, mean, var = fcb.conv1x1_bn_train(cfg, a, wk, g, bb)
             return _loss_parts(y, mean, var, t)
 
-        def ref_loss(a, w, g, b):
-            y, mean, var = fcb.conv1x1_bn_reference(a, w, g, b, eps=1e-5)
+        def ref_loss(a, wk, g, bb):
+            y, mean, var = fcb.conv1x1_bn_reference(a, wk, g, bb, eps=1e-5)
             return _loss_parts(y, mean, var, t)
 
         lf, gf = jax.value_and_grad(fused_loss, argnums=(0, 1, 2, 3))(
-            a, w, gamma, beta)
+            a, wk, gamma, beta)
         lr, gr = jax.value_and_grad(ref_loss, argnums=(0, 1, 2, 3))(
-            a, w, gamma, beta)
+            a, wk, gamma, beta)
         # bf16 activations: both paths quantize at the same points except
         # g (ours rounds once to bf16 in VMEM); grads agree to bf16 eps.
         # atol scales with each tensor's magnitude — dW entries are sums
@@ -95,35 +96,38 @@ class TestCoreParity:
                 rtol=3e-2, atol=3e-2 * max(np.abs(w32).max(), 1.0),
                 err_msg=name)
 
-    def test_dw_accumulates_across_row_blocks(self):
-        # m=512 with block_m=64 -> 8 sequential grid steps; dW must equal
-        # the single-block answer exactly (f32 accumulation both ways).
+    def test_dw_accumulates_across_grid_steps(self):
+        # 8x8 spatial x batch 8 with a 16-row budget -> 32 sequential
+        # steps; dW must equal the single-step answer exactly (f32
+        # accumulation both ways).
         rng = np.random.default_rng(2)
-        m, k, n = 512, 16, 24
-        a = _rand(rng, (m, k), jnp.float32)
-        w = _rand(rng, (k, n), jnp.float32, 0.3)
-        gamma = jnp.ones((n,), jnp.float32)
-        beta = jnp.zeros((n,), jnp.float32)
-        t = _rand(rng, (m, n), jnp.float32)
+        b, h, w, k, c = 8, 8, 8, 16, 24
+        a = _rand(rng, (b, h, w, k), jnp.float32)
+        wk = _rand(rng, (k, c), jnp.float32, 0.3)
+        gamma = jnp.ones((c,), jnp.float32)
+        beta = jnp.zeros((c,), jnp.float32)
+        t = _rand(rng, (b, h, w, c), jnp.float32)
 
         def loss(cfg, a):
-            y, mean, var = fcb.conv1x1_bn_train(cfg, a, w, gamma, beta)
+            y, mean, var = fcb.conv1x1_bn_train(cfg, a, wk, gamma, beta)
             return _loss_parts(y, mean, var, t)
 
-        g_many = jax.grad(lambda a: loss((1e-5, 64, True), a))(a)
-        g_one = jax.grad(lambda a: loss((1e-5, 512, True), a))(a)
+        g_many = jax.grad(lambda a: loss((1e-5, 16, True), a))(a)
+        g_one = jax.grad(lambda a: loss((1e-5, 4096, True), a))(a)
         np.testing.assert_allclose(np.asarray(g_many), np.asarray(g_one),
                                    rtol=1e-5, atol=1e-5)
 
 
 class TestSupportGate:
-    def test_untileable_m_rejected(self):
-        assert not fcb.supported(9, 16, 16)       # no block divides 9
-        assert fcb.supported(128, 64, 64)
-        assert fcb.supported(25088, 2048, 512)    # layer4 conv1 @ b=512
+    def test_resnet_shapes_supported(self):
+        # (spatial, batch, k, c) for the flagship 1x1s at b=512
+        assert fcb.supported(49, 512, 2048, 512)     # layer4 conv1
+        assert fcb.supported(49, 512, 512, 2048)     # layer4 conv3
+        assert fcb.supported(3136, 512, 64, 256)     # layer1 conv3
+        assert fcb.supported(64, 4, 12, 20)          # tiny test shape
 
     def test_vmem_budget_rejects_huge_channels(self):
-        assert not fcb.supported(4096, 4096, 4096)
+        assert not fcb.supported(64, 64, 4096, 4096)
 
 
 def _unfused_pair(dtype, features, strides=1):

@@ -1,12 +1,12 @@
 """Fused 1x1-conv + BatchNorm backward — the byte-floor pallas kernel.
 
 Why this op exists (PERF.md §6.3/§7.4b): the ResNet-50 train step moves
-143.5 GB/step on-chip (offline AOT census 149.0 GB, 4% apart), ~105 GB of
-it in the backward pass, and the census showed the traffic is STRUCTURAL
-— layouts are fine, folded-BN is a null, remat is negative.  The one
-remaining lever is TOUCH COUNT: XLA's backward for a conv+BN pair
-materializes the BN input-cotangent ``g`` (activation-sized) in HBM and
-re-reads it twice (conv data-grad, conv weight-grad):
+143.5 GB/step on-chip (offline AOT census 149.0 GB, 4% apart), ~105 GB
+of it in the backward pass, and the census showed the traffic is
+STRUCTURAL — layouts are fine, folded-BN is a null, remat is negative.
+The one remaining lever is TOUCH COUNT: XLA's backward for a conv+BN
+pair materializes the BN input-cotangent ``g`` (activation-sized) in HBM
+and re-reads it twice (conv data-grad, conv weight-grad):
 
     XLA:   pass1 reads (x, dy)          -> BN sums
            pass2 reads (x, dy) writes g -> BN input grad
@@ -20,29 +20,39 @@ re-reads it twice (conv data-grad, conv weight-grad):
 
 Every 1x1 conv in a ResNet-50 bottleneck (conv1, conv3, downsample — the
 large-C tensors) is a matmul over ``(N*H*W, Cin) x (Cin, Cout)``, so
-"conv backward" here is two MXU dots per tile: ``da = g @ W^T`` and
-``dW += a^T @ g``, both fed by a ``g`` computed on the fly from the
-folded per-channel BN-backward coefficients
+"conv backward" here is two MXU dots per tile fed by a ``g`` computed on
+the fly from the folded per-channel BN-backward coefficients
 
     g = s*dy - u*x + c,   s = gamma*r,  u = gamma*r^2*c2,
                           c = gamma*r^2*c2*mu - gamma*r*c1,
     c1 = mean(dy), c2 = mean(dy * xhat), r = rsqrt(var+eps)
 
 (the exact training-mode BN backward, differentiating through the batch
-statistics).  Removing g's write + two reads is 3 activation-sized
-touches per fused pair; summed over ResNet-50's 1x1 convs at batch 512
-that is ~27 GB of the 149 GB census — verified offline by
-``perf/exp_hlo_offline.py BN=fused`` (the AOT cost model counts a pallas
-call as operands+outputs, which for this streaming kernel is the honest
-count).
+statistics).
+
+LAYOUT CONTRACT (the round-5 lesson, measured): XLA:TPU lays ResNet
+conv activations out as ``{3,0,2,1}`` — physically C on the 128 lanes,
+N on the 8 sublanes, spatial dims major.  A naive ``reshape(N*H*W, C)``
+before a pallas call demands a different physical order, and the
+relayout copies it forces cost MORE than the fusion saves (measured
+136.3 vs 81.4 GB at b=256 for the first cut of this kernel).  So:
+
+  * the FORWARD is a plain ``lax.conv_general_dilated`` + folded BN —
+    byte-identical ops to the unfused model, conv layouts end to end;
+  * the BACKWARD kernel consumes ``[H*W, N, C]`` views, whose default
+    (descending) layout is physically IDENTICAL to ``{3,0,2,1}`` on
+    ``[N,H,W,C]`` — the transpose+reshape at the boundary is a bitcast,
+    not a copy, and rows of the matmul are just a permutation of
+    ``N*H*W`` (BN sums, dW and da are row-order-invariant).
+
+Removing g's write + two reads is 3 activation-sized touches per fused
+pair; verified offline by ``perf/exp_hlo_offline.py BN=fused`` (the AOT
+cost model counts a pallas call as operands+outputs, which for this
+streaming kernel is the honest count).
 
 The 3x3 convs and the stem keep the XLA path: their g tensors are the
 small-C minority of the bytes and an implicit-GEMM halo kernel is not
 worth the risk for them (measured priority, not principle).
-
-Forward is left to XLA (matmul + folded one-FMA normalize, same touch
-count as flax BN); only training-mode backward uses the kernel.  Eval
-mode is a plain affine fold, no custom anything.
 
 Reference parity: the reference's ResNet comes from torchvision
 (SURVEY.md §3a); its conv+BN backward is cuDNN's fused
@@ -50,10 +60,10 @@ Reference parity: the reference's ResNet comes from torchvision
 TPU-native equivalent of that fusion, not a translation of it.
 
 CPU tests run the kernel under the pallas interpreter
-(tests/test_fused_conv_bn.py): value + gradient parity vs the
-unfused jnp composition, f32 tight / bf16 tolerance, stride-2, module
-parity vs ``nn.Conv + nn.BatchNorm``, and golden-loss equivalence of the
-full ResNet-50 step.
+(tests/test_fused_conv_bn.py): value + gradient parity vs the unfused
+composition, f32 tight / bf16 tolerance, stride-2, module parity vs
+``nn.Conv + nn.BatchNorm``, and golden-loss equivalence of the full
+ResNet-50 step.
 """
 
 from __future__ import annotations
@@ -63,13 +73,14 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Row-block default: 256 rows x up to 2048 channels of bf16 activations
-# keeps the worst ResNet-50 1x1 shape (~K=2048 or N=2048) near ~10 MB of
-# VMEM including the f32 dW accumulator (see _pick_bm).
-DEFAULT_BLOCK_M = 256
+# Row budget per grid step (spatial-tile x batch-tile rows): 2048 rows of
+# up-to-2048-wide bf16 activations keeps the worst ResNet-50 1x1 shape
+# near ~10 MB of VMEM including the f32 dW accumulator (see _pick_tiles).
+DEFAULT_BLOCK_ROWS = 2048
 _VMEM_BUDGET = 10 * 1024 * 1024
 
 
@@ -77,32 +88,47 @@ def _auto_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def supported(m: int, k: int, n: int, block_m: int = DEFAULT_BLOCK_M) -> bool:
-    """True when the backward kernel's static tiling fits (else callers keep
-    the plain-XLA composition).  M must tile into whole row blocks; K/N are
-    lane/sublane padded by Mosaic but bounded so W + the f32 dW accumulator
-    stay within the VMEM budget."""
-    bm = _pick_bm(m, k, n, block_m)
-    return bm is not None
+def supported(spatial: int, n: int, k: int, c: int,
+              block_rows: int = DEFAULT_BLOCK_ROWS) -> bool:
+    """True when the backward kernel's static tiling fits (else callers
+    keep the plain-XLA composition).  ``spatial`` = H*W rows per batch
+    element, ``n`` = batch, ``k``/``c`` = in/out channels."""
+    return _pick_tiles(spatial, n, k, c, block_rows) is not None
 
 
-def _pick_bm(m: int, k: int, n: int, block_m: int) -> int | None:
-    if k > 4096 or n > 4096 or k * n * 6 > _VMEM_BUDGET:  # W bf16 + acc f32
+def _pick_tiles(spatial: int, n: int, k: int, c: int,
+                block_rows: int) -> tuple[int, int] | None:
+    """(ts, tn): spatial-tile and batch-tile sizes.  Prefer whole-batch
+    tiles (tn = n) with ts shrinking to fit; shrink tn only for very
+    large batches."""
+    if k > 4096 or c > 4096 or k * c * 6 > _VMEM_BUDGET:  # W bf16 + acc f32
         return None
-    bm = min(block_m, m)
-    while bm >= 8:
-        if bm % 8 == 0 and m % bm == 0 \
-                and _vmem_est(bm, k, n) <= _VMEM_BUDGET:
-            return bm
-        bm //= 2
-    return None
+    tn = n
+    while tn > 8 and (tn > block_rows or n % tn != 0):
+        tn //= 2
+    if n % tn != 0:
+        return None
+    ts = max(1, min(spatial, block_rows // tn))
+    while ts > 1 and spatial % ts != 0:
+        ts -= 1
+    if spatial % ts != 0:
+        return None
+    if _vmem_est(ts * tn, k, c) > _VMEM_BUDGET:
+        # One more shrink round on the batch tile for huge channel counts.
+        while tn > 8 and _vmem_est(ts * tn, k, c) > _VMEM_BUDGET:
+            tn //= 2
+            if n % tn != 0:
+                return None
+        if _vmem_est(ts * tn, k, c) > _VMEM_BUDGET:
+            return None
+    return ts, tn
 
 
-def _vmem_est(bm: int, k: int, n: int) -> int:
-    # a + da tiles (bm,K) bf16; x + dy tiles (bm,N) bf16; g (bm,N) f32;
-    # W (K,N) bf16; dW acc (K,N) f32; coef rows negligible.
-    return 2 * (bm * k * 2) + 2 * (bm * n * 2) + bm * n * 4 \
-        + k * n * 2 + k * n * 4
+def _vmem_est(rows: int, k: int, c: int) -> int:
+    # a + da tiles (rows,K) bf16; x + dy tiles (rows,C) bf16; g (rows,C)
+    # f32; W (K,C) bf16; dW acc (K,C) f32; coef rows negligible.
+    return 2 * (rows * k * 2) + 2 * (rows * c * 2) + rows * c * 4 \
+        + k * c * 2 + k * c * 4
 
 
 # ---------------------------------------------------------------------------
@@ -112,34 +138,40 @@ def _vmem_est(bm: int, k: int, n: int) -> int:
 
 def _bwd_kernel(a_ref, w_ref, x_ref, dy_ref, coef_ref,
                 da_ref, dw_ref, dw_acc,
-                *, n_m: int, precision=None):
-    """Grid is (M/bm,), sequential.  coef rows: 0=s, 1=u, 2=c (f32).
-
-    g = s*dy - u*x + c is computed in f32 in VMEM, used by both dots, and
-    never written back; dW accumulates in f32 scratch across the row
-    blocks and is emitted once at the last block.
+                *, n_s: int, n_n: int, precision=None):
+    """Grid is (S/ts, N/tn), sequential (dW carries).  coef rows:
+    0=s, 1=u, 2=c (f32).  Blocks are [ts, tn, channels]; the leading-dim
+    collapse to [ts*tn, channels] is a sublane-group stack, not a
+    relayout.  g = s*dy - u*x + c is computed in f32 in VMEM, used by
+    both dots, and never written back; dW accumulates in f32 scratch and
+    is emitted once at the last step.
     """
-    mi = pl.program_id(0)
+    si = pl.program_id(0)
+    ni = pl.program_id(1)
 
-    @pl.when(mi == 0)
+    @pl.when(jnp.logical_and(si == 0, ni == 0))
     def _init():
         dw_acc[...] = jnp.zeros_like(dw_acc)
 
-    s = coef_ref[0, :][None, :]                       # [1, N] f32
+    ts, tn, k = a_ref.shape
+    c = x_ref.shape[-1]
+    s = coef_ref[0, :][None, :]                       # [1, C] f32
     u = coef_ref[1, :][None, :]
-    c = coef_ref[2, :][None, :]
-    x = x_ref[...].astype(jnp.float32)                # [bm, N]
-    dy = dy_ref[...].astype(jnp.float32)
-    g = (s * dy - u * x + c).astype(w_ref.dtype)      # [bm, N] — VMEM only
+    cc = coef_ref[2, :][None, :]
+    a = a_ref[...].reshape(ts * tn, k)
+    x = x_ref[...].reshape(ts * tn, c).astype(jnp.float32)
+    dy = dy_ref[...].reshape(ts * tn, c).astype(jnp.float32)
+    g = (s * dy - u * x + cc).astype(w_ref.dtype)     # VMEM only
 
-    da_ref[...] = jax.lax.dot_general(                # g @ W^T   [bm, K]
+    da_ref[...] = jax.lax.dot_general(                # g @ W^T   [rows, K]
         g, w_ref[...], (((1,), (1,)), ((), ())), precision=precision,
-        preferred_element_type=jnp.float32).astype(da_ref.dtype)
-    dw_acc[...] += jax.lax.dot_general(               # a^T @ g   [K, N]
-        a_ref[...], g, (((0,), (0,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32
+    ).astype(da_ref.dtype).reshape(ts, tn, k)
+    dw_acc[...] += jax.lax.dot_general(               # a^T @ g   [K, C]
+        a, g, (((0,), (0,)), ((), ())), precision=precision,
         preferred_element_type=jnp.float32)
 
-    @pl.when(mi == n_m - 1)
+    @pl.when(jnp.logical_and(si == n_s - 1, ni == n_n - 1))
     def _emit():
         dw_ref[...] = dw_acc[...]
 
@@ -150,102 +182,127 @@ def _sds(like: jax.Array, shape, dtype) -> jax.ShapeDtypeStruct:
     return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
 
 
-def _fused_bwd_matmuls(a2d, w_c, x, dy, coef, *, block_m, interpret,
+def _fused_bwd_matmuls(a3, w_c, x3, dy3, coef, *, block_rows, interpret,
                        precision=None):
-    """da, dW for the 1x1 conv given the folded BN-backward coefficients."""
-    m, k = a2d.shape
-    n = x.shape[1]
-    bm = _pick_bm(m, k, n, block_m)
-    assert bm is not None, "caller must gate on supported()"
-    n_m = m // bm
+    """da3, dW given [S, N, C]-view operands and the folded coefficients."""
+    s_sp, n, k = a3.shape
+    c = x3.shape[-1]
+    tiles = _pick_tiles(s_sp, n, k, c, block_rows)
+    assert tiles is not None, "caller must gate on supported()"
+    ts, tn = tiles
+    n_s, n_n = s_sp // ts, n // tn
 
-    da, dw = pl.pallas_call(
-        functools.partial(_bwd_kernel, n_m=n_m, precision=precision),
-        grid=(n_m,),
+    da3, dw = pl.pallas_call(
+        functools.partial(_bwd_kernel, n_s=n_s, n_n=n_n,
+                          precision=precision),
+        grid=(n_s, n_n),
         in_specs=[
-            pl.BlockSpec((bm, k), lambda i: (i, 0)),   # a
-            pl.BlockSpec((k, n), lambda i: (0, 0)),    # W (resident)
-            pl.BlockSpec((bm, n), lambda i: (i, 0)),   # x
-            pl.BlockSpec((bm, n), lambda i: (i, 0)),   # dy
-            pl.BlockSpec((3, n), lambda i: (0, 0)),    # coef rows
+            pl.BlockSpec((ts, tn, k), lambda i, j: (i, j, 0)),   # a
+            pl.BlockSpec((k, c), lambda i, j: (0, 0)),           # W
+            pl.BlockSpec((ts, tn, c), lambda i, j: (i, j, 0)),   # x
+            pl.BlockSpec((ts, tn, c), lambda i, j: (i, j, 0)),   # dy
+            pl.BlockSpec((3, c), lambda i, j: (0, 0)),           # coef
         ],
         out_specs=[
-            pl.BlockSpec((bm, k), lambda i: (i, 0)),   # da
-            pl.BlockSpec((k, n), lambda i: (0, 0)),    # dW (emitted last)
+            pl.BlockSpec((ts, tn, k), lambda i, j: (i, j, 0)),   # da
+            pl.BlockSpec((k, c), lambda i, j: (0, 0)),           # dW (last)
         ],
         out_shape=[
-            _sds(a2d, (m, k), a2d.dtype),
-            _sds(a2d, (k, n), jnp.float32),
+            _sds(a3, (s_sp, n, k), a3.dtype),
+            _sds(a3, (k, c), jnp.float32),
         ],
-        scratch_shapes=[pltpu.VMEM((k, n), jnp.float32)],
-        # dW carries across row blocks: the single grid dim is sequential.
+        scratch_shapes=[pltpu.VMEM((k, c), jnp.float32)],
+        # dW carries across every step: both grid dims are sequential.
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
+            dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(a2d, w_c, x, dy, coef)
-    return da, dw
+    )(a3, w_c, x3, dy3, coef)
+    return da3, dw
+
+
+def _to_snc(x4):
+    """[N, H, W, C] -> [H*W, N, C].  On the conv layout {3,0,2,1} this
+    transpose+reshape is a pure bitcast (see module docstring)."""
+    n, h, w, c = x4.shape
+    return x4.transpose(1, 2, 0, 3).reshape(h * w, n, c)
+
+
+def _from_snc(x3, h, w):
+    """[H*W, N, C] -> [N, H, W, C] (inverse bitcast)."""
+    s_sp, n, c = x3.shape
+    return x3.reshape(h, w, n, c).transpose(2, 0, 1, 3)
 
 
 # ---------------------------------------------------------------------------
-# the custom-vjp core: y, mean, var = conv1x1 + train-mode BN
+# the custom-vjp core: y, mean, var = conv1x1 + train-mode BN (NHWC)
 # ---------------------------------------------------------------------------
+
+
+def _conv1x1(a4, w2, precision=None):
+    """1x1 stride-1 conv via conv_general_dilated — the SAME op (same
+    dtype contract: bf16 in/out, f32 MXU accumulation internally) the
+    unfused flax model runs, so XLA's layout assignment sees nothing
+    new.  No preferred_element_type: its f32 output would poison the
+    VJP's conv dtypes, and flax.nn.Conv doesn't use it either."""
+    return lax.conv_general_dilated(
+        a4, w2[None, None], (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=precision)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def conv1x1_bn_train(cfg: tuple, a2d: jax.Array, w: jax.Array,
+def conv1x1_bn_train(cfg: tuple, a4: jax.Array, w: jax.Array,
                      gamma: jax.Array, beta: jax.Array):
-    """``cfg = (eps, block_m, interpret)`` (hashable statics).
+    """``cfg = (eps, block_rows, interpret)`` (hashable statics).
 
-    a2d: [M, K] activations (rows = N*H*W), w: [K, N] f32 params,
-    gamma/beta: [N] f32.  Returns (y [M,N] in a2d.dtype, mean [N] f32,
-    var [N] f32 — biased, flax-style).  The mean/var outputs exist for
-    the running-stats update and are NOT differentiated through
-    (callers must stop_gradient them, as FusedConvBN does; their
-    cotangents are ignored in the backward, matching flax's treatment
-    of running statistics).
+    a4: [N, H, W, K] activations, w: [K, C] f32 params, gamma/beta: [C]
+    f32.  Returns (y [N,H,W,C] in a4.dtype, mean [C] f32, var [C] f32 —
+    biased, flax-style).  The mean/var outputs exist for the
+    running-stats update and are NOT differentiated through (callers
+    must stop_gradient them, as FusedConvBN does; their cotangents are
+    ignored in the backward, matching flax's treatment of running
+    statistics).
     """
-    y, mean, var, _ = _fwd_math(cfg, a2d, w, gamma, beta)
+    y, mean, var, _ = _fwd_math(cfg, a4, w, gamma, beta)
     return y, mean, var
 
 
-def _fwd_math(cfg, a2d, w, gamma, beta):
+def _fwd_math(cfg, a4, w, gamma, beta):
     eps, _, _ = cfg
-    w_c = w.astype(a2d.dtype)
-    # Conv-as-matmul with f32 MXU accumulation, stored in compute dtype —
-    # the same contract as nn.Conv(dtype=bf16).
-    x = jax.lax.dot_general(a2d, w_c, (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32
-                            ).astype(a2d.dtype)
-    # f32 accumulation without f32 materialization (folded_bn.py rationale:
-    # the convert feeds the reduce, only C-sized f32 lands).
+    x = _conv1x1(a4, w.astype(a4.dtype))
+    # f32 accumulation without f32 materialization (folded_bn.py
+    # rationale: the convert feeds the reduce, only C-sized f32 lands).
+    axes = (0, 1, 2)
     xf = x.astype(jnp.float32)
-    mean = jnp.mean(xf, axis=0)
-    var = jnp.maximum(jnp.mean(jnp.square(xf), axis=0) - jnp.square(mean),
-                      0.0)
-    r = jax.lax.rsqrt(var + eps)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.maximum(jnp.mean(jnp.square(xf), axis=axes)
+                      - jnp.square(mean), 0.0)
+    r = lax.rsqrt(var + eps)
     aa = gamma.astype(jnp.float32) * r
     bb = beta.astype(jnp.float32) - mean * aa
     y = x * aa.astype(x.dtype) + bb.astype(x.dtype)
     return y, mean, var, x
 
 
-def _core_fwd(cfg, a2d, w, gamma, beta):
-    y, mean, var, x = _fwd_math(cfg, a2d, w, gamma, beta)
-    return (y, mean, var), (a2d, w, x, mean, var, gamma)
+def _core_fwd(cfg, a4, w, gamma, beta):
+    y, mean, var, x = _fwd_math(cfg, a4, w, gamma, beta)
+    return (y, mean, var), (a4, w, x, mean, var, gamma)
 
 
 def _core_bwd(cfg, res, cots):
-    eps, block_m, interpret = cfg
-    a2d, w, x, mean, var, gamma = res
+    eps, block_rows, interpret = cfg
+    a4, w, x, mean, var, gamma = res
     dy, _dmean, _dvar = cots          # stats cotangents: see docstring
-    m = a2d.shape[0]
+    n, h, w_sp, c = x.shape
+    m = n * h * w_sp
 
-    # Pass 1 (XLA): both BN reductions in one fused pass over (x, dy).
-    r = jax.lax.rsqrt(var + eps)
+    # Pass 1 (XLA): both BN reductions in one fused pass over (x, dy),
+    # native layout — reductions are layout-agnostic.
+    r = lax.rsqrt(var + eps)
     dyf = dy.astype(jnp.float32)
     xhat = (x.astype(jnp.float32) - mean) * r
-    sum_dy = jnp.sum(dyf, axis=0)
-    sum_dyxhat = jnp.sum(dyf * xhat, axis=0)
+    sum_dy = jnp.sum(dyf, axis=(0, 1, 2))
+    sum_dyxhat = jnp.sum(dyf * xhat, axis=(0, 1, 2))
     dgamma = sum_dyxhat
     dbeta = sum_dy
 
@@ -255,33 +312,32 @@ def _core_bwd(cfg, res, cots):
     c2 = sum_dyxhat / m
     s = gf * r
     u = gf * r * r * c2
-    c = u * mean - s * c1
-    coef = jnp.stack([s, u, c])                     # [3, N] f32
+    cc = u * mean - s * c1
+    coef = jnp.stack([s, u, cc])                    # [3, C] f32
 
-    # Pass 2 (pallas): da + dW with g never materialized in HBM.
-    da, dw = _fused_bwd_matmuls(a2d, w.astype(a2d.dtype), x, dy, coef,
-                                block_m=block_m, interpret=interpret)
+    # Pass 2 (pallas) on [S, N, C] views — bitcasts on the conv layout.
+    da3, dw = _fused_bwd_matmuls(
+        _to_snc(a4), w.astype(a4.dtype), _to_snc(x), _to_snc(dy), coef,
+        block_rows=block_rows, interpret=interpret)
+    da4 = _from_snc(da3, h, w_sp)
     # w is stored f32 and cast to compute dtype inside the fwd; the f32
     # accumulator already IS the gradient through that cast.
-    return da, dw.astype(w.dtype), dgamma.astype(gamma.dtype), \
+    return da4, dw.astype(w.dtype), dgamma.astype(gamma.dtype), \
         dbeta.astype(gamma.dtype)
 
 
 conv1x1_bn_train.defvjp(_core_fwd, _core_bwd)
 
 
-def conv1x1_bn_reference(a2d, w, gamma, beta, *, eps):
-    """The unfused jnp composition (matmul -> flax-semantics train BN) the
+def conv1x1_bn_reference(a4, w, gamma, beta, *, eps):
+    """The unfused composition (1x1 conv -> flax-semantics train BN) the
     kernel is parity-tested against; differentiable end to end by XLA."""
-    w_c = w.astype(a2d.dtype)
-    x = jax.lax.dot_general(a2d, w_c, (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32
-                            ).astype(a2d.dtype)
+    x = _conv1x1(a4, w.astype(a4.dtype))
     xf = x.astype(jnp.float32)
-    mean = jnp.mean(xf, axis=0)
-    var = jnp.maximum(jnp.mean(jnp.square(xf), axis=0) - jnp.square(mean),
-                      0.0)
-    r = jax.lax.rsqrt(var + eps)
+    mean = jnp.mean(xf, axis=(0, 1, 2))
+    var = jnp.maximum(jnp.mean(jnp.square(xf), axis=(0, 1, 2))
+                      - jnp.square(mean), 0.0)
+    r = lax.rsqrt(var + eps)
     aa = gamma.astype(jnp.float32) * r
     bb = beta.astype(jnp.float32) - mean * aa
     y = x * aa.astype(x.dtype) + bb.astype(x.dtype)
@@ -298,7 +354,7 @@ import flax.linen as nn  # noqa: E402  (after-jax import, flax convention)
 class FusedConvBN(nn.Module):
     """1x1 conv (no bias) + BatchNorm with the fused pallas backward.
 
-    Parameter layout: ``kernel`` keeps nn.Conv's ``(1, 1, K, N)`` shape so
+    Parameter layout: ``kernel`` keeps nn.Conv's ``(1, 1, K, C)`` shape so
     torchvision-style weight ports map unchanged; ``scale``/``bias`` and
     the ``batch_stats`` ``mean``/``var`` entries match nn.BatchNorm, so
     the harness's cross-replica batch-stats averaging (parallel/step.py)
@@ -306,8 +362,8 @@ class FusedConvBN(nn.Module):
     the unfused pair — same caveat as the ``bn="folded"`` toggle.)
 
     Strides are handled OUTSIDE the fused core: a strided 1x1 conv is
-    exactly a spatial slice followed by the dense matmul, and the slice's
-    VJP (zero-scatter) stays with XLA.
+    exactly a spatial slice followed by the stride-1 conv, and the
+    slice's VJP (zero-scatter) stays with XLA.
     """
 
     features: int
@@ -320,7 +376,7 @@ class FusedConvBN(nn.Module):
     scale_init: nn.initializers.Initializer = nn.initializers.ones
     kernel_init: nn.initializers.Initializer = \
         nn.initializers.variance_scaling(2.0, "fan_out", "normal")
-    block_m: int = DEFAULT_BLOCK_M
+    block_rows: int = DEFAULT_BLOCK_ROWS
     interpret: bool | None = None     # None = auto (CPU -> interpreter)
 
     @nn.compact
@@ -343,33 +399,29 @@ class FusedConvBN(nn.Module):
         if self.strides > 1:
             x = x[:, ::self.strides, ::self.strides, :]
         b, h, w_sp, _ = x.shape
-        a2d = x.reshape(b * h * w_sp, k_in)
         w2d = kernel.reshape(k_in, self.features)
 
         if self.use_running_average:
-            # Eval: affine fold with running stats — plain XLA.
+            # Eval: conv + affine fold with running stats — plain XLA.
             mean, var = ra_mean.value, ra_var.value
-            xx = jax.lax.dot_general(a2d, w2d.astype(self.dtype),
-                                     (((1,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32
-                                     ).astype(self.dtype)
-            r = jax.lax.rsqrt(var + self.epsilon)
+            xx = _conv1x1(x, w2d.astype(self.dtype))
+            r = lax.rsqrt(var + self.epsilon)
             aa = scale.astype(jnp.float32) * r
             bb = bias.astype(jnp.float32) - mean * aa
-            y2d = xx * aa.astype(self.dtype) + bb.astype(self.dtype)
+            y = xx * aa.astype(self.dtype) + bb.astype(self.dtype)
         else:
             interpret = (_auto_interpret() if self.interpret is None
                          else self.interpret)
-            if supported(a2d.shape[0], k_in, self.features, self.block_m) \
-                    and not self.is_initializing():
-                cfg = (float(self.epsilon), int(self.block_m),
+            if supported(h * w_sp, b, k_in, self.features,
+                         self.block_rows) and not self.is_initializing():
+                cfg = (float(self.epsilon), int(self.block_rows),
                        bool(interpret))
-                y2d, mean, var = conv1x1_bn_train(cfg, a2d, w2d, scale, bias)
+                y, mean, var = conv1x1_bn_train(cfg, x, w2d, scale, bias)
             else:
                 # Shape outside the kernel's tiling (or init pass): the
                 # reference composition, identical numerics.
-                y2d, mean, var = conv1x1_bn_reference(
-                    a2d, w2d, scale, bias, eps=self.epsilon)
+                y, mean, var = conv1x1_bn_reference(
+                    x, w2d, scale, bias, eps=self.epsilon)
             if not self.is_initializing():
                 mom = self.momentum
                 ra_mean.value = mom * ra_mean.value + (1 - mom) * \
@@ -377,4 +429,4 @@ class FusedConvBN(nn.Module):
                 ra_var.value = mom * ra_var.value + (1 - mom) * \
                     jax.lax.stop_gradient(var)
 
-        return y2d.reshape(b, h, w_sp, self.features)
+        return y
