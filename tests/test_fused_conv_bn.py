@@ -120,14 +120,14 @@ class TestCoreParity:
 
 class TestSupportGate:
     def test_resnet_shapes_supported(self):
-        # (spatial, batch, k, c) for the flagship 1x1s at b=512
-        assert fcb.supported(49, 512, 2048, 512)     # layer4 conv1
-        assert fcb.supported(49, 512, 512, 2048)     # layer4 conv3
-        assert fcb.supported(3136, 512, 64, 256)     # layer1 conv3
-        assert fcb.supported(64, 4, 12, 20)          # tiny test shape
+        # (h, w, batch, k, c) for the flagship 1x1s at b=512
+        assert fcb.supported(7, 7, 512, 2048, 512)     # layer4 conv1
+        assert fcb.supported(7, 7, 512, 512, 2048)     # layer4 conv3
+        assert fcb.supported(56, 56, 512, 64, 256)     # layer1 conv3
+        assert fcb.supported(8, 8, 4, 12, 20)          # tiny test shape
 
     def test_vmem_budget_rejects_huge_channels(self):
-        assert not fcb.supported(64, 64, 4096, 4096)
+        assert not fcb.supported(8, 8, 64, 4096, 4096)
 
 
 def _unfused_pair(dtype, features, strides=1):
