@@ -50,6 +50,9 @@ for i in $(seq 1 690); do
     if [ -e perf/STOP ]; then note "STOP sentinel after 4b; not starting 6"; exit 0; fi
     bash perf/run_all_tpu6.sh >> "$LOG" 2>&1
     note "queue 6 exited rc=$?"
+    if [ -e perf/STOP ]; then note "STOP sentinel after 6; not starting 7"; exit 0; fi
+    bash perf/run_all_tpu7.sh >> "$LOG" 2>&1
+    note "queue 7 exited rc=$?"
     note "chain complete; watcher exiting"
     exit 0
   fi

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-5 TPU queue #7 — the fused conv+BN backward A/B (PERF.md §6.3's
+# byte-floor lever, built this round as tpuframe/ops/fused_conv_bn.py).
+#
+# The offline AOT census verifies the BYTE claim without the chip; this
+# queue measures the ms/step consequence on the real v5e:
+#   1. bench with bn=fused at the 256 optimum and at 512
+#   2. fresh unfused runs in the same session (same clock/thermal state)
+# Run AFTER queues 4b/5/6 (chip claim + one-client rules via claim.sh).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p perf/results
+LOG=perf/results/run_all7.log
+echo "=== run_all_tpu7 $(date -u +%FT%TZ) ===" >> "$LOG"
+. perf/claim.sh
+
+note() { echo "[run_all7 $(date -u +%T)] $*" | tee -a "$LOG"; }
+
+claim_wait_for_others | tee -a "$LOG"
+note "phase 0: chip claim"
+if ! claim_chip 96 "$LOG"; then
+  note "claim FAILED; giving up"
+  exit 1
+fi
+
+run() { queue_run "$@"; }
+
+for b in 256 512; do
+  TPUFRAME_BENCH_BATCH=$b TPUFRAME_BENCH_BN=fused \
+      run bench_b${b}_fusedbn 1800 python bench.py
+  TPUFRAME_BENCH_BATCH=$b \
+      run bench_b${b}_ab_unfused 1200 python bench.py
+done
+
+note "queue 7 complete"
