@@ -155,6 +155,14 @@ def ensure_cpu_backend():
     import sys
 
     os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    # Pallas ops auto-interpret when the HOST backend is CPU — but these
+    # scripts compile FOR a TPU topology, and an interpret-mode kernel
+    # lowers as an XLA while loop, not a Mosaic custom call: the census
+    # then measures a program that never runs on chip (discovered
+    # round 5 — the first fused-conv-BN census was full of
+    # FusedConvBN/while loops, and every round-4 offline "pallas" row
+    # has the same defect).  Force real Mosaic lowering.
+    os.environ.setdefault("TPUFRAME_PALLAS_INTERPRET", "0")
     if (os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu")
             or os.environ.get("PALLAS_AXON_POOL_IPS", "")):
         print("re-exec without axon platform...", flush=True)

@@ -41,6 +41,14 @@ _LANES = 128  # VMEM lane width: per-row stats are stored lane-broadcast
 
 
 def _auto_interpret() -> bool:
+    # TPUFRAME_PALLAS_INTERPRET overrides the backend check: the offline
+    # AOT census compiles FOR a TPU topology FROM a CPU host, where the
+    # backend heuristic would silently swap Mosaic kernels for
+    # interpreter while-loops (perf/_common.ensure_cpu_backend sets 0;
+    # round-5 census correction).
+    env = os.environ.get("TPUFRAME_PALLAS_INTERPRET")
+    if env is not None:
+        return env == "1"
     return jax.default_backend() != "tpu"
 
 

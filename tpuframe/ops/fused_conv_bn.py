@@ -85,6 +85,15 @@ _VMEM_BUDGET = 10 * 1024 * 1024
 
 
 def _auto_interpret() -> bool:
+    import os
+
+    # TPUFRAME_PALLAS_INTERPRET overrides the backend check: the offline
+    # AOT census compiles FOR a TPU topology FROM a CPU host, where the
+    # backend heuristic would silently swap Mosaic kernels for
+    # interpreter while-loops (perf/_common.ensure_cpu_backend sets 0).
+    env = os.environ.get("TPUFRAME_PALLAS_INTERPRET")
+    if env is not None:
+        return env == "1"
     return jax.default_backend() != "tpu"
 
 
