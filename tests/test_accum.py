@@ -96,6 +96,7 @@ def test_accum_metrics_and_grad_norm_present():
     assert np.isfinite(float(m["grad_norm"]))
 
 
+@pytest.mark.slow
 def test_accum_bn_model_runs(mesh8):
     """Mutable model state (BN stats) threads through the scan: stats after
     one accum step differ from the initial stats and stay replicated."""

@@ -150,6 +150,7 @@ class TestParity:
 
 
 class TestInResNet:
+    @pytest.mark.slow
     def test_resnet18_forward_backward_folded(self):
         from tpuframe import models
         from tpuframe.models import losses

@@ -58,6 +58,7 @@ def _losses(mesh, use_fsdp, n=3):
     return out, state
 
 
+@pytest.mark.slow
 def test_fsdp_golden_loss_vs_replicated():
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=2, fsdp=4))
     ref, _ = _losses(None, False)

@@ -259,6 +259,7 @@ def _map_bottleneck_stats(unf, has_ds):
 
 
 class TestResNetGolden:
+    @pytest.mark.slow
     def test_tiny_resnet50_fused_equals_flax(self):
         """Full model golden equivalence: loss + param grads of a 2-block
         bottleneck ResNet under bn='fused' match bn='flax' with the same

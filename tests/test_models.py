@@ -80,6 +80,7 @@ class TestResNet:
         logits = model.apply(variables, x)
         assert logits.dtype == jnp.float32
 
+    @pytest.mark.slow
     def test_remat_same_function_same_grads(self):
         """Per-block rematerialization is a schedule change, not a math
         change: outputs, batch-stats updates, and gradients must match the

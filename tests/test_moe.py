@@ -181,6 +181,7 @@ def _moe_losses(mesh_spec, n_steps=3, aux_weight=0.0):
     return out
 
 
+@pytest.mark.slow
 def test_moe_train_step_dp_expert_mesh_golden():
     ref = _moe_losses(None)
     got = _moe_losses(mesh_lib.MeshSpec(data=4, expert=2))

@@ -32,6 +32,7 @@ def _init_state(model, batch, tx):
     return step_lib.TrainState.create(variables["params"], tx)
 
 
+@pytest.mark.slow
 def test_scanblock_lm_full_forward_matches_staged():
     model = ScanBlockLM(_cfg())
     batch = _data()
@@ -47,6 +48,7 @@ def test_scanblock_lm_full_forward_matches_staged():
     np.testing.assert_allclose(np.asarray(out), np.asarray(full), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pp_lm_golden_losses_vs_unsharded():
     model = ScanBlockLM(_cfg())
     batch = _data()
@@ -85,6 +87,7 @@ def test_pp_lm_golden_losses_vs_unsharded():
     assert ref_losses[-1] < ref_losses[0]
 
 
+@pytest.mark.slow
 def test_pp_lm_global_norm_clip_matches_unsharded():
     """pp_clip_by_global_norm: the cross-stage clip must reproduce the
     unsharded optax.clip_by_global_norm trajectory exactly — per-stage
@@ -128,6 +131,7 @@ def test_pp_lm_global_norm_clip_matches_unsharded():
     np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pp_lm_fused_xent_matches_dense():
     """fused_xent=True through the pipeline: the chunked head+loss must
     reproduce the dense pipeline losses step for step (same init/data)."""

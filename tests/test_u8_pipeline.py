@@ -36,12 +36,14 @@ def test_synthetic_u8_stays_u8_through_loader():
     assert batch["image"].dtype == jnp.uint8
 
 
+@pytest.mark.slow
 def test_harness_runs_u8_end_to_end():
     metrics = train_mod.train(_tiny_cfg(keep_u8=True))
     assert metrics["step"] == 2
     assert np.isfinite(metrics["loss"])
 
 
+@pytest.mark.slow
 def test_real_shard_u8_vs_f32_parity(tmp_path):
     """The SAME u8 shard data through both paths — host-normalized f32
     (the default) vs u8-to-device + on-device normalize — must produce
