@@ -123,10 +123,12 @@ def _pick_tiles(h: int, w: int, n: int, k: int, c: int,
 
 
 def _vmem_est(rows: int, k: int, c: int) -> int:
-    # a + da tiles (rows,K) bf16; x + dy tiles (rows,C) bf16; g (rows,C)
-    # f32; W (K,C) bf16; dW acc (K,C) f32; coef rows negligible.
-    return 2 * (rows * k * 2) + 2 * (rows * c * 2) + rows * c * 4 \
-        + k * c * 2 + k * c * 4
+    # Mosaic DOUBLE-BUFFERS every grid-blocked operand/result (a, x, dy,
+    # da — the 2x factor; the real v5e compiler OOM'd at 16 MB VMEM when
+    # this estimate ignored that), plus the f32 g temp on the kernel
+    # stack, the resident W block and the f32 dW accumulator scratch.
+    dbuf = 2 * (2 * (rows * k * 2) + 2 * (rows * c * 2))
+    return dbuf + rows * c * 4 + k * c * 2 + k * c * 4
 
 
 # ---------------------------------------------------------------------------
