@@ -1,0 +1,40 @@
+#!/bin/bash
+# Round-5 OFFLINE queue (no chip needed; AOT flock serializes with any
+# concurrent census).  Order:
+#   1. regenerate every round-4 offline row that contained a pallas op —
+#      they were compiled with the kernels in INTERPRETER mode (XLA while
+#      loops, not Mosaic custom calls; see ensure_cpu_backend) and their
+#      bytes/memory verdicts describe a program that never runs on chip;
+#   2. the new flash-ring capacity rows (ring stages with flash_mha_lse);
+#   3. the v4-family re-audit (TOPO=v4:2x2x2; 32 GB HBM — VERDICT r4 #5)
+#      of the bench census + the flagship capacity entries.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p perf/results
+LOG=perf/results/run_offline_r5.log
+echo "=== run_offline_r5 $(date -u +%FT%TZ) ===" >> "$LOG"
+note() { echo "[offline-r5 $(date -u +%T)] $*" | tee -a "$LOG"; }
+ENV="PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu"
+
+run() { # name cmd...
+  local name=$1; shift
+  note "START $name"
+  env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu timeout 3600 "$@" \
+      > "perf/results/$name.out" 2> "perf/results/$name.err"
+  note "END $name rc=$?"
+}
+
+# 1. round-4 pallas-row regeneration (now real Mosaic lowering)
+run offline_ab_lmxent_r5 python perf/exp_offline_ab.py lm_xent
+run offline_ab_lm8k_r5 python perf/exp_offline_ab.py lm_8k
+run capacity_ulysses_r5 python perf/exp_capacity_audit.py lm_32k_ulysses
+
+# 2. flash-ring capacity rows (new this round)
+run capacity_ring_pallas_r5 python perf/exp_capacity_audit.py lm_32k_ring_pallas
+run capacity_ring_pallas_exact_r5 python perf/exp_capacity_audit.py lm_long_exact_pallas
+
+# 3. v4 family re-audit
+TOPO=v4:2x2x2 run v4_hlo_b512 env TOPO=v4:2x2x2 B=512 python perf/exp_hlo_offline.py
+TOPO=v4:2x2x2 run v4_capacity_all env TOPO=v4:2x2x2 python perf/exp_capacity_audit.py all
+
+note "offline r5 queue complete"
