@@ -197,7 +197,10 @@ def dp32():
 
     from tpuframe.parallel import mesh as mesh_lib
 
-    topo = topologies.get_topology_desc("v5e:4x8", platform="tpu")
+    # TOPO=v4:2x2x4 compiles the same program against the v4-32 north
+    # star (16 chips x 2 TensorCores = 32 devices, BASELINE.json:5).
+    topo = topologies.get_topology_desc(
+        os.environ.get("TOPO", "v5e:4x8"), platform="tpu")
     n = len(topo.devices)
     # The framework mesh (all six axes; only data sized) so the step's
     # default batch partition P(('data','fsdp')) resolves.
@@ -241,7 +244,7 @@ def dp32():
     from _hlo_parse import allreduce_payload
 
     payload, ops = allreduce_payload(txt)
-    record(_analyze(compiled, "resnet50_dp32", {
+    record(_analyze(compiled, "resnet50_dp32" + ("" if os.environ.get("TOPO", "v5e:4x8") == "v5e:4x8" else "_" + os.environ["TOPO"].replace(":", "_").replace("x", "")), {
         "devices": n, "allreduce_ops": ops,
         "allreduce_payload_mb": round(sum(payload.values()) / 1e6, 2),
         "payload_bf16_mb": round(payload["bf16"] / 1e6, 2),

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-5 offline queue, part B: the v4-family passes with the RIGHT
+# slice shapes (v4 exposes 2 devices per chip: v4:2x2x1 = 8 devices for
+# the capacity audit; v4:2x2x4 = 32 devices = the v4-32 north star for
+# the DP-32 program).  Part A's v4:2x2x2 audit run hit 16 devices and
+# recorded honest mesh-mismatch error rows.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p perf/results
+LOG=perf/results/run_offline_r5.log
+note() { echo "[offline-r5b $(date -u +%T)] $*" | tee -a "$LOG"; }
+
+run() { # name cmd...
+  local name=$1; shift
+  note "START $name"
+  env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu timeout 5400 "$@" \
+      > "perf/results/$name.out" 2> "perf/results/$name.err"
+  note "END $name rc=$?"
+}
+
+run v4_capacity_all_b env TOPO=v4:2x2x1 python perf/exp_capacity_audit.py all
+run v4_dp32 env TOPO=v4:2x2x4 python perf/exp_offline_ab.py dp32
+run v4_hlo_b512_fused env TOPO=v4:2x2x2 B=512 BN=fused python perf/exp_hlo_offline.py
+
+note "offline r5b queue complete"

@@ -10,6 +10,7 @@ import io
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpuframe import train as train_mod
 from tpuframe.data import ShardedLoader, datasets
