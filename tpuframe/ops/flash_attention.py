@@ -163,9 +163,14 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref,  # inputs
         l = l_ref[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows → zeros
         o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
-        # logsumexp residual for the backward pass
+        # logsumexp residual for the backward pass.  Stored SUBLANE-major
+        # ([bq, 1] rows, matching the m/l stats' natural orientation): a
+        # lane-major [1, 1, bq] store would need a sublane<->lane
+        # transpose, which Mosaic lowers as tpu.dynamic_gather —
+        # unsupported on v4 ("Sublane gather not supported by this TPU
+        # generation", found by the offline v4 audit, PERF.md §12).
         lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
-        lse_ref[0, 0] = lse[:, 0]
+        lse_ref[0] = lse
 
 
 def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret,
@@ -201,11 +206,11 @@ def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             _sds(q, (bn, s_q, d), q.dtype),
-            _sds(q, (bn, 1, s_q), jnp.float32),
+            _sds(q, (bn, s_q, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -219,7 +224,7 @@ def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
-    return out, lse[:, 0, :]
+    return out, lse[:, :, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -241,7 +246,7 @@ def _recompute_p(q_ref, k_ref, lse_ref, mask_ref, *, scale, need_tri,
         cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         tri = qi * block_q + rows >= kv * block_k + cols
         keep = tri if keep is None else jnp.logical_and(keep, tri)
-    lse = lse_ref[0, 0][:, None]                            # [bq, 1]
+    lse = lse_ref[0]                                        # [bq, 1]
     p = jnp.exp(jnp.where(keep, s, NEG_INF) - lse) if keep is not None \
         else jnp.exp(s - lse)
     if keep is not None:
@@ -267,7 +272,7 @@ def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(                       # dO @ V^T  [bq, bk]
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             precision=precision, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, 0][:, None])        # [bq, bk]
+        ds = p * (dp - delta_ref[0])                    # [bq, bk]
         dq_acc[...] += scale * jax.lax.dot_general(     # ds @ K    [bq, d]
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             precision=precision, preferred_element_type=jnp.float32)
@@ -302,7 +307,7 @@ def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             precision=precision, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, 0][:, None])
+        ds = p * (dp - delta_ref[0])
         dk_acc[...] += scale * jax.lax.dot_general(     # ds^T @ Q  [bk, d]
             ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             precision=precision, preferred_element_type=jnp.float32)
@@ -324,19 +329,19 @@ def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
 
     # delta_i = rowsum(dO_i * O_i) — tiny elementwise reduce; let XLA fuse it.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)[:, None, :]
+                    axis=-1)[:, :, None]
     if dlse is not None:
         # lse-output cotangent (ring-attention stage merging): with
         # lse = logsumexp(s) an output, ∂lse/∂s_j = p_j adds dlse·p_j to
         # ds — i.e. ds = p·(dp - delta + dlse).  Folding it into delta
         # (delta_eff = delta - dlse) reuses both backward kernels
         # untouched.
-        delta = delta - dlse[:, None, :].astype(jnp.float32)
-    lse3 = lse[:, None, :]
+        delta = delta - dlse[:, :, None].astype(jnp.float32)
+    lse3 = lse[:, :, None]
 
     q_spec_qmajor = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
     kv_spec_qmajor = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
-    row_spec_qmajor = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))
+    row_spec_qmajor = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
 
     common = [q, k, v, do, lse3, delta]
 
@@ -368,7 +373,7 @@ def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
     # --- dk/dv: grid (bn, kv blocks, q blocks) ---
     q_spec = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
     kv_spec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
-    row_spec = pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i))
+    row_spec = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0))
     kernel, mspec, margs = with_mask(
         _bwd_dkv_kernel, lambda h, b, j, i: (b // h, 0, j))
     dk, dv = pl.pallas_call(
