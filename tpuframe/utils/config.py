@@ -192,19 +192,18 @@ def _lm_long() -> TrainConfig:
     return TrainConfig(
         name="lm_long", model="transformer-lm",
         # attn_impl="pallas": ring stages run the flash kernel
-        # (flash_mha_lse + logsumexp merge, round 5).  The offline
-        # audit's verdict chain: xla-stage ring at dp1 x sp8 was 17.2 GB
-        # resident/dev — over v5e's 15.75 (round 4); flash stages bring
-        # the same mesh to 15.3 GB (fits) and cut ring bytes from >=2x
-        # to 1.33x of Ulysses+flash (PERF.md §11).  Unsupported shapes
-        # auto-fall back to the xla stages.
+        # (flash_mha_lse + logsumexp merge, round 5), cutting ring bytes
+        # from >=2x to 1.33x of Ulysses+flash (PERF.md §11-§12).
+        # Capacity (offline audit): dp1 x sp8 at 32k is 16.1 GB
+        # resident/dev — still over v5e's 15.75 (fits v4's 32 GB), so
+        # the data=2 default below is mandatory on v5e.  Unsupported
+        # shapes auto-fall back to the xla stages.
         model_kwargs={"seq_mode": "ring", "attn_impl": "pallas",
                       "remat": True,
                       "max_seq": 32768, "vocab_size": 32000},
         dataset="lm_text", dataset_kwargs={"seq_len": 32768},
-        # data=2 stays the default mesh: 15.3 GB/dev at dp1 x sp8 is
-        # only 0.4 GB under the v5e edge; dp2 x sp(-1) keeps margin
-        # (and v4's 32 GB fits either way).
+        # data=2 stays the default mesh: 4.7 GB/dev at dp2 x sp4 —
+        # wide margin on both generations.
         shard_seq=True, mesh=MeshSpec(data=2, seq=-1),
         optimizer="adamw", base_lr=3e-4, scale_lr_by_batch=False,
         warmup_steps=200, schedule="cosine", weight_decay=0.1,
