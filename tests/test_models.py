@@ -279,3 +279,28 @@ class TestSpaceToDepthStem:
         # a superset of the padded 7x7) vs 7*7*3.
         n = lambda v: sum(a.size for a in jax.tree.leaves(v["params"]))  # noqa: E731
         assert n(v_s2d) - n(v_std) == (4 * 4 * 12 - 7 * 7 * 3) * 64
+
+
+class TestDepthVariants:
+    """torchvision-parity depth family: param counts must match the
+    canonical torchvision models exactly (the same oracle style as the
+    ResNet-50 count pin)."""
+
+    @pytest.mark.parametrize("name,expected", [
+        ("resnet34", 21_797_672),
+        ("resnet101", 44_549_160),
+        ("resnet152", 60_192_808),
+    ])
+    def test_param_counts_match_torchvision(self, name, expected):
+        from tpuframe import models
+
+        model = models.get_model(name, num_classes=1000)
+        variables = jax.eval_shape(
+            lambda k: model.init(k, jnp.zeros((1, 224, 224, 3))),
+            jax.random.key(0))
+        n = sum(int(np.prod(p.shape))
+                for p in jax.tree.leaves(variables["params"]))
+        # torchvision counts include the BN affine params; batch_stats are
+        # buffers there, params nowhere — count them separately like the
+        # ResNet-50 pin does.
+        assert n == expected

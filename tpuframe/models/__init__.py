@@ -12,7 +12,8 @@ choices (MXU wants large bf16 matmuls; see task guidance + pallas_guide).
 from typing import Any, Callable
 
 from tpuframe.models.convnet import ConvNet
-from tpuframe.models.resnet import ResNet, ResNet18, ResNet50
+from tpuframe.models.resnet import (ResNet, ResNet18, ResNet34,
+                                    ResNet50, ResNet101, ResNet152)
 from tpuframe.models.bert import BertConfig, BertForSequenceClassification
 from tpuframe.models.transformer_lm import (LMConfig, ScanBlockLM,
                                              TransformerLM)
@@ -52,7 +53,10 @@ _transformer_lm_pp = _lm_adapter(ScanBlockLM)
 _REGISTRY: dict[str, Callable[..., Any]] = {
     "convnet": ConvNet,
     "resnet18": ResNet18,
+    "resnet34": ResNet34,
     "resnet50": ResNet50,
+    "resnet101": ResNet101,
+    "resnet152": ResNet152,
     "bert-base": _bert_base,
     "transformer-lm": _transformer_lm,
     "transformer-lm-pp": _transformer_lm_pp,
@@ -73,7 +77,10 @@ __all__ = [
     "TransformerLM",
     "ResNet",
     "ResNet18",
+    "ResNet34",
     "ResNet50",
+    "ResNet101",
+    "ResNet152",
     "BertConfig",
     "BertForSequenceClassification",
     "get_model",

@@ -238,3 +238,31 @@ def ResNet50(num_classes: int = 1000, *, cifar_stem: bool = False,
     return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck,
                   num_classes=num_classes, cifar_stem=cifar_stem, dtype=dtype,
                   stem=stem, remat=remat, bn=bn)
+
+
+def ResNet101(num_classes: int = 1000, *, cifar_stem: bool = False,
+              dtype: jnp.dtype = jnp.float32, stem: str = "conv",
+              remat: bool = False, bn: str = "flax") -> ResNet:
+    """torchvision-parity depth variant (same v1.5 bottleneck family the
+    reference pulls from torchvision; SURVEY.md §3a)."""
+    return ResNet(stage_sizes=(3, 4, 23, 3), block_cls=Bottleneck,
+                  num_classes=num_classes, cifar_stem=cifar_stem, dtype=dtype,
+                  stem=stem, remat=remat, bn=bn)
+
+
+def ResNet152(num_classes: int = 1000, *, cifar_stem: bool = False,
+              dtype: jnp.dtype = jnp.float32, stem: str = "conv",
+              remat: bool = False, bn: str = "flax") -> ResNet:
+    """torchvision-parity depth variant (see ResNet101)."""
+    return ResNet(stage_sizes=(3, 8, 36, 3), block_cls=Bottleneck,
+                  num_classes=num_classes, cifar_stem=cifar_stem, dtype=dtype,
+                  stem=stem, remat=remat, bn=bn)
+
+
+def ResNet34(num_classes: int = 1000, *, cifar_stem: bool = False,
+             dtype: jnp.dtype = jnp.float32, remat: bool = False,
+             bn: str = "flax") -> ResNet:
+    """torchvision-parity depth variant of the BasicBlock family."""
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock,
+                  num_classes=num_classes, cifar_stem=cifar_stem, dtype=dtype,
+                  remat=remat, bn=bn)
