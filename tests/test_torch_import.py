@@ -1,0 +1,90 @@
+"""torchvision ResNet checkpoint import (tpuframe/models/torch_import.py).
+
+torchvision itself is not in the image, so the oracle is structural: the
+export/import pair must be a bijection on the full variable tree, the
+exported key set must be exactly torchvision's naming scheme, and a
+synthetic state_dict built with torch tensors must round-trip through
+the importer with the conv/fc layout transforms applied.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuframe import models
+from tpuframe.models import torch_import as ti
+
+
+def _init(model, size=32):
+    return model.init(jax.random.key(0), jnp.zeros((1, size, size, 3)))
+
+
+@pytest.mark.parametrize("name", ["resnet18", "resnet50"])
+def test_roundtrip_bijection(name):
+    model = models.get_model(name, num_classes=10, cifar_stem=False)
+    v = _init(model)
+    sd = ti.export_torchvision_resnet(v)
+    v2 = ti.load_torchvision_resnet(v, sd)
+    flat1 = ti._flat(v["params"]) | {
+        "s/" + k: x for k, x in ti._flat(v["batch_stats"]).items()}
+    flat2 = ti._flat(v2["params"]) | {
+        "s/" + k: x for k, x in ti._flat(v2["batch_stats"]).items()}
+    assert set(flat1) == set(flat2)
+    for k in flat1:
+        np.testing.assert_array_equal(np.asarray(flat1[k]),
+                                      np.asarray(flat2[k]), err_msg=k)
+
+
+def test_key_names_match_torchvision_scheme():
+    model = models.get_model("resnet50", num_classes=1000, cifar_stem=False)
+    sd = ti.export_torchvision_resnet(_init(model))
+    # Spot-pin canonical torchvision keys incl. stage boundaries and the
+    # downsample entries only stage-opening blocks have.
+    for key in ("conv1.weight", "bn1.running_var",
+                "layer1.0.conv3.weight", "layer1.0.downsample.0.weight",
+                "layer1.0.downsample.1.running_mean",
+                "layer1.2.bn3.bias",
+                "layer2.0.downsample.0.weight", "layer2.3.conv2.weight",
+                "layer3.5.bn1.weight", "layer4.2.conv3.weight",
+                "fc.weight", "fc.bias"):
+        assert key in sd, key
+    assert "layer1.1.downsample.0.weight" not in sd  # non-opening block
+    # torchvision resnet50: 1 stem + 48 block convs + 4 downsamples = 53.
+    assert sum(1 for k in sd if k.endswith("conv1.weight")
+               or k.endswith("conv2.weight") or k.endswith("conv3.weight")
+               or k == "conv1.weight") == 49
+    assert sum(1 for k in sd if k.endswith("downsample.0.weight")) == 4
+
+
+def test_torch_tensor_state_dict_with_layout_transforms():
+    torch = pytest.importorskip("torch")
+    model = models.get_model("resnet18", num_classes=4, cifar_stem=False)
+    v = _init(model)
+    sd_np = ti.export_torchvision_resnet(v)
+    sd_t = {k: torch.from_numpy(np.ascontiguousarray(x))
+            for k, x in sd_np.items()}
+    # Perturb one conv deterministically in TORCH layout (OIHW); the
+    # importer must land it transposed in the flax kernel (HWIO).
+    w = sd_t["layer1.0.conv1.weight"]
+    sd_t["layer1.0.conv1.weight"] = torch.arange(
+        w.numel(), dtype=torch.float32).reshape(w.shape)
+    v2 = ti.load_torchvision_resnet(v, sd_t)
+    got = np.asarray(v2["params"]["BasicBlock_0"]["Conv_0"]["kernel"])
+    want = np.arange(w.numel(), dtype=np.float32).reshape(
+        tuple(w.shape)).transpose(2, 3, 1, 0)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_missing_and_mismatched_keys_raise():
+    model = models.get_model("resnet18", num_classes=4, cifar_stem=False)
+    v = _init(model)
+    sd = ti.export_torchvision_resnet(v)
+    broken = dict(sd)
+    del broken["layer2.0.downsample.0.weight"]
+    with pytest.raises(KeyError, match="downsample"):
+        ti.load_torchvision_resnet(v, broken)
+    wrong = dict(sd)
+    wrong["fc.weight"] = np.zeros((7, 3), np.float32)
+    with pytest.raises(ValueError, match="fc.weight"):
+        ti.load_torchvision_resnet(v, wrong)

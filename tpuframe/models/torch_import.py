@@ -1,0 +1,201 @@
+"""torchvision ResNet checkpoint import (SURVEY.md §3a "Model defs").
+
+The reference takes its ResNets straight from torchvision
+(``torchvision.models.resnet50(pretrained=...)``), so a switching user
+arrives with torch ``state_dict`` checkpoints.  This maps them onto the
+flax trees of :mod:`tpuframe.models.resnet` — same spirit as
+``bert.load_hf_weights`` for HF BERT.
+
+Name mapping (torchvision → tpuframe):
+
+    conv1.weight                  → params/stem_conv/kernel   (OIHW→HWIO)
+    bn1.{weight,bias}             → params/stem_bn/{scale,bias}
+    bn1.running_{mean,var}        → batch_stats/stem_bn/{mean,var}
+    layer{L}.{i}.conv{j}.weight   → params/<Block>_{n}/Conv_{j-1}/kernel
+    layer{L}.{i}.bn{j}.*          → .../<Block>_{n}/BatchNorm_{j-1}/*
+    layer{L}.{i}.downsample.0/1.* → .../downsample_conv, downsample_bn
+    fc.{weight,bias}              → params/Dense_0/{kernel,bias} (.T)
+
+where ``n`` is the cumulative block index (flax auto-naming is flat
+across stages) and ``<Block>`` is ``Bottleneck``/``BasicBlock``.
+
+Dtype/layout transforms: conv ``[O, I, kH, kW] → [kH, kW, I, O]``; fc
+``[out, in] → [in, out]``; everything cast to the destination leaf's
+dtype.  ``num_batches_tracked`` buffers are ignored (tpuframe tracks no
+step counter in BN).
+
+Forward-parity caveat: torchvision's ImageNet preprocessing normalizes
+with its mean/std on NCHW float tensors; tpuframe's pipelines are NHWC —
+imported weights expect the SAME normalization values the torch model
+was trained with (the imagenet builder's defaults match torchvision's).
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _t(x):
+    return np.asarray(x)  # torch tensors support __array__ (CPU)
+
+
+def _block_prefix(variables) -> str:
+    names = {k.split("/")[0] for k in _flat(variables["params"])}
+    for cand in ("Bottleneck", "BasicBlock"):
+        if any(n.startswith(cand + "_") for n in names):
+            return cand
+    raise ValueError("variables do not look like a tpuframe ResNet "
+                     f"(top-level params: {sorted(names)[:8]}...)")
+
+
+def _flat(tree, prefix=""):
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flat(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def _unflatten(flat):
+    tree: dict = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def _stage_block_index(params_flat, block) -> dict[tuple[int, int], int]:
+    """(layer, i) → cumulative flax block index, from the param tree's own
+    block count per stage (channel widths identify the stage)."""
+    n_blocks = len({k.split("/")[0] for k in params_flat
+                    if k.startswith(block + "_")})
+    # A new stage opens at block 0 and at every block carrying a
+    # downsample conv (stage-opening blocks are exactly the shape-changing
+    # ones; v1.5 Bottleneck layer1.0 downsamples too — channel expansion —
+    # while BasicBlock layer1.0 doesn't, and both cases are covered by
+    # the n == 0 clause).
+    mapping = {}
+    layer, i = 1, 0
+    for n in range(n_blocks):
+        has_ds = f"{block}_{n}/downsample_conv/kernel" in params_flat
+        if n > 0 and has_ds:
+            layer += 1
+            i = 0
+        mapping[(layer, i)] = n
+        i += 1
+    return mapping
+
+
+def load_torchvision_resnet(variables: dict, state_dict: dict) -> dict:
+    """Return a new ``{"params", "batch_stats"}`` tree with every leaf
+    replaced from the torchvision ``state_dict``.  Raises KeyError on a
+    missing source tensor and ValueError on a shape mismatch — silent
+    partial imports are how wrong checkpoints sneak into runs."""
+    block = _block_prefix(variables)
+    params = _flat(variables["params"])
+    stats = _flat(variables["batch_stats"])
+    idx = _stage_block_index(params, block)
+
+    def conv(w):
+        return _t(w).transpose(2, 3, 1, 0)  # OIHW → HWIO
+
+    out_p, out_s = {}, {}
+
+    def put_p(dst, src_name, transform=lambda x: _t(x)):
+        if src_name not in state_dict:
+            raise KeyError(f"state_dict missing {src_name!r} (for {dst})")
+        v = transform(state_dict[src_name])
+        ref = params[dst]
+        if tuple(v.shape) != tuple(ref.shape):
+            raise ValueError(f"{src_name} -> {dst}: shape {v.shape} != "
+                             f"{tuple(ref.shape)}")
+        out_p[dst] = jnp.asarray(v, ref.dtype)
+
+    def put_s(dst, src_name):
+        if src_name not in state_dict:
+            raise KeyError(f"state_dict missing {src_name!r} (for {dst})")
+        v = _t(state_dict[src_name])
+        ref = stats[dst]
+        if tuple(v.shape) != tuple(ref.shape):
+            raise ValueError(f"{src_name} -> {dst}: shape {v.shape} != "
+                             f"{tuple(ref.shape)}")
+        out_s[dst] = jnp.asarray(v, ref.dtype)
+
+    def bn(dst_mod, src_mod):
+        put_p(f"{dst_mod}/scale", f"{src_mod}.weight")
+        put_p(f"{dst_mod}/bias", f"{src_mod}.bias")
+        put_s(f"{dst_mod}/mean", f"{src_mod}.running_mean")
+        put_s(f"{dst_mod}/var", f"{src_mod}.running_var")
+
+    put_p("stem_conv/kernel", "conv1.weight", conv)
+    bn("stem_bn", "bn1")
+
+    convs_per_block = 3 if block == "Bottleneck" else 2
+    for (layer, i), n in sorted(idx.items()):
+        tv = f"layer{layer}.{i}"
+        fx = f"{block}_{n}"
+        for j in range(1, convs_per_block + 1):
+            put_p(f"{fx}/Conv_{j-1}/kernel", f"{tv}.conv{j}.weight", conv)
+            bn(f"{fx}/BatchNorm_{j-1}", f"{tv}.bn{j}")
+        if f"{fx}/downsample_conv/kernel" in params:
+            put_p(f"{fx}/downsample_conv/kernel",
+                  f"{tv}.downsample.0.weight", conv)
+            bn(f"{fx}/downsample_bn", f"{tv}.downsample.1")
+
+    put_p("Dense_0/kernel", "fc.weight", lambda w: _t(w).T)
+    put_p("Dense_0/bias", "fc.bias")
+
+    missing = set(params) - set(out_p)
+    if missing:
+        raise ValueError(f"import left params unset: {sorted(missing)[:6]}")
+    missing_s = set(stats) - set(out_s)
+    if missing_s:
+        raise ValueError(f"import left stats unset: {sorted(missing_s)[:6]}")
+    return {"params": _unflatten(out_p), "batch_stats": _unflatten(out_s)}
+
+
+def export_torchvision_resnet(variables: dict) -> dict:
+    """Inverse of :func:`load_torchvision_resnet` (numpy state_dict) —
+    lets tpuframe-trained ResNets go BACK to torch eval stacks, and
+    makes the import testable as a bijection without torchvision."""
+    block = _block_prefix(variables)
+    params = _flat(variables["params"])
+    stats = _flat(variables["batch_stats"])
+    idx = _stage_block_index(params, block)
+    sd = {}
+
+    def conv_back(w):
+        return np.asarray(w).transpose(3, 2, 0, 1)  # HWIO → OIHW
+
+    def bn_back(src_mod, dst_mod):
+        sd[f"{dst_mod}.weight"] = np.asarray(params[f"{src_mod}/scale"])
+        sd[f"{dst_mod}.bias"] = np.asarray(params[f"{src_mod}/bias"])
+        sd[f"{dst_mod}.running_mean"] = np.asarray(stats[f"{src_mod}/mean"])
+        sd[f"{dst_mod}.running_var"] = np.asarray(stats[f"{src_mod}/var"])
+
+    sd["conv1.weight"] = conv_back(params["stem_conv/kernel"])
+    bn_back("stem_bn", "bn1")
+    convs_per_block = 3 if block == "Bottleneck" else 2
+    for (layer, i), n in sorted(idx.items()):
+        tv = f"layer{layer}.{i}"
+        fx = f"{block}_{n}"
+        for j in range(1, convs_per_block + 1):
+            sd[f"{tv}.conv{j}.weight"] = conv_back(
+                params[f"{fx}/Conv_{j-1}/kernel"])
+            bn_back(f"{fx}/BatchNorm_{j-1}", f"{tv}.bn{j}")
+        if f"{fx}/downsample_conv/kernel" in params:
+            sd[f"{tv}.downsample.0.weight"] = conv_back(
+                params[f"{fx}/downsample_conv/kernel"])
+            bn_back(f"{fx}/downsample_bn", f"{tv}.downsample.1")
+    sd["fc.weight"] = np.asarray(params["Dense_0/kernel"]).T
+    sd["fc.bias"] = np.asarray(params["Dense_0/bias"])
+    return sd
