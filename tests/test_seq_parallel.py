@@ -174,6 +174,15 @@ def test_ring_fully_masked_rows(seq_mesh):
     assert float(np.max(np.abs(got[2:]))) > 0
 
 
+@pytest.fixture(autouse=True)
+def _force_ring_flash_interpreter(monkeypatch):
+    """The flash-ring tests exercise the kernel path UNDER the
+    interpreter (that is the point of the CPU suite); the production
+    guard in ring_attention would otherwise silently fall back to the
+    XLA stages off-TPU."""
+    monkeypatch.setenv("TPUFRAME_RING_FLASH_INTERPRET", "1")
+
+
 def _run_sharded_novma(fn, mesh, q, k, v, mask):
     """_run_sharded with shard_map's vma check off: the pallas HLO
     interpreter's internal slicing mixes varying operands with its own

@@ -141,7 +141,19 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if impl == "pallas":
         from tpuframe.ops import flash_attention as fa
 
-        if fa.supported(q, k) and (mask is None or mask.ndim == 2):
+        # Interpreter guard: the pallas HLO interpreter's internal
+        # slicing trips shard_map's vma check (see the CPU tests'
+        # check_vma=False concession), so a config that requests pallas
+        # ring stages quietly keeps the numerically-identical XLA stages
+        # when the kernel would interpret (CPU harness runs, dryrun) —
+        # real-TPU and offline-AOT contexts lower Mosaic and take the
+        # flash path.  TPUFRAME_RING_FLASH_INTERPRET=1 forces the flash
+        # stages under the interpreter (the kernel tests do, with
+        # check_vma=False shard_maps).
+        interpreting = fa._auto_interpret()
+        forced = os.environ.get("TPUFRAME_RING_FLASH_INTERPRET") == "1"
+        if fa.supported(q, k) and (mask is None or mask.ndim == 2) \
+                and (not interpreting or forced):
             return _ring_flash(q, k, v, axis=axis, mask=mask, causal=causal)
         impl = "xla"
     elif impl != "xla":
