@@ -213,3 +213,51 @@ def test_fusion_threshold_on_v5e_combiner_owns_fusion():
     # The program-level knob is still visible as the operand structure.
     assert tensors_leaf > tensors_packed >= 1, (tensors_leaf,
                                                 tensors_packed)
+
+
+def test_flash_mha_lse_fwd_bwd_compiles_for_v5e():
+    """The ring-stage variant (round 5): lse output + its cotangent fold.
+    Guards the Mosaic lowering of the lse path the capacity audit's
+    flash-ring rows depend on."""
+    out = _run("""
+        from tpuframe.ops.flash_attention import flash_mha_lse
+        dev = topo.devices[0]
+        mesh = Mesh(np.array([dev]), ("d",))
+        sh = NamedSharding(mesh, P())
+        q = jax.ShapeDtypeStruct((2, 512, 4, 64), jnp.bfloat16, sharding=sh)
+
+        def loss(q, k, v):
+            out, lse = flash_mha_lse(q, k, v, causal=True, interpret=False)
+            # lse participates so its cotangent path compiles too.
+            return out.astype(jnp.float32).sum() + (lse * 0.5).sum()
+
+        c = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, q, q).compile()
+        txt = c.as_text()
+        assert "tpu_custom_call" in txt or "custom-call" in txt, txt[:2000]
+        print("FA-lse fwd+bwd Mosaic compile OK")
+    """)
+    assert "Mosaic compile OK" in out
+
+
+def test_flash_attention_compiles_for_v4_target():
+    """v4-generation Mosaic guard (PERF.md §12.1): the lse/delta rows must
+    stay sublane-major — a lane-major layout lowers as tpu.dynamic_gather,
+    which v4 rejects ('Sublane gather not supported').  This compile
+    catches any regression without v4 hardware."""
+    out = _run("""
+        from tpuframe.ops.flash_attention import flash_mha, flash_mha_lse
+        topo4 = topologies.get_topology_desc("v4:2x2x1", platform="tpu")
+        dev = topo4.devices[0]
+        mesh = Mesh(np.array([dev]), ("d",))
+        sh = NamedSharding(mesh, P())
+        q = jax.ShapeDtypeStruct((2, 512, 4, 64), jnp.bfloat16, sharding=sh)
+
+        def loss(q, k, v):
+            out, lse = flash_mha_lse(q, k, v, causal=True, interpret=False)
+            return out.astype(jnp.float32).sum() + (lse * 0.5).sum()
+
+        c = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, q, q).compile()
+        assert "custom-call" in c.as_text()
+        print("FA v4 Mosaic compile OK")
+    """)
+    assert "v4 Mosaic compile OK" in out
