@@ -261,3 +261,32 @@ def test_flash_attention_compiles_for_v4_target():
         print("FA v4 Mosaic compile OK")
     """)
     assert "v4 Mosaic compile OK" in out
+
+
+def test_fused_conv_bn_bwd_compiles_for_v5e_at_oom_shape():
+    """Round-5 kernel (ops/fused_conv_bn.py): Mosaic lowering of the
+    fused backward at the shape whose first tiling overflowed the real
+    v5e VMEM (layer4-conv1 @ b=256: K=1024, C=512, 14x14 — the
+    double-buffer budget regression guard, PERF.md §11)."""
+    out = _run("""
+        from tpuframe.ops.fused_conv_bn import conv1x1_bn_train
+        dev = topo.devices[0]
+        mesh = Mesh(np.array([dev]), ("d",))
+        sh = NamedSharding(mesh, P())
+        a = jax.ShapeDtypeStruct((256, 14, 14, 1024), jnp.bfloat16,
+                                 sharding=sh)
+        w = jax.ShapeDtypeStruct((1024, 512), jnp.float32, sharding=sh)
+        g = jax.ShapeDtypeStruct((512,), jnp.float32, sharding=sh)
+
+        cfg = (1e-5, 2048, False)   # interpret=False -> Mosaic
+
+        def loss(a, w, gamma, beta):
+            y, mean, var = conv1x1_bn_train(cfg, a, w, gamma, beta)
+            return y.astype(jnp.float32).sum()
+
+        c = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3))).lower(
+            a, w, g, g).compile()
+        assert "tpu_custom_call" in c.as_text()
+        print("fused conv+BN bwd Mosaic compile OK")
+    """)
+    assert "Mosaic compile OK" in out
