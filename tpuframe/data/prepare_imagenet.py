@@ -1,4 +1,5 @@
-"""Offline ImageNet preparation: class-folder JPEGs → per-host npy shards.
+"""Offline ImageNet preparation: class-folder JPEGs OR TFRecord shards →
+per-host npy shards.
 
 SURVEY.md §7 hard part 2: decoding JPEGs on the training hosts would
 bottleneck the input pipeline at pod scale, so decode/resize happens offline
@@ -17,6 +18,13 @@ CLI:
     python -m tpuframe.data.prepare_imagenet \\
         --src /data/imagenet/train --out gs://bucket/imagenet/train \\
         --image-size 224 --shard-size 8192 --workers 16
+
+    # from standard tf.Example TFRecord shards (image/encoded +
+    # image/class/label — the TF-ecosystem ImageNet layout; read with the
+    # built-in dependency-free codec, tpuframe.data.tfrecord):
+    python -m tpuframe.data.prepare_imagenet \\
+        --src-tfrecords gs://bucket/imagenet-tfrecords/train \\
+        --out gs://bucket/imagenet/train
 """
 
 from __future__ import annotations
@@ -76,6 +84,86 @@ def decode_one(args: tuple[str, int, int]) -> np.ndarray:
         return np.asarray(im, np.uint8)
 
 
+def _decode_jpeg_bytes(raw: bytes, size: int) -> np.ndarray:
+    """decode_one's geometry for in-memory JPEG bytes (TFRecord path)."""
+    Image = _require_pil()
+    with Image.open(io.BytesIO(raw)) as im:
+        im = im.convert("RGB")
+        w, h = im.size
+        scale = (int(size * 1.14) + 1) / min(w, h)
+        im = im.resize((max(size, round(w * scale)),
+                        max(size, round(h * scale))), Image.BILINEAR)
+        w, h = im.size
+        lo_x, lo_y = (w - size) // 2, (h - size) // 2
+        im = im.crop((lo_x, lo_y, lo_x + size, lo_y + size))
+        return np.asarray(im, np.uint8)
+
+
+def iter_tfrecord_examples(src: str):
+    """Yield (jpeg_bytes, label) from every ``*.tfrecord*``-named (or
+    extensionless ``train-00000-of-01024``-style) shard under ``src``.
+
+    Feature names follow the standard TF ImageNet layout: ``image/encoded``
+    (JPEG bytes) and ``image/class/label`` (int; 1-based in the classic
+    Inception-era shards — values are passed through unchanged, matching
+    whatever the shard author wrote)."""
+    from tpuframe.data import tfrecord as tfr
+
+    names = sorted(n for n in gcs.listdir(src)
+                   if "tfrecord" in n or "-of-" in n)
+    if not names:
+        raise ValueError(f"no TFRecord shards under {src}")
+    for name in names:
+        data = gcs.read_bytes(gcs.join(src, name))
+        for rec in tfr.iter_records(data):
+            ex = tfr.parse_example(rec)
+            enc = ex.get("image/encoded")
+            lbl = ex.get("image/class/label")
+            if not enc or lbl is None or len(lbl) == 0:
+                raise ValueError(
+                    f"{name}: record missing image/encoded or "
+                    f"image/class/label (got {sorted(ex)})")
+            yield enc[0], int(np.asarray(lbl).reshape(-1)[0])
+
+
+def prepare_tfrecords(src: str, out: str, *, image_size: int = 224,
+                      shard_size: int = 8192,
+                      limit: int | None = None) -> int:
+    """TFRecord shards → the npy layout ``datasets.imagenet`` consumes.
+    Returns the number of shards written."""
+    gcs.makedirs(out)
+    n_shards = 0
+    buf_img: list[np.ndarray] = []
+    buf_lbl: list[int] = []
+
+    def flush():
+        nonlocal n_shards
+        if not buf_img:
+            return
+        img = np.stack(buf_img)
+        lbl = np.asarray(buf_lbl, np.int32)
+        for prefix, arr in (("images", img), ("labels", lbl)):
+            b = io.BytesIO()
+            np.save(b, arr)
+            gcs.write_bytes(gcs.join(out, f"{prefix}_{n_shards:05d}.npy"),
+                            b.getvalue())
+        n_shards += 1
+        buf_img.clear()
+        buf_lbl.clear()
+
+    count = 0
+    for jpeg, label in iter_tfrecord_examples(src):
+        buf_img.append(_decode_jpeg_bytes(jpeg, image_size))
+        buf_lbl.append(label)
+        count += 1
+        if limit and count >= limit:
+            break
+        if len(buf_img) >= shard_size:
+            flush()
+    flush()
+    return n_shards
+
+
 def prepare(src: str, out: str, *, image_size: int = 224,
             shard_size: int = 8192, workers: int = 8,
             limit: int | None = None) -> int:
@@ -127,15 +215,26 @@ def prepare(src: str, out: str, *, image_size: int = 224,
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--src", required=True, help="class-folder JPEG tree")
+    p.add_argument("--src", help="class-folder JPEG tree")
+    p.add_argument("--src-tfrecords",
+                   help="dir of tf.Example TFRecord shards (alternative "
+                        "to --src; image/encoded + image/class/label)")
     p.add_argument("--out", required=True, help="output dir (may be gs://)")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--shard-size", type=int, default=8192)
     p.add_argument("--workers", type=int, default=8)
     p.add_argument("--limit", type=int, default=None)
     a = p.parse_args(argv)
-    n = prepare(a.src, a.out, image_size=a.image_size,
-                shard_size=a.shard_size, workers=a.workers, limit=a.limit)
+    if bool(a.src) == bool(a.src_tfrecords):
+        p.error("exactly one of --src / --src-tfrecords is required")
+    if a.src_tfrecords:
+        n = prepare_tfrecords(a.src_tfrecords, a.out,
+                              image_size=a.image_size,
+                              shard_size=a.shard_size, limit=a.limit)
+    else:
+        n = prepare(a.src, a.out, image_size=a.image_size,
+                    shard_size=a.shard_size, workers=a.workers,
+                    limit=a.limit)
     print(f"wrote {n} shards to {a.out}")
     return 0
 
