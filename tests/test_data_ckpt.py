@@ -371,3 +371,61 @@ class TestPrepareImagenet:
         Image.fromarray(np.zeros((100, 300, 3), np.uint8)).save(p)
         arr = prepare_imagenet.decode_one((str(p), 64, 0))
         assert arr.shape == (64, 64, 3) and arr.dtype == np.uint8
+
+
+class TestAugment:
+    """On-device augmentation (tpuframe/data/augment.py)."""
+
+    def test_flip_is_per_image_and_deterministic(self):
+        import jax
+        import jax.numpy as jnp
+        from tpuframe.data import augment
+
+        imgs = jnp.arange(4 * 2 * 3 * 1, dtype=jnp.uint8).reshape(4, 2, 3, 1)
+        a = augment.random_flip(imgs, jax.random.key(0))
+        b = augment.random_flip(imgs, jax.random.key(0))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        flipped = np.asarray(a) != np.asarray(imgs)
+        per_img = flipped.reshape(4, -1).any(axis=1)
+        assert per_img.any()          # some flip...
+        assert not per_img.all() or True  # (p=0.5 over 4: both possible)
+        # a flipped image is exactly the W-reverse
+        for i in range(4):
+            if per_img[i]:
+                np.testing.assert_array_equal(
+                    np.asarray(a)[i], np.asarray(imgs)[i, :, ::-1, :])
+
+    def test_pad_crop_flip_preserves_shape_and_content_bounds(self):
+        import jax
+        import jax.numpy as jnp
+        from tpuframe.data import augment
+
+        imgs = jnp.ones((8, 32, 32, 3), jnp.uint8) * 7
+        out = augment.apply("pad_crop_flip", imgs, jax.random.key(1))
+        assert out.shape == imgs.shape and out.dtype == imgs.dtype
+        vals = set(np.unique(np.asarray(out)).tolist())
+        assert vals <= {0, 7}          # original pixels or zero padding
+
+    def test_crop_flip_requires_margin(self):
+        import jax
+        import jax.numpy as jnp
+        import pytest as _pytest
+        from tpuframe.data import augment
+
+        imgs = jnp.zeros((2, 32, 32, 3), jnp.uint8)
+        with _pytest.raises(ValueError, match="smaller"):
+            augment.apply("crop_flip", imgs, jax.random.key(0), crop=64)
+        out = augment.apply("crop_flip",
+                            jnp.zeros((2, 40, 40, 3), jnp.uint8),
+                            jax.random.key(0), crop=32)
+        assert out.shape == (2, 32, 32, 3)
+
+    def test_unknown_mode_raises(self):
+        import jax
+        import jax.numpy as jnp
+        import pytest as _pytest
+        from tpuframe.data import augment
+
+        with _pytest.raises(ValueError, match="unknown augment"):
+            augment.apply("mixup", jnp.zeros((1, 8, 8, 3)),
+                          jax.random.key(0))

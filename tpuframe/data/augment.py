@@ -1,0 +1,78 @@
+"""On-device training augmentation (TrainConfig.augment).
+
+The reference's input pipeline augments in the torch DataLoader workers
+(RandomResizedCrop + RandomHorizontalFlip for ImageNet; pad-4 + random
+crop + flip for CIFAR).  The TPU-native home for this work is INSIDE the
+compiled train step: the ops are elementwise/slice-level (XLA fuses them
+into the input read), they run on the uint8 batch BEFORE on-device
+normalization (cheapest dtype), and the randomness rides the step rng —
+per-step deterministic, so checkpoint-resume reproduces the exact batch
+stream (tests/test_train_harness resume-exactness holds with
+augmentation on).
+
+Modes:
+  * ``"flip"``          — per-image random horizontal flip (ImageNet
+                          storage is already the crop geometry).
+  * ``"pad_crop_flip"`` — zero-pad 4px, random crop back to the stored
+                          size, then flip: the classic CIFAR recipe.
+  * ``"crop_flip"``     — random crop to ``crop`` from larger stored
+                          images (prepare_imagenet with a larger
+                          --image-size), then flip.
+  * ``"none"``          — identity.
+
+Eval batches are never augmented (the harness only calls this in the
+train loss path).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def random_flip(images: jax.Array, rng: jax.Array) -> jax.Array:
+    """Per-image horizontal flip with p=0.5.  [B, H, W, C], any dtype."""
+    flip = jax.random.bernoulli(rng, 0.5, (images.shape[0],))
+    return jnp.where(flip[:, None, None, None], images[:, :, ::-1, :],
+                     images)
+
+
+def _random_crop(images: jax.Array, rng: jax.Array, crop_h: int,
+                 crop_w: int) -> jax.Array:
+    b, h, w, c = images.shape
+    ry, rx = jax.random.split(rng)
+    oy = jax.random.randint(ry, (b,), 0, h - crop_h + 1)
+    ox = jax.random.randint(rx, (b,), 0, w - crop_w + 1)
+
+    def one(img, y, x):
+        return lax.dynamic_slice(img, (y, x, 0), (crop_h, crop_w, c))
+
+    return jax.vmap(one)(images, oy, ox)
+
+
+def apply(mode: str, images: jax.Array, rng: jax.Array,
+          *, crop: int | None = None) -> jax.Array:
+    """Dispatch on the config's ``augment`` mode (train path only)."""
+    if mode == "none":
+        return images
+    r_crop, r_flip = jax.random.split(rng)
+    if mode == "flip":
+        return random_flip(images, r_flip)
+    if mode == "pad_crop_flip":
+        h, w = images.shape[1], images.shape[2]
+        padded = jnp.pad(images, ((0, 0), (4, 4), (4, 4), (0, 0)))
+        out = _random_crop(padded, r_crop, h, w)
+        return random_flip(out, r_flip)
+    if mode == "crop_flip":
+        if crop is None:
+            raise ValueError("crop_flip needs the model input size")
+        if images.shape[1] < crop or images.shape[2] < crop:
+            raise ValueError(
+                f"crop_flip: stored images {images.shape[1:3]} smaller "
+                f"than crop {crop} — prepare shards with a larger "
+                f"--image-size")
+        out = _random_crop(images, r_crop, crop, crop)
+        return random_flip(out, r_flip)
+    raise ValueError(f"unknown augment mode {mode!r}; expected none | flip "
+                     f"| pad_crop_flip | crop_flip")

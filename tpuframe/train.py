@@ -380,9 +380,16 @@ def make_loss_fn(cfg: TrainConfig, model) -> step_lib.LossFn:
         return loss_fn
 
     def loss_fn(params, model_state, batch, rng):
+        images = batch["image"]
+        if cfg.augment != "none":
+            from tpuframe.data import augment as augment_lib
+
+            aug_rng, rng = jax.random.split(rng)
+            images = augment_lib.apply(cfg.augment, images, aug_rng,
+                                       crop=cfg.augment_crop)
         outputs = model.apply(
             {"params": params, **model_state},
-            _maybe_normalize(cfg, batch["image"]), train=True,
+            _maybe_normalize(cfg, images), train=True,
             rngs={"dropout": rng},
             mutable=list(model_state) if model_state else False)
         if model_state:

@@ -61,6 +61,13 @@ class TrainConfig:
     log_every: int = 10
     seed: int = 42
 
+    # On-device training augmentation (tpuframe/data/augment.py):
+    # none | flip | pad_crop_flip (CIFAR recipe) | crop_flip (larger
+    # stored images; crop size = the model input).  Train path only;
+    # randomness rides the step rng (resume-exact).
+    augment: str = "none"
+    augment_crop: int | None = None
+
     # precision
     compute_dtype: str = "float32"  # bfloat16 on real TPU runs
 
@@ -125,6 +132,7 @@ def _cifar10_resnet18() -> TrainConfig:
         dataset="cifar10", optimizer="sgd", base_lr=0.1, warmup_steps=200,
         schedule="cosine", weight_decay=5e-4, global_batch=256,
         total_steps=2000, eval_every=500,
+        augment="pad_crop_flip",   # the classic CIFAR train recipe
     )
 
 
@@ -138,6 +146,7 @@ def _imagenet_resnet50() -> TrainConfig:
         schedule="cosine", weight_decay=1e-4, label_smoothing=0.1,
         global_batch=2048, total_steps=56300, eval_every=2000,
         compute_dtype="bfloat16", ckpt_every=2000,
+        augment="flip",   # storage is crop geometry; flip on device
     )
 
 
