@@ -198,3 +198,53 @@ class TestMnliHarness:
         metrics = train_mod.train(cfg)
         assert metrics["step"] == 2
         assert np.isfinite(metrics["loss"])
+
+
+class TestCola:
+    """CoLA: 4-column headerless TSV, binary labels, MCC eval metric."""
+
+    def test_tsv_parse(self, tmp_path):
+        tsv = ("gj04\t1\t\tThe sailors rode the breeze clear of the rocks.\n"
+               "gj04\t0\t*\tThe car honked down the road.\n"
+               "ab12\t1\t\tShort one.\n")
+        for name in ("train.tsv", "dev.tsv"):
+            (tmp_path / name).write_text(tsv)
+        train, dev = datasets.glue_cola(str(tmp_path), seq_len=16)
+        np.testing.assert_array_equal(train.columns["label"], [1, 0, 1])
+        assert train.columns["input_ids"].shape == (3, 16)
+
+    def test_mcc_finalize_matches_definition(self):
+        from tpuframe.train import _finalize_eval
+
+        # Rates from a known confusion matrix: tp=40 fp=10 tn=45 fn=5 /100.
+        avg = {"_m_tp": 0.40, "_m_fp": 0.10, "_m_tn": 0.45, "_m_fn": 0.05,
+               "accuracy": 0.85}
+        out = _finalize_eval(avg)
+        tp, fp, tn, fn = 40, 10, 45, 5
+        want = (tp * tn - fp * fn) / (
+            (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)) ** 0.5
+        assert abs(out["mcc"] - want) < 1e-12
+        assert "_m_tp" not in out
+
+    def test_degenerate_single_class_has_no_mcc(self):
+        from tpuframe.train import _finalize_eval
+
+        out = _finalize_eval({"_m_tp": 0.0, "_m_fp": 0.0, "_m_tn": 1.0,
+                              "_m_fn": 0.0})
+        assert "mcc" not in out
+
+    @pytest.mark.slow
+    def test_bert_cola_tiny_steps_reports_mcc(self):
+        from tpuframe import train as train_mod
+        from tpuframe.utils import get_config
+
+        cfg = get_config("glue_bert_cola").with_overrides(
+            total_steps=2, eval_every=2, eval_batches=2, global_batch=8,
+            warmup_steps=1, log_every=1,
+            model_kwargs={"vocab_size": 512, "hidden_size": 64,
+                          "num_layers": 2, "num_heads": 2,
+                          "intermediate_size": 128, "max_position": 32},
+            dataset_kwargs={"synthetic_size": 64, "seq_len": 16,
+                            "vocab_size": 512})
+        metrics = train_mod.train(cfg)
+        assert "eval_mcc" in metrics or "eval_accuracy" in metrics

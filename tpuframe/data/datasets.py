@@ -344,6 +344,39 @@ def glue_stsb(data_dir: str | None = None, *, seq_len: int = 128,
                                    vocab_size, seed=11))
 
 
+def glue_cola(data_dir: str | None = None, *, seq_len: int = 128,
+              vocab_size: int = 30522, synthetic_size: int = 1024,
+              tokenizer=None, vocab_file: str | None = None):
+    """CoLA (Corpus of Linguistic Acceptability) — single-sentence binary
+    classification whose standard metric is MATTHEWS CORRELATION (the
+    class balance is skewed ~70/30, so accuracy overstates; the harness
+    derives MCC from aggregated confusion moments at eval, train.py).
+
+    File format differs from every other GLUE task: ``train.tsv`` /
+    ``dev.tsv`` have NO header and four columns
+    ``source<TAB>label<TAB>star<TAB>sentence``.
+    """
+    if data_dir is not None:
+        tokenizer = _resolve_tokenizer(tokenizer, data_dir, vocab_file)
+
+        def load(name):
+            text = gcs.read_bytes(gcs.join(data_dir, name)).decode()
+            sents, labels = [], []
+            for line in text.replace("\r\n", "\n").strip().split("\n"):
+                cols = line.split("\t")
+                if len(cols) < 4:
+                    continue
+                labels.append(int(cols[1]))
+                sents.append(cols[3])
+            return _tokenize(sents, np.asarray(labels, np.int32), seq_len,
+                             vocab_size, tokenizer)
+
+        return load("train.tsv"), load("dev.tsv")
+    return (_synthetic_tokens(synthetic_size, seq_len, vocab_size, seed=12),
+            _synthetic_tokens(max(synthetic_size // 8, 64), seq_len,
+                              vocab_size, seed=13))
+
+
 def _synthetic_score_pairs(n, seq_len, vocab_size, *, seed):
     """Pair-encoded batches with a LEARNABLE float score: the signal token
     (position 1) encodes one of 11 levels mapping to scores 0.0-5.0."""

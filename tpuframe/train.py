@@ -49,13 +49,15 @@ def build_datasets(cfg: TrainConfig):
         "glue_sst2": datasets.glue_sst2,
         "glue_mnli": datasets.glue_mnli,
         "glue_stsb": datasets.glue_stsb,
+        "glue_cola": datasets.glue_cola,
         "lm_text": datasets.lm_text,
     }[cfg.dataset]
     return builder(cfg.data_dir, **cfg.dataset_kwargs)
 
 
 def _is_text_task(cfg: TrainConfig) -> bool:
-    return cfg.dataset in ("glue_sst2", "glue_mnli", "glue_stsb")
+    return cfg.dataset in ("glue_sst2", "glue_mnli", "glue_stsb",
+                           "glue_cola")
 
 
 def _maybe_normalize(cfg: TrainConfig, x):
@@ -461,8 +463,22 @@ def make_metric_fn(cfg: TrainConfig, model):
                         "_m_pred2": jnp.mean(pred ** 2),
                         "_m_y2": jnp.mean(y ** 2),
                         "_m_py": jnp.mean(pred * y)}
-            return {"accuracy": losses.accuracy(logits, batch["label"]),
-                    "loss": losses.softmax_cross_entropy(logits, batch["label"])}
+            out = {"accuracy": losses.accuracy(logits, batch["label"]),
+                   "loss": losses.softmax_cross_entropy(logits,
+                                                        batch["label"])}
+            if cfg.dataset == "glue_cola":
+                # Confusion-rate moments: equal-size eval batches mean
+                # evaluate()'s averaging reproduces whole-set rates, from
+                # which _finalize_eval derives the task's standard
+                # Matthews correlation (scale cancels in MCC).
+                pred = jnp.argmax(logits, -1)
+                y = batch["label"]
+                out.update(
+                    _m_tp=jnp.mean((pred == 1) & (y == 1)),
+                    _m_fp=jnp.mean((pred == 1) & (y == 0)),
+                    _m_tn=jnp.mean((pred == 0) & (y == 0)),
+                    _m_fn=jnp.mean((pred == 0) & (y == 1)))
+            return out
 
         return metric_fn
 
@@ -507,6 +523,12 @@ def evaluate(h: Harness, max_batches: int) -> dict:
 def _finalize_eval(avg: dict) -> dict:
     """Derive set-level metrics from aggregated moments (keys starting
     with ``_m_``), which are internal and dropped from the report."""
+    if "_m_tp" in avg:
+        tp, fp = avg["_m_tp"], avg["_m_fp"]
+        tn, fn = avg["_m_tn"], avg["_m_fn"]
+        denom = ((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)) ** 0.5
+        if denom > 0:
+            avg["mcc"] = (tp * tn - fp * fn) / denom
     if "_m_py" in avg:
         var_p = avg["_m_pred2"] - avg["_m_pred"] ** 2
         var_y = avg["_m_y2"] - avg["_m_y"] ** 2

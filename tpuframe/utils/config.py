@@ -184,6 +184,16 @@ def _glue_bert_stsb() -> TrainConfig:
     )
 
 
+def _glue_bert_cola() -> TrainConfig:
+    """Config 4 [B:10], fourth GLUE shape: CoLA — single-sentence binary
+    with MATTHEWS CORRELATION eval (the skewed-class task where accuracy
+    misleads).  Standard recipe: ~3 epochs over 8.5k sentences at 32."""
+    return _glue_bert().with_overrides(
+        name="glue_bert_cola", dataset="glue_cola", total_steps=800,
+        warmup_steps=80,
+    )
+
+
 def _imagenet_resnet50_pod() -> TrainConfig:
     """Config 5 [B:11]: ResNet-50 / ImageNet on a multi-host pod (v4-32).
     Same recipe as config 3 at 4x the batch; launched via tpuframe.launch."""
@@ -272,6 +282,7 @@ WORKLOADS = {
     "glue_bert": _glue_bert,
     "glue_bert_mnli": _glue_bert_mnli,
     "glue_bert_stsb": _glue_bert_stsb,
+    "glue_bert_cola": _glue_bert_cola,
     "imagenet_resnet50_pod": _imagenet_resnet50_pod,
     "lm_long": _lm_long,
     "lm_smoke": _lm_smoke,
