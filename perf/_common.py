@@ -241,3 +241,12 @@ def hold_aot_lock():
     fh = open(_aot_lock_path(), "w")
     fcntl.flock(fh, fcntl.LOCK_EX)  # blocks until free
     _AOT_LOCK_HANDLE = fh
+
+
+def topo_tag_suffix(topo: str, default: str) -> str:
+    """Shared result-tag suffix for non-default compile-only topologies
+    ("" for the default; "_v4_221"-style otherwise) — one rule for
+    exp_hlo_offline / exp_capacity_audit / exp_offline_ab."""
+    if topo == default:
+        return ""
+    return "_" + topo.replace(":", "_").replace("x", "")

@@ -54,8 +54,9 @@ def log(m):
 
 
 def _tag(base):
-    return base if TOPO == "v5e:2x4" else (
-        base + "_" + TOPO.replace(":", "_").replace("x", ""))
+    from _common import topo_tag_suffix
+
+    return base + topo_tag_suffix(TOPO, "v5e:2x4")
 
 
 def record(row):

@@ -119,8 +119,9 @@ def main():
     suffix = "" if (BN, REMAT, STEM) == ("flax", False, "conv") else (
         f"_{BN}" + ("_remat" if REMAT else "") +
         ("_s2d" if STEM != "conv" else ""))
-    if TOPO != "v5e:2x2":
-        suffix += "_" + TOPO.replace(":", "_").replace("x", "")
+    from _common import topo_tag_suffix
+
+    suffix += topo_tag_suffix(TOPO, "v5e:2x2")
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "results", f"resnet_step_hlo_offline{suffix}.txt")
     with open(out_path, "w") as f:

@@ -244,7 +244,10 @@ def dp32():
     from _hlo_parse import allreduce_payload
 
     payload, ops = allreduce_payload(txt)
-    record(_analyze(compiled, "resnet50_dp32" + ("" if os.environ.get("TOPO", "v5e:4x8") == "v5e:4x8" else "_" + os.environ["TOPO"].replace(":", "_").replace("x", "")), {
+    from _common import topo_tag_suffix
+
+    record(_analyze(compiled, "resnet50_dp32" + topo_tag_suffix(
+        os.environ.get("TOPO", "v5e:4x8"), "v5e:4x8"), {
         "devices": n, "allreduce_ops": ops,
         "allreduce_payload_mb": round(sum(payload.values()) / 1e6, 2),
         "payload_bf16_mb": round(payload["bf16"] / 1e6, 2),

@@ -142,3 +142,27 @@ class TestPrepareFromTfrecords:
             [tfr.build_example({"unrelated": [b"z"]})]))
         with pytest.raises(ValueError, match="image/encoded"):
             list(prep.iter_tfrecord_examples(str(src)))
+
+
+def test_label_offset_maps_one_based_shards(tmp_path):
+    from tpuframe.data import prepare_imagenet as prep
+
+    rng = np.random.default_rng(1)
+    src = tmp_path / "tfr"
+    src.mkdir()
+    recs = [tfr.build_example({
+        "image/encoded": [_jpeg_bytes(rng)],
+        "image/class/label": np.asarray([i + 1], np.int64),  # 1-based
+    }) for i in range(4)]
+    (src / "t.tfrecord").write_bytes(tfr.write_records(recs))
+    got = [lbl for _, lbl in
+           prep.iter_tfrecord_examples(str(src), label_offset=1)]
+    assert got == [0, 1, 2, 3]
+    # wrong offset on 0-based shards fails loudly
+    recs0 = [tfr.build_example({
+        "image/encoded": [_jpeg_bytes(rng)],
+        "image/class/label": np.asarray([0], np.int64),
+    })]
+    (src / "t.tfrecord").write_bytes(tfr.write_records(recs0))
+    with pytest.raises(ValueError, match="offset"):
+        list(prep.iter_tfrecord_examples(str(src), label_offset=1))

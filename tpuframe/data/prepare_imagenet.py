@@ -71,21 +71,13 @@ def decode_one(args: tuple[str, int, int]) -> np.ndarray:
     (the standard ResNet eval geometry; training-time augmentation is the
     loader's job, not storage's)."""
     path, size, _label = args
-    Image = _require_pil()
-    with Image.open(path) as im:
-        im = im.convert("RGB")
-        w, h = im.size
-        scale = (int(size * 1.14) + 1) / min(w, h)
-        im = im.resize((max(size, round(w * scale)),
-                        max(size, round(h * scale))), Image.BILINEAR)
-        w, h = im.size
-        lo_x, lo_y = (w - size) // 2, (h - size) // 2
-        im = im.crop((lo_x, lo_y, lo_x + size, lo_y + size))
-        return np.asarray(im, np.uint8)
+    with open(path, "rb") as fh:
+        return _decode_jpeg_bytes(fh.read(), size)
 
 
 def _decode_jpeg_bytes(raw: bytes, size: int) -> np.ndarray:
-    """decode_one's geometry for in-memory JPEG bytes (TFRecord path)."""
+    """The ONE decode geometry (both prep paths route here): resize
+    shorter side to 1.14*size, center-crop size×size, uint8 RGB."""
     Image = _require_pil()
     with Image.open(io.BytesIO(raw)) as im:
         im = im.convert("RGB")
@@ -99,14 +91,17 @@ def _decode_jpeg_bytes(raw: bytes, size: int) -> np.ndarray:
         return np.asarray(im, np.uint8)
 
 
-def iter_tfrecord_examples(src: str):
+def iter_tfrecord_examples(src: str, *, label_offset: int = 0):
     """Yield (jpeg_bytes, label) from every ``*.tfrecord*``-named (or
     extensionless ``train-00000-of-01024``-style) shard under ``src``.
 
     Feature names follow the standard TF ImageNet layout: ``image/encoded``
-    (JPEG bytes) and ``image/class/label`` (int; 1-based in the classic
-    Inception-era shards — values are passed through unchanged, matching
-    whatever the shard author wrote)."""
+    (JPEG bytes) and ``image/class/label``.  CLASSIC Inception-era shards
+    store 1-BASED labels (1..1000): pass ``--label-offset 1`` to map them
+    onto the 0-based space the model head uses — a passed-through 1-based
+    label space would silently mistrain (class 1000 one-hots to an
+    all-zero row).  Labels are validated non-negative after the offset so
+    a wrong guess fails loudly."""
     from tpuframe.data import tfrecord as tfr
 
     names = sorted(n for n in gcs.listdir(src)
@@ -123,14 +118,23 @@ def iter_tfrecord_examples(src: str):
                 raise ValueError(
                     f"{name}: record missing image/encoded or "
                     f"image/class/label (got {sorted(ex)})")
-            yield enc[0], int(np.asarray(lbl).reshape(-1)[0])
+            label = int(np.asarray(lbl).reshape(-1)[0]) - label_offset
+            if label < 0:
+                raise ValueError(
+                    f"{name}: label {label + label_offset} with "
+                    f"--label-offset {label_offset} goes negative — wrong "
+                    f"offset for this shard family?")
+            yield enc[0], label
 
 
 def prepare_tfrecords(src: str, out: str, *, image_size: int = 224,
-                      shard_size: int = 8192,
+                      shard_size: int = 8192, workers: int = 8,
+                      label_offset: int = 0,
                       limit: int | None = None) -> int:
     """TFRecord shards → the npy layout ``datasets.imagenet`` consumes.
-    Returns the number of shards written."""
+    Returns the number of shards written.  Decoding parallelizes over
+    ``workers`` processes like the --src path (full ImageNet is 1.28M
+    JPEGs; serial PIL would be ~an order of magnitude slower)."""
     gcs.makedirs(out)
     n_shards = 0
     buf_img: list[np.ndarray] = []
@@ -151,15 +155,36 @@ def prepare_tfrecords(src: str, out: str, *, image_size: int = 224,
         buf_img.clear()
         buf_lbl.clear()
 
-    count = 0
-    for jpeg, label in iter_tfrecord_examples(src):
-        buf_img.append(_decode_jpeg_bytes(jpeg, image_size))
-        buf_lbl.append(label)
-        count += 1
-        if limit and count >= limit:
-            break
-        if len(buf_img) >= shard_size:
-            flush()
+    examples = iter_tfrecord_examples(src, label_offset=label_offset)
+    if limit:
+        import itertools
+
+        examples = itertools.islice(examples, limit)
+    if workers > 1:
+        import itertools
+
+        # Chunked streaming: full ImageNet is ~150 GB of JPEG bytes —
+        # decode one shard-sized chunk at a time, never the whole set.
+        with ProcessPoolExecutor(max_workers=workers) as pool:
+            while True:
+                chunk = list(itertools.islice(examples, shard_size))
+                if not chunk:
+                    break
+                jpegs = [j for j, _ in chunk]
+                for (_, label), arr in zip(
+                        chunk, pool.map(_decode_jpeg_bytes, jpegs,
+                                        [image_size] * len(jpegs),
+                                        chunksize=64)):
+                    buf_img.append(arr)
+                    buf_lbl.append(label)
+                    if len(buf_img) >= shard_size:
+                        flush()
+    else:
+        for jpeg, label in examples:
+            buf_img.append(_decode_jpeg_bytes(jpeg, image_size))
+            buf_lbl.append(label)
+            if len(buf_img) >= shard_size:
+                flush()
     flush()
     return n_shards
 
@@ -219,6 +244,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--src-tfrecords",
                    help="dir of tf.Example TFRecord shards (alternative "
                         "to --src; image/encoded + image/class/label)")
+    p.add_argument("--label-offset", type=int, default=0,
+                   help="subtracted from TFRecord labels; classic "
+                        "Inception-era ImageNet shards are 1-based: "
+                        "pass 1")
     p.add_argument("--out", required=True, help="output dir (may be gs://)")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--shard-size", type=int, default=8192)
@@ -230,7 +259,8 @@ def main(argv: list[str] | None = None) -> int:
     if a.src_tfrecords:
         n = prepare_tfrecords(a.src_tfrecords, a.out,
                               image_size=a.image_size,
-                              shard_size=a.shard_size, limit=a.limit)
+                              shard_size=a.shard_size, workers=a.workers,
+                              label_offset=a.label_offset, limit=a.limit)
     else:
         n = prepare(a.src, a.out, image_size=a.image_size,
                     shard_size=a.shard_size, workers=a.workers,

@@ -333,16 +333,10 @@ conv1x1_bn_train.defvjp(_core_fwd, _core_bwd)
 
 def conv1x1_bn_reference(a4, w, gamma, beta, *, eps):
     """The unfused composition (1x1 conv -> flax-semantics train BN) the
-    kernel is parity-tested against; differentiable end to end by XLA."""
-    x = _conv1x1(a4, w.astype(a4.dtype))
-    xf = x.astype(jnp.float32)
-    mean = jnp.mean(xf, axis=(0, 1, 2))
-    var = jnp.maximum(jnp.mean(jnp.square(xf), axis=(0, 1, 2))
-                      - jnp.square(mean), 0.0)
-    r = lax.rsqrt(var + eps)
-    aa = gamma.astype(jnp.float32) * r
-    bb = beta.astype(jnp.float32) - mean * aa
-    y = x * aa.astype(x.dtype) + bb.astype(x.dtype)
+    kernel is parity-tested against; differentiable end to end by XLA.
+    Delegates to the SAME forward math as the custom_vjp (the module's
+    fallback-path contract is bit-identical forward numerics)."""
+    y, mean, var, _ = _fwd_math((eps, 0, False), a4, w, gamma, beta)
     return y, mean, var
 
 
