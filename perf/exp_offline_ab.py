@@ -154,16 +154,25 @@ def lm_8k():
 
 def bert_b256():
     """BERT-base classification step at b=256 s=128 — the queue-4 on-chip
-    A/B's byte/residency picture, available offline."""
+    A/B's byte/residency picture, available offline.  BERT_LARGE=1
+    compiles the 24-layer/1024-hidden large variant at b=128 instead
+    (model-scale headroom evidence: the reference genre's next size up)."""
     from tpuframe.models import bert as bert_lib
     from tpuframe.models import losses
     from tpuframe.parallel import step as step_lib
 
     mesh = _topo_mesh(n=1)
     repl = NamedSharding(mesh, P())
-    cfg = bert_lib.BertConfig(dtype="bfloat16")
+    large = os.environ.get("BERT_LARGE") == "1"
+    if large:
+        cfg = bert_lib.BertConfig(dtype="bfloat16", hidden_size=1024,
+                                  num_layers=24, num_heads=16,
+                                  intermediate_size=4096)
+        B, S = 128, 128
+    else:
+        cfg = bert_lib.BertConfig(dtype="bfloat16")
+        B, S = 256, 128
     model = bert_lib.BertForSequenceClassification(cfg)
-    B, S = 256, 128
     ids = jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=repl)
     lab = jax.ShapeDtypeStruct((B,), jnp.int32, sharding=repl)
     variables = jax.eval_shape(
@@ -185,9 +194,10 @@ def bert_b256():
     step = step_lib.make_train_step(loss_fn, tx, None, donate=False)
     batch = {"input_ids": ids, "attention_mask": ids,
              "token_type_ids": ids, "label": lab}
-    log("compiling bert-base b=256 s=128...")
+    tag = "bert_large_b128" if large else "bert_b256"
+    log(f"compiling {tag} s=128...")
     compiled = jax.jit(step).lower(state, batch).compile()
-    record(_analyze(compiled, "bert_b256", {"batch": B, "seq": S}))
+    record(_analyze(compiled, tag, {"batch": B, "seq": S}))
 
 
 def dp32():
