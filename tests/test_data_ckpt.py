@@ -429,3 +429,35 @@ class TestAugment:
         with _pytest.raises(ValueError, match="unknown augment"):
             augment.apply("mixup", jnp.zeros((1, 8, 8, 3)),
                           jax.random.key(0))
+
+    def test_center_crop_matches_geometry(self):
+        import jax.numpy as jnp
+        from tpuframe.data import augment
+
+        imgs = jnp.arange(2 * 8 * 8 * 1, dtype=jnp.float32).reshape(2, 8, 8, 1)
+        out = augment.center_crop(imgs, 4)
+        assert out.shape == (2, 4, 4, 1)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(imgs[:, 2:6, 2:6, :]))
+        # size-match is the identity
+        same = augment.center_crop(imgs, 8)
+        np.testing.assert_array_equal(np.asarray(same), np.asarray(imgs))
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="smaller"):
+            augment.center_crop(imgs, 16)
+
+    def test_crop_flip_end_to_end_harness(self):
+        """Train 2 steps with larger synthetic storage + crop_flip: train
+        crops to augment_crop, eval center-crops — both paths compile."""
+        from tpuframe import train as train_mod
+        from tpuframe.utils import get_config
+
+        cfg = get_config("imagenet_resnet50").with_overrides(
+            total_steps=2, eval_every=2, eval_batches=1, global_batch=16,
+            warmup_steps=1, log_every=1, compute_dtype="float32",
+            augment="crop_flip", augment_crop=24,
+            dataset_kwargs={"image_size": 32, "synthetic_size": 32,
+                            "num_classes": 10},
+            model_kwargs={"cifar_stem": True, "num_classes": 10})
+        metrics = train_mod.train(cfg)
+        assert np.isfinite(metrics["loss"])

@@ -20,8 +20,9 @@ Modes:
                           --image-size), then flip.
   * ``"none"``          — identity.
 
-Eval batches are never augmented (the harness only calls this in the
-train loss path).
+Eval batches are never RANDOMLY augmented; the only eval-side entry
+point is :func:`center_crop`, the deterministic geometry companion the
+harness applies when ``crop_flip`` trains from larger stored images.
 """
 
 from __future__ import annotations
@@ -76,3 +77,20 @@ def apply(mode: str, images: jax.Array, rng: jax.Array,
         return random_flip(out, r_flip)
     raise ValueError(f"unknown augment mode {mode!r}; expected none | flip "
                      f"| pad_crop_flip | crop_flip")
+
+
+def center_crop(images: jax.Array, crop: int) -> jax.Array:
+    """Deterministic eval-side companion of ``crop_flip``: when training
+    random-crops from larger stored images, eval center-crops to the same
+    geometry (the standard train/eval pairing)."""
+    h, w = images.shape[1], images.shape[2]
+    if h == crop and w == crop:
+        return images
+    if h < crop or w < crop:
+        # Mirror apply()'s guard: a silent negative-offset slice would
+        # return a tiny corner crop and eval would report garbage.
+        raise ValueError(
+            f"center_crop: stored images {images.shape[1:3]} smaller than "
+            f"crop {crop} — prepare shards with a larger --image-size")
+    oy, ox = (h - crop) // 2, (w - crop) // 2
+    return images[:, oy:oy + crop, ox:ox + crop, :]

@@ -483,8 +483,15 @@ def make_metric_fn(cfg: TrainConfig, model):
         return metric_fn
 
     def metric_fn(params, model_state, batch):
+        images = batch["image"]
+        if cfg.augment == "crop_flip" and cfg.augment_crop:
+            from tpuframe.data import augment as augment_lib
+
+            # train random-crops from larger stored images; eval pairs it
+            # with the deterministic center crop at the same geometry.
+            images = augment_lib.center_crop(images, cfg.augment_crop)
         logits = model.apply({"params": params, **model_state},
-                             _maybe_normalize(cfg, batch["image"]))
+                             _maybe_normalize(cfg, images))
         out = {"accuracy": losses.accuracy(logits, batch["label"]),
                "loss": losses.softmax_cross_entropy(logits, batch["label"])}
         if batch["label"].shape and cfg.dataset == "imagenet":
