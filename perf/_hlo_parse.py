@@ -1,35 +1,32 @@
-"""Side-effect-free HLO text parsing helpers (importable from tests).
+"""Compatibility shim — the parser was promoted into the framework.
 
-Kept separate from ``_common`` (whose ``setup`` path pulls jax config)
-and from the experiment scripts (whose import guards re-exec the
-process): this module is pure text parsing.
+``allreduce_payload`` (and the general collective parser that replaced
+its regex) now live in ``tpuframe.analysis.hlo_audit``; this module
+keeps the historical ``from _hlo_parse import allreduce_payload`` import
+path of the perf scripts working.
+
+Loaded by file path rather than ``import tpuframe...`` on purpose: the
+``tpuframe`` package __init__ imports jax, and this module's contract is
+*side-effect-free text parsing* — several perf scripts import it before
+their env-guard re-exec, when initializing jax would pin the wrong
+backend.  ``hlo_audit`` itself imports nothing but the stdlib.
 """
 
-import re
+import importlib.util
+import os
+import sys
 
+_HLO_AUDIT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tpuframe", "analysis", "hlo_audit.py")
 
-def allreduce_payload(txt: str):
-    """Sum all-reduce payload bytes from optimized-HLO text.
+if "tpuframe.analysis.hlo_audit" in sys.modules:
+    _mod = sys.modules["tpuframe.analysis.hlo_audit"]
+else:
+    _spec = importlib.util.spec_from_file_location(
+        "_hlo_parse_impl", _HLO_AUDIT)
+    _mod = importlib.util.module_from_spec(_spec)
+    sys.modules["_hlo_parse_impl"] = _mod  # dataclasses resolve via here
+    _spec.loader.exec_module(_mod)
 
-    Returns ``({"bf16": bytes, "f32": bytes}, op_count)``.  Handles
-    XLA's variadic tuple all-reduces; an ``all-reduce-start``'s result
-    tuple aliases the operand (shapes appear twice — the form the
-    latency-hiding scheduler emits), so those instructions are halved.
-    """
-    payload = {"bf16": 0.0, "f32": 0.0}
-    ops = 0
-    for line in txt.splitlines():
-        stripped = line.strip()
-        m = re.match(r"%?[\w.-]+ = (.*?) all-reduce(-start)?\(", stripped)
-        if not m:
-            continue
-        factor = 0.5 if m.group(2) else 1.0
-        for dt, dims in re.findall(r"(bf16|f32)\[([0-9,]*)\]", m.group(1)):
-            sz = {"bf16": 2, "f32": 4}[dt]
-            k = 1
-            for d in dims.split(","):
-                if d:
-                    k *= int(d)
-            payload[dt] += k * sz * factor
-        ops += 1
-    return payload, ops
+allreduce_payload = _mod.allreduce_payload
+parse_collectives = _mod.parse_collectives

@@ -263,6 +263,60 @@ def test_flash_attention_compiles_for_v4_target():
     assert "v4 Mosaic compile OK" in out
 
 
+def test_flash_attention_dp4_budget_audit_v5e():
+    """tpuframe.analysis over the REAL TPU compiler output: a dp4
+    flash-attention train step is AOT-compiled for v5e and its
+    collectives must fit the declared dp budget — the Mosaic kernel must
+    not perturb the step's wire pattern, and the gradient all-reduce
+    must be present and param-sized (the CI gate's deep half; the fast
+    half audits CPU lowerings in tests/test_analysis.py)."""
+    import jax as _jax
+    if not hasattr(_jax, "typeof"):
+        pytest.skip("jax.typeof unavailable (flash_mha's shard_map-aware "
+                    "out_shape needs the varying-axes API, jax>=0.6) — "
+                    "same SKIP-not-PASS contract as tpuframe.analysis "
+                    "strategies")
+    out = _run("""
+        import optax
+        from tpuframe.ops.flash_attention import flash_mha
+        from tpuframe.analysis import budgets, hlo_audit
+        from tpuframe.parallel import mesh as mesh_lib
+        from tpuframe.parallel import step as step_lib
+
+        mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=4),
+                                  devices=list(topo.devices))
+        repl = NamedSharding(mesh, P())
+        dsh = NamedSharding(mesh, mesh_lib.batch_spec())
+        tx = optax.sgd(0.1)
+
+        def loss_fn(params, model_state, b, rng):
+            q = b["q"]
+            o = flash_mha(q, q, q, causal=True, interpret=False)
+            h = o.reshape(q.shape[0], q.shape[1], -1).astype(jnp.float32)
+            return ((h @ params["w"]) ** 2).mean(), ({}, {})
+
+        state = jax.eval_shape(lambda: step_lib.TrainState.create(
+            {"w": jnp.zeros((256, 1024), jnp.float32)}, tx))
+        to_s = lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl)
+        state = jax.tree.map(
+            lambda s: to_s(s) if hasattr(s, "shape") else s, state,
+            is_leaf=lambda l: isinstance(l, jax.ShapeDtypeStruct))
+        batch = {"q": jax.ShapeDtypeStruct((8, 512, 4, 64), jnp.bfloat16,
+                                           sharding=dsh)}
+        step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False)
+        report, c = hlo_audit.audit_jitted(step, state, batch)
+        txt = c.as_text()
+        assert "tpu_custom_call" in txt or "custom-call" in txt, txt[:2000]
+        pb = 256 * 1024 * 4
+        violations = budgets.check_budget(report, budgets.dp_budget(pb))
+        assert not violations, violations
+        ar = report.bytes_by_kind().get("all-reduce", 0)
+        assert pb <= ar <= 2 * pb, (ar, pb, report.summary())
+        print("FA dp4 budget audit OK:", report.summary())
+    """, timeout=2700)
+    assert "budget audit OK" in out
+
+
 def test_fused_conv_bn_bwd_compiles_for_v5e_at_oom_shape():
     """Round-5 kernel (ops/fused_conv_bn.py): Mosaic lowering of the
     fused backward at the shape whose first tiling overflowed the real

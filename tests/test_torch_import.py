@@ -88,3 +88,25 @@ def test_missing_and_mismatched_keys_raise():
     wrong["fc.weight"] = np.zeros((7, 3), np.float32)
     with pytest.raises(ValueError, match="fc.weight"):
         ti.load_torchvision_resnet(v, wrong)
+
+
+def test_fused_bn_tree_rejected_with_clear_error():
+    # bn='fused' re-keys the Bottleneck 1x1 conv+BN pairs (FusedConvBN_N,
+    # downsample_fused) — the importer must refuse up front with guidance
+    # instead of dying on a raw KeyError mid-import.
+    variables = {
+        "params": {
+            "stem_conv": {"kernel": jnp.zeros((7, 7, 3, 4))},
+            "Bottleneck_0": {
+                "FusedConvBN_0": {"kernel": jnp.zeros((1, 1, 4, 8)),
+                                  "scale": jnp.ones((8,)),
+                                  "bias": jnp.zeros((8,))},
+                "Conv_1": {"kernel": jnp.zeros((3, 3, 8, 8))},
+                "downsample_fused": {"kernel": jnp.zeros((1, 1, 4, 8))},
+            },
+        },
+        "batch_stats": {"Bottleneck_0": {"FusedConvBN_0": {
+            "mean": jnp.zeros((8,)), "var": jnp.ones((8,))}}},
+    }
+    with pytest.raises(ValueError, match="bn='fused'"):
+        ti.load_torchvision_resnet(variables, {})

@@ -1,0 +1,137 @@
+"""``python -m tpuframe.analysis`` — the offline CI gate.
+
+Runs all three analysis layers against the shipped tree and exits
+non-zero on any finding:
+
+  1. source lint (TF101-TF104) over ``tpuframe/``;
+  2. per-strategy collective budget audits — every strategy step program
+     in :mod:`tpuframe.analysis.strategies` is AOT-compiled on a forced
+     multi-device CPU backend and its collectives checked against the
+     declared :class:`~tpuframe.analysis.budgets.CommBudget`;
+  3. registry cross-checks — every
+     :data:`~tpuframe.analysis.budgets.KNOWN_VMEM_EXCLUSIONS` entry must
+     still be excluded by the gate it cites.
+
+Strategies this interpreter cannot express (see
+:class:`~tpuframe.analysis.strategies.Unavailable`) print as SKIP and do
+not fail the gate.
+
+The strategy audits need a multi-device jax backend, so the CLI
+re-executes itself in a child process with a scrubbed CPU-only
+environment (``JAX_PLATFORMS=cpu``, forced host device count, no TPU
+plugin) — the same pattern as the repo's multichip dry run.  Pass
+``--lint-only`` to skip the jax-dependent layers entirely (no re-exec,
+no jax import).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+_CHILD_FLAG = "TPUFRAME_ANALYSIS_CHILD"
+
+
+def _scrubbed_cpu_env(n_devices: int) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # sitecustomize only registers the axon PJRT plugin when
+    # PALLAS_AXON_POOL_IPS is non-empty.
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags).strip()
+    env["PYTHONUNBUFFERED"] = "1"
+    env[_CHILD_FLAG] = "1"
+    return env
+
+
+def _parse(argv):
+    ap = argparse.ArgumentParser(
+        prog="python -m tpuframe.analysis",
+        description="static SPMD/collective analysis (offline CI gate)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: the tpuframe "
+                         "package directory)")
+    ap.add_argument("--lint-only", action="store_true",
+                    help="run only the AST source lint (no jax)")
+    ap.add_argument("--strategy", action="append", default=None,
+                    metavar="NAME",
+                    help="audit only these strategies (repeatable)")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual CPU device count for the strategy "
+                         "audits (default 8)")
+    return ap.parse_args(argv)
+
+
+def _default_lint_paths() -> list[str]:
+    import tpuframe
+
+    return [os.path.dirname(os.path.abspath(tpuframe.__file__))]
+
+
+def _run_lint(paths) -> int:
+    from tpuframe.analysis.source_lint import lint_paths
+
+    findings = lint_paths(paths)
+    for f in findings:
+        print(f"LINT {f}")
+    print(f"[analysis] source lint: {len(findings)} finding(s) over "
+          f"{', '.join(map(str, paths))}")
+    return len(findings)
+
+
+def _run_strategies(names, n_devices) -> int:
+    from tpuframe.analysis import strategies
+
+    failures = 0
+    for audit in strategies.audit_all(n_devices, names):
+        print(f"[analysis] {audit}")
+        if audit.status == "violation":
+            failures += len(audit.violations) or 1
+    return failures
+
+
+def _run_registry_checks() -> int:
+    from tpuframe.analysis.budgets import check_known_exclusions
+
+    problems = check_known_exclusions()
+    for p in problems:
+        print(f"REGISTRY {p}")
+    print(f"[analysis] known-exclusion registry: "
+          f"{len(problems)} problem(s)")
+    return len(problems)
+
+
+def main(argv=None) -> int:
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    lint_paths_arg = args.paths or _default_lint_paths()
+
+    if not args.lint_only and os.environ.get(_CHILD_FLAG) != "1":
+        # Re-exec with a clean multi-device CPU backend; the child runs
+        # this same main() with _CHILD_FLAG set.
+        cmd = [sys.executable, "-m", "tpuframe.analysis",
+               "--devices", str(args.devices)]
+        for s in args.strategy or ():
+            cmd += ["--strategy", s]
+        cmd += args.paths or []
+        return subprocess.call(cmd, env=_scrubbed_cpu_env(args.devices))
+
+    n_findings = _run_lint(lint_paths_arg)
+    if not args.lint_only:
+        n_findings += _run_strategies(
+            tuple(args.strategy) if args.strategy else None, args.devices)
+        n_findings += _run_registry_checks()
+
+    if n_findings:
+        print(f"[analysis] FAIL: {n_findings} finding(s)")
+        return 1
+    print("[analysis] clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

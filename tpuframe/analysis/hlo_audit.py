@@ -1,0 +1,245 @@
+"""Layer 1: the HLO collective auditor.
+
+Promoted from ``perf/_hlo_parse.py`` (which now re-exports from here) and
+generalized from all-reduce-only to every collective XLA emits.  Pure
+text parsing over compiled-HLO (post-GSPMD, the authoritative view — the
+partitioner inserts collectives auto-SPMD programs don't show in their
+StableHLO) with a StableHLO fallback for pre-compile lowerings
+(shard_map programs carry their collectives explicitly there).
+
+The byte accounting is a per-instruction wire-traffic proxy:
+
+  * sync ops: bytes of the instruction's result (for reduce-scatter the
+    result is the scattered shard — the full operand is what crosses the
+    wire, so reduce-scatter uses the larger of operand/result when the
+    operand types are visible, else the result);
+  * ``-start`` ops (the latency-hiding scheduler's async form): the
+    result tuple aliases the operand for equal-size kinds (all-reduce,
+    collective-permute, all-to-all), so their shapes are halved;
+    all-gather-start keeps the largest tuple element (the gathered
+    output);
+  * ``-done`` ops are skipped — their bytes were counted at the start.
+
+This is a *budget-ceiling* model, not a cost model: it answers "did
+GSPMD materialize a collective class/size the strategy never declared",
+not "how many microseconds will the wire take".
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+# HLO op name -> canonical kind.  StableHLO spells these with
+# underscores; both map to the dashed canonical form.
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "i8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2, "i16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "i32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "i64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_DTYPE_RE = "|".join(sorted(_DTYPE_BYTES, key=len, reverse=True))
+
+# `%name = (types) all-reduce(-start)?(operands), ...` — group(1) is the
+# result-type text, group(3) the optional async suffix.  `-done` ops fail
+# the `\(` right after the optional suffix and are skipped by design.
+_HLO_RE = re.compile(
+    r"%?[\w.-]+ = (.*?) (" + "|".join(COLLECTIVE_KINDS) + r")(-start)?\(")
+
+# StableHLO / MHLO: `stablehlo.all_reduce`, `"stablehlo.all_gather"` ...
+# result type parsed from the trailing `-> tensor<...>` (or the tensor
+# list of a tuple result).
+_STABLEHLO_RE = re.compile(
+    r"\b(?:stablehlo|mhlo)\.(" +
+    "|".join(k.replace("-", "_") for k in COLLECTIVE_KINDS) + r")\b")
+
+_SHAPE_RE = re.compile(r"(" + _DTYPE_RE + r")\[([0-9,]*)\]")
+_TENSOR_RE = re.compile(r"tensor<([0-9x]*?)x?(" + _DTYPE_RE + r")>")
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[0-9,{} ]*\})\}")
+
+
+def _shape_bytes(dtype: str, dims_txt: str) -> int:
+    n = 1
+    for d in dims_txt.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES[dtype]
+
+
+@dataclass
+class CollectiveOp:
+    """One parsed collective instruction."""
+
+    kind: str                      # canonical dashed kind
+    bytes: int                     # wire-traffic proxy (see module doc)
+    dtype_bytes: dict[str, int]    # per-dtype breakdown of ``bytes``
+    shapes: list[str] = field(default_factory=list)  # raw result shapes
+    replica_groups: str | None = None
+    is_async: bool = False
+    line: str = ""                 # the (stripped, truncated) source line
+
+    def __str__(self):
+        grp = f" groups={self.replica_groups}" if self.replica_groups else ""
+        return (f"{self.kind}{'-start' if self.is_async else ''} "
+                f"{self.bytes / 1e6:.3f} MB [{', '.join(self.shapes)}]{grp}")
+
+
+@dataclass
+class CollectiveReport:
+    """All collectives of one program, with per-kind aggregates."""
+
+    ops: list[CollectiveOp] = field(default_factory=list)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(op.bytes for op in self.ops)
+
+    def bytes_by_kind(self, min_bytes: int = 0) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for op in self.ops:
+            if op.bytes >= min_bytes:
+                out[op.kind] = out.get(op.kind, 0) + op.bytes
+        return out
+
+    def count_by_kind(self, min_bytes: int = 0) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for op in self.ops:
+            if op.bytes >= min_bytes:
+                out[op.kind] = out.get(op.kind, 0) + 1
+        return out
+
+    def filter(self, min_bytes: int) -> "CollectiveReport":
+        return CollectiveReport(
+            [op for op in self.ops if op.bytes >= min_bytes])
+
+    def summary(self) -> str:
+        if not self.ops:
+            return "no collectives"
+        parts = [f"{k}: {n} op(s), {b / 1e6:.3f} MB"
+                 for (k, n), b in zip(self.count_by_kind().items(),
+                                      self.bytes_by_kind().values())]
+        return (f"{len(self.ops)} collective(s), "
+                f"{self.total_bytes / 1e6:.3f} MB total — "
+                + "; ".join(parts))
+
+
+def parse_collectives(txt: str) -> CollectiveReport:
+    """Parse every collective instruction out of HLO or StableHLO text."""
+    ops: list[CollectiveOp] = []
+    for raw in txt.splitlines():
+        line = raw.strip()
+        m = _HLO_RE.match(line)
+        if m:
+            ops.append(_parse_hlo_op(line, m))
+            continue
+        sm = _STABLEHLO_RE.search(line)
+        if sm:
+            op = _parse_stablehlo_op(line, sm)
+            if op is not None:
+                ops.append(op)
+    return CollectiveReport(ops)
+
+
+def _parse_hlo_op(line: str, m: re.Match) -> CollectiveOp:
+    result_txt, kind, start = m.group(1), m.group(2), bool(m.group(3))
+    shapes = _SHAPE_RE.findall(result_txt)
+    dtype_bytes: dict[str, int] = {}
+    per_shape = [(_shape_bytes(dt, dims), dt, dims) for dt, dims in shapes]
+    if start and kind == "all-gather" and per_shape:
+        # async tuple = (operand, gathered result): keep the output.
+        per_shape = [max(per_shape)]
+    factor = 0.5 if start and kind != "all-gather" else 1.0
+    if kind == "reduce-scatter" and not start:
+        # The full operand crosses the wire; prefer it when visible.
+        operand_shapes = _SHAPE_RE.findall(line[m.end():])
+        if operand_shapes:
+            op_sz = [(_shape_bytes(dt, dims), dt, dims)
+                     for dt, dims in operand_shapes]
+            if sum(s for s, _, _ in op_sz) > sum(s for s, _, _ in per_shape):
+                per_shape = op_sz
+    for sz, dt, _dims in per_shape:
+        dtype_bytes[dt] = dtype_bytes.get(dt, 0) + int(sz * factor)
+    gm = _GROUPS_RE.search(line)
+    return CollectiveOp(
+        kind=kind,
+        bytes=sum(dtype_bytes.values()),
+        dtype_bytes=dtype_bytes,
+        shapes=[f"{dt}[{dims}]" for _, dt, dims in per_shape],
+        replica_groups=gm.group(1) if gm else None,
+        is_async=start,
+        line=line[:200],
+    )
+
+
+def _parse_stablehlo_op(line: str, m: re.Match) -> CollectiveOp | None:
+    kind = m.group(1).replace("_", "-")
+    # Result types come after `->`; fall back to every tensor type on the
+    # line (over-counting is the safe direction for a ceiling check).
+    arrow = line.rfind("->")
+    tensors = _TENSOR_RE.findall(line[arrow:] if arrow >= 0 else line)
+    if not tensors:
+        return None
+    dtype_bytes: dict[str, int] = {}
+    shapes = []
+    for dims_txt, dt in tensors:
+        n = 1
+        for d in dims_txt.split("x"):
+            if d:
+                n *= int(d)
+        dtype_bytes[dt] = dtype_bytes.get(dt, 0) + n * _DTYPE_BYTES[dt]
+        shapes.append(f"{dt}[{dims_txt.replace('x', ',')}]")
+    gm = re.search(r"replica_groups\s*=\s*dense<([^>]*)>", line)
+    return CollectiveOp(
+        kind=kind,
+        bytes=sum(dtype_bytes.values()),
+        dtype_bytes=dtype_bytes,
+        shapes=shapes,
+        replica_groups=gm.group(1).strip()[:120] if gm else None,
+        is_async=False,
+        line=line[:200],
+    )
+
+
+def audit_compiled(compiled) -> CollectiveReport:
+    """Collective report of an AOT-compiled executable (``jit(f).lower(
+    ...).compile()``) — the post-GSPMD, authoritative program text."""
+    return parse_collectives(compiled.as_text())
+
+
+def audit_jitted(jitted, *example_args) -> tuple[CollectiveReport, object]:
+    """Lower + backend-compile ``jitted`` on its example args (shapes
+    only — ``jax.ShapeDtypeStruct`` leaves are fine) and audit the
+    optimized HLO.  Returns ``(report, compiled)`` so callers can chain
+    donation/memory checks on the same artifact."""
+    compiled = jitted.lower(*example_args).compile()
+    return audit_compiled(compiled), compiled
+
+
+# ---------------------------------------------------------------------------
+# Legacy surface (perf/_hlo_parse.py promotion): kept verbatim so the
+# perf scripts and their recorded results keep meaning the same thing.
+# ---------------------------------------------------------------------------
+
+
+def allreduce_payload(txt: str):
+    """Sum all-reduce payload bytes from optimized-HLO text.
+
+    Returns ``({"bf16": bytes, "f32": bytes}, op_count)``.  Handles
+    XLA's variadic tuple all-reduces; an ``all-reduce-start``'s result
+    tuple aliases the operand (shapes appear twice — the form the
+    latency-hiding scheduler emits), so those instructions are halved.
+    """
+    payload = {"bf16": 0.0, "f32": 0.0}
+    ops = 0
+    for op in parse_collectives(txt).ops:
+        if op.kind != "all-reduce":
+            continue
+        for dt in ("bf16", "f32"):
+            payload[dt] += op.dtype_bytes.get(dt, 0)
+        ops += 1
+    return payload, ops

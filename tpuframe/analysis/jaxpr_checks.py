@@ -1,0 +1,241 @@
+"""Layer 2: traced-program (jaxpr) audits.
+
+Three defect classes the compiled-HLO layer cannot attribute cleanly are
+visible in the jaxpr, before any backend work:
+
+  * **f32 upcasts in bf16 regions** — a stray ``.astype(float32)`` (or a
+    library default) silently runs a matmul/conv off the bf16 MXU path,
+    doubling its bytes and flops.  :func:`find_f32_matmuls` reports
+    every MXU-class op whose operands are f32; a step declared
+    ``compute_dtype=bfloat16`` should report none (reductions, BN
+    statistics and optimizer math legitimately accumulate in f32 —
+    those are not matmuls and are not flagged).
+  * **trace-time constant capture** — a host array closed over instead
+    of passed as an argument is baked into the program as a literal:
+    it bloats the executable, defeats donation, and re-traces on every
+    content change.  :func:`find_large_constants` walks the closed
+    jaxpr's consts (including nested jaxprs).
+  * **donation leaks** — a buffer declared donated (``donate_argnums``)
+    that the compiled module does not actually alias to an output keeps
+    BOTH copies live at peak; at ResNet/LM state sizes that is the
+    difference between fitting HBM and not.  :func:`audit_donation`
+    parses the executable's ``input_output_alias`` table and diffs it
+    against the declaration.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+
+# MXU-class primitives: the ops whose dtype decides whether the step is
+# actually running on the bf16 fast path.
+_MATMUL_PRIMS = ("dot_general", "conv_general_dilated")
+
+# Primitive params that hold nested (possibly closed) jaxprs.
+_SUBJAXPR_PARAMS = ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr",
+                    "fun_jaxpr", "fwd_jaxpr_thunk", "branches")
+
+
+def _as_closed(j):
+    """Accept ClosedJaxpr | Jaxpr | objects with a .jaxpr attribute."""
+    if hasattr(j, "jaxpr"):      # ClosedJaxpr
+        return j
+    return None
+
+
+def iter_eqns(closed_jaxpr):
+    """Yield every eqn of a (closed) jaxpr, recursing into nested ones."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for name, v in eqn.params.items():
+            if name not in _SUBJAXPR_PARAMS:
+                continue
+            subs = v if isinstance(v, (list, tuple)) else [v]
+            for sub in subs:
+                if sub is None or callable(sub) and not hasattr(sub, "jaxpr"):
+                    continue
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    yield from iter_eqns(sub)
+
+
+def iter_consts(closed_jaxpr):
+    """Yield every captured constant, recursing into nested closed
+    jaxprs (whose consts are their own)."""
+    consts = getattr(closed_jaxpr, "consts", None) or []
+    yield from consts
+    for eqn in iter_eqns(closed_jaxpr):
+        for name, v in eqn.params.items():
+            if name not in _SUBJAXPR_PARAMS:
+                continue
+            subs = v if isinstance(v, (list, tuple)) else [v]
+            for sub in subs:
+                sub_consts = getattr(sub, "consts", None)
+                if sub_consts:
+                    yield from sub_consts
+
+
+@dataclass
+class PrecisionFinding:
+    primitive: str
+    dtypes: tuple[str, ...]
+    shapes: tuple[tuple[int, ...], ...]
+
+    def __str__(self):
+        ops = ", ".join(f"{d}{list(s)}"
+                        for d, s in zip(self.dtypes, self.shapes))
+        return f"{self.primitive} on ({ops})"
+
+
+def find_f32_matmuls(traced) -> list[PrecisionFinding]:
+    """MXU-class eqns with any float32 operand.
+
+    ``traced``: a (closed) jaxpr, or anything ``jax.make_jaxpr`` already
+    produced.  In a bf16-declared step this list should be empty —
+    each entry is a matmul/conv that fell off the bf16 path.
+    """
+    findings = []
+    for eqn in iter_eqns(traced):
+        if eqn.primitive.name not in _MATMUL_PRIMS:
+            continue
+        avals = [getattr(v, "aval", None) for v in eqn.invars]
+        dts = tuple(str(a.dtype) for a in avals if a is not None)
+        if any(dt == "float32" for dt in dts):
+            findings.append(PrecisionFinding(
+                primitive=eqn.primitive.name,
+                dtypes=dts,
+                shapes=tuple(tuple(a.shape) for a in avals
+                             if a is not None)))
+    return findings
+
+
+def has_bf16(traced) -> bool:
+    """True if any eqn in the program touches bfloat16 — the cheap guard
+    that makes :func:`find_f32_matmuls` meaningful ("bf16 region")."""
+    for eqn in iter_eqns(traced):
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None and str(getattr(aval, "dtype", "")) \
+                    == "bfloat16":
+                return True
+    return False
+
+
+@dataclass
+class ConstFinding:
+    nbytes: int
+    dtype: str
+    shape: tuple[int, ...]
+
+    def __str__(self):
+        return (f"captured constant {self.dtype}{list(self.shape)} "
+                f"({self.nbytes / 1e6:.2f} MB)")
+
+
+def find_large_constants(traced, min_bytes: int = 1 << 20) \
+        -> list[ConstFinding]:
+    """Constants baked into the traced program at or above ``min_bytes``
+    — host arrays that should have been step arguments."""
+    findings = []
+    for c in iter_consts(traced):
+        try:
+            arr = np.asarray(c)
+        except Exception:  # noqa: BLE001 — exotic leaf: not a host bake
+            continue
+        if arr.nbytes >= min_bytes:
+            findings.append(ConstFinding(
+                nbytes=int(arr.nbytes), dtype=str(arr.dtype),
+                shape=tuple(arr.shape)))
+    return sorted(findings, key=lambda f: -f.nbytes)
+
+
+# ---------------------------------------------------------------------------
+# Donation audit — parsed from the executable text, not from warnings,
+# so it works on AOT artifacts and saved HLO dumps alike.
+# ---------------------------------------------------------------------------
+
+# HloModule header form: input_output_alias={ {0}: (0, {}, may-alias),
+# {1}: (2, {1}, must-alias) } — output index tree : (param_number,
+# param_index tree, kind).
+_ALIAS_BLOCK_RE = re.compile(r"input_output_alias=\{(.*?)\}(?:,|\s|$)")
+_ALIAS_ENTRY_RE = re.compile(r"\{[0-9, ]*\}:\s*\((\d+),")
+
+
+def parse_input_output_alias(hlo_text: str) -> set[int]:
+    """Parameter numbers the executable aliases to some output."""
+    aliased: set[int] = set()
+    # The header is one (very long) line; search the whole text but the
+    # alias table only ever appears in the HloModule line.
+    for line in hlo_text.splitlines():
+        if "input_output_alias=" not in line:
+            continue
+        m = _ALIAS_BLOCK_RE.search(line)
+        if not m:
+            continue
+        # Entries may nest one brace level ({1}: (0, {2}, ...)); the
+        # lazy block regex can under-capture — scan the rest of the
+        # line's entries directly instead.
+        tail = line[line.index("input_output_alias=") :]
+        depth, end = 0, 0
+        for i, ch in enumerate(tail):
+            if ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        block = tail[: end + 1]
+        for em in _ALIAS_ENTRY_RE.finditer(block):
+            aliased.add(int(em.group(1)))
+    return aliased
+
+
+@dataclass
+class DonationReport:
+    """Declared-vs-actual buffer donation for one executable."""
+
+    declared: set[int] = field(default_factory=set)   # flat param numbers
+    aliased: set[int] = field(default_factory=set)
+    platform_supports: bool = True
+
+    @property
+    def leaked(self) -> set[int]:
+        return self.declared - self.aliased
+
+    def __str__(self):
+        if not self.platform_supports:
+            return ("donation not implemented on this backend — audit "
+                    "on a TPU topology (AOT) for a real answer")
+        return (f"declared={len(self.declared)} aliased={len(self.aliased)} "
+                f"leaked={len(self.leaked)}"
+                + (f" (param numbers {sorted(self.leaked)[:8]}...)"
+                   if self.leaked else ""))
+
+
+def audit_donation(compiled, declared: set[int] | None = None,
+                   platform: str | None = None) -> DonationReport:
+    """Diff declared donations against the executable's alias table.
+
+    ``compiled``: an AOT executable (``.as_text()``) or raw HLO text.
+    ``declared``: flat parameter numbers expected to be donated; when
+    omitted, the report only carries what IS aliased (useful as a
+    baseline).  XLA:CPU ignores donation entirely — when ``platform``
+    (or the executable's platform) is cpu and nothing aliased,
+    ``platform_supports=False`` instead of reporting a mass leak.
+    """
+    txt = compiled if isinstance(compiled, str) else compiled.as_text()
+    aliased = parse_input_output_alias(txt)
+    if platform is None and not isinstance(compiled, str):
+        try:
+            platform = compiled.runtime_executable().platform()  # pragma: no cover
+        except Exception:  # noqa: BLE001
+            platform = None
+    supports = True
+    if not aliased and (platform or "").lower() in ("cpu", "host"):
+        supports = False
+    return DonationReport(declared=set(declared or ()), aliased=aliased,
+                          platform_supports=supports)

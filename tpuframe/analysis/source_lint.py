@@ -1,0 +1,306 @@
+"""Layer 3: AST lint over tpuframe source for known JAX footguns.
+
+Each rule institutionalizes a defect class rounds 4-5 found by hand:
+
+  TF101  host conversion on a traced value — ``float(x)``,
+         ``np.asarray(x)``, ``x.item()`` inside a jitted/shard_mapped
+         function forces a trace-time concretization error (or, worse,
+         silently bakes a constant when the value happens to be static).
+  TF102  Python control flow on a traced value — ``if jnp.any(mask):``
+         inside traced code raises ConcretizationTypeError at trace
+         time; the fix is ``lax.cond``/``jnp.where``.  Only tests that
+         syntactically involve array computation (``jnp.``/``lax.``
+         calls, ``.any()``/``.all()``) are flagged — ``if axes:`` on
+         static config is fine and common.
+  TF103  timing without a sync — a ``t1 - t0`` duration around a
+         dispatched step measures *dispatch* (async!) unless something
+         in the function forces completion (``block_until_ready``,
+         ``device_get``, ``float()``/``.item()`` on the result).  The
+         round-4 perf rigs hit exactly this.
+  TF104  ``pallas_call`` without an explicit ``interpret=`` decision —
+         the silent-interpret failure mode: a kernel that never went
+         through Mosaic presenting itself as a TPU kernel.  Every call
+         site must say how it decides (the ``_auto_interpret()``
+         pattern).
+
+Scope: TF101/TF102 only fire *inside functions known to be traced*
+(decorated with ``jax.jit``/``pmap``/``shard_map`` or passed to
+``jax.jit(...)`` by name, plus their nested defs) — host code is
+allowed, and encouraged, to call ``float()``.  TF103/TF104 are
+function-/call-site-local and apply everywhere.
+
+Suppression: append ``# tf-lint: ok[TF103]`` (or bare ``# tf-lint: ok``
+for all rules) to the offending line or to the enclosing ``def`` line,
+with a reason in a neighbouring comment.  Suppressions are grep-able
+policy, the same contract as the VMEM known-exclusion registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+RULES = {
+    "TF101": "host conversion on a traced value inside traced code",
+    "TF102": "Python control flow on a traced (array) value",
+    "TF103": "duration measured around device work without a sync",
+    "TF104": "pallas_call without an explicit interpret= decision",
+}
+
+# Decorators that make a function body traced code.
+_TRACING_DECORATORS = {"jit", "pmap", "pjit", "shard_map", "vmap"}
+
+# Call-expression shapes treated as host conversions (TF101).
+_HOST_CONVERTERS = {"float", "int", "bool", "complex"}
+_NP_CONVERTERS = {"asarray", "array"}
+_METHOD_CONVERTERS = {"item", "tolist"}
+
+# TF103: callee names that look like dispatched device work...
+_DEVICE_WORK_RE = re.compile(
+    r"(step|apply|update|forward|jit|compile|sample|generate)", re.I)
+# ...and callee/attribute names that force completion.
+_SYNC_MARKERS = {"block_until_ready", "device_get", "item", "tolist",
+                 "asarray", "array", "float"}
+
+_SUPPRESS_RE = re.compile(r"#\s*tf-lint:\s*ok(?:\[([A-Z0-9, ]+)\])?")
+
+
+@dataclass
+class LintFinding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute(Name('jax'),'jit'); '' when not a name."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_tracing_decorator(dec: ast.AST) -> bool:
+    # @jax.jit / @jit / @shard_map ...
+    tail = _dotted(dec).rsplit(".", 1)[-1]
+    if tail in _TRACING_DECORATORS:
+        return True
+    if isinstance(dec, ast.Call):
+        # @partial(jax.jit, ...) / @jax.jit(...) / @shard_map(...)
+        if _is_tracing_decorator(dec.func):
+            return True
+        if _dotted(dec.func).rsplit(".", 1)[-1] == "partial" and dec.args:
+            return _is_tracing_decorator(dec.args[0])
+    return False
+
+
+def _jitted_names(tree: ast.Module) -> set[str]:
+    """Function names passed to jax.jit(...)/jit(...) anywhere."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func).rsplit(".", 1)[-1]
+        if callee not in _TRACING_DECORATORS:
+            continue
+        for arg in node.args[:1]:
+            if isinstance(arg, ast.Name):
+                names.add(arg.id)
+            elif (isinstance(arg, ast.Call)
+                  and _dotted(arg.func).rsplit(".", 1)[-1] == "partial"
+                  and arg.args and isinstance(arg.args[0], ast.Name)):
+                names.add(arg.args[0].id)
+    return names
+
+
+def _test_touches_arrays(test: ast.AST) -> bool:
+    """True when an `if` test syntactically involves array computation."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d.startswith(("jnp.", "lax.", "jax.numpy.", "jax.lax.")):
+                return True
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("any", "all")
+                    and not _dotted(node.func).startswith(("np.", "numpy."))):
+                return True
+    return False
+
+
+class _FnInfo:
+    def __init__(self, node, traced: bool):
+        self.node = node
+        self.traced = traced
+
+
+def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
+    """Run every rule over one source blob; suppressions already applied."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [LintFinding("TF100", path, e.lineno or 0,
+                            f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    jitted = _jitted_names(tree)
+    findings: list[LintFinding] = []
+
+    def suppressed(rule: str, *linenos: int) -> bool:
+        for ln in linenos:
+            if not (1 <= ln <= len(lines)):
+                continue
+            m = _SUPPRESS_RE.search(lines[ln - 1])
+            if m and (m.group(1) is None
+                      or rule in re.split(r"[,\s]+", m.group(1))):
+                return True
+        return False
+
+    def emit(rule: str, node: ast.AST, msg: str, fn: _FnInfo | None = None):
+        def_line = fn.node.lineno if fn is not None else node.lineno
+        if not suppressed(rule, node.lineno, def_line):
+            findings.append(LintFinding(rule, path, node.lineno, msg))
+
+    def _iter_local(node):
+        """Child nodes of ``node`` excluding nested function subtrees
+        (each nested def is checked in its own visit with its own
+        traced-ness)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield child
+            yield from _iter_local(child)
+
+    def visit_fn(node, enclosing_traced: bool):
+        traced = (enclosing_traced
+                  or node.name in jitted
+                  or any(_is_tracing_decorator(d)
+                         for d in node.decorator_list))
+        info = _FnInfo(node, traced)
+        _check_timing(node, info)
+        for child in _iter_local(node):
+            _check_node(child, info)
+        for sub in _nested_defs(node):
+            visit_fn(sub, traced)
+
+    def _nested_defs(node):
+        out = []
+
+        def rec(n):
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.append(child)
+                else:
+                    rec(child)
+
+        rec(node)
+        return out
+
+    def _check_node(node, fn: _FnInfo | None):
+        traced = fn is not None and fn.traced
+        if isinstance(node, ast.Call):
+            callee = _dotted(node.func)
+            tail = callee.rsplit(".", 1)[-1]
+            if traced:
+                if (tail in _HOST_CONVERTERS and callee == tail
+                        and node.args
+                        and not isinstance(node.args[0], ast.Constant)):
+                    emit("TF101", node,
+                         f"{tail}() on a possibly-traced value inside "
+                         f"traced code — concretizes at trace time", fn)
+                elif (callee.startswith(("np.", "numpy.", "onp."))
+                      and tail in _NP_CONVERTERS):
+                    emit("TF101", node,
+                         f"{callee}() pulls a traced value to host — "
+                         f"use jnp inside traced code", fn)
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in _METHOD_CONVERTERS
+                      and not callee.startswith(("np.", "numpy."))):
+                    emit("TF101", node,
+                         f".{node.func.attr}() on a possibly-traced "
+                         f"value inside traced code", fn)
+            if tail == "pallas_call" and not any(
+                    kw.arg == "interpret" for kw in node.keywords):
+                emit("TF104", node,
+                     "pallas_call without interpret= — decide "
+                     "Mosaic-vs-interpret explicitly (_auto_interpret())",
+                     fn)
+        elif traced and isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            if _test_touches_arrays(node.test):
+                emit("TF102", node,
+                     "Python branch on an array-valued test inside "
+                     "traced code — use lax.cond/jnp.where", fn)
+
+    def _check_timing(node, fn: _FnInfo):
+        timing_names: set[str] = set()
+        has_device_work = False
+        has_sync = False
+        durations = []
+
+        def is_timing_call(c):
+            return (isinstance(c, ast.Call)
+                    and _dotted(c.func).rsplit(".", 1)[-1]
+                    in ("time", "perf_counter", "monotonic"))
+
+        local = list(_iter_local(node))
+        for child in local:
+            if isinstance(child, ast.Assign) and is_timing_call(child.value):
+                for t in child.targets:
+                    if isinstance(t, ast.Name):
+                        timing_names.add(t.id)
+            if isinstance(child, ast.Call):
+                callee = _dotted(child.func)
+                tail = callee.rsplit(".", 1)[-1]
+                if tail in _SYNC_MARKERS:
+                    has_sync = True
+                elif _DEVICE_WORK_RE.search(tail):
+                    has_device_work = True
+        for child in local:
+            if isinstance(child, ast.BinOp) and isinstance(
+                    child.op, ast.Sub):
+                sides = (child.left, child.right)
+                if all(is_timing_call(s)
+                       or (isinstance(s, ast.Name)
+                           and s.id in timing_names)
+                       for s in sides) and (
+                        timing_names or any(map(is_timing_call, sides))):
+                    durations.append(child)
+        if durations and has_device_work and not has_sync:
+            for d in durations:
+                emit("TF103", d,
+                     "duration measured around dispatched device work "
+                     "with no block_until_ready/sync in scope — this "
+                     "times dispatch, not execution", fn)
+
+    for top in _iter_local(tree):
+        _check_node(top, None)     # module level: TF104 still applies
+    for top in _nested_defs(tree):
+        visit_fn(top, False)
+    return findings
+
+
+def lint_paths(paths, exclude: tuple[str, ...] = ()) -> list[LintFinding]:
+    """Lint every ``.py`` under each path (file or directory tree)."""
+    findings: list[LintFinding] = []
+    for p in paths:
+        p = Path(p)
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            rel = str(f)
+            if any(part in rel for part in exclude):
+                continue
+            try:
+                src = f.read_text()
+            except OSError as e:
+                findings.append(LintFinding("TF100", rel, 0, str(e)))
+                continue
+            findings.extend(lint_source(src, rel))
+    return findings

@@ -1,0 +1,312 @@
+"""Auditable step programs, one per MULTICHIP parallelism strategy.
+
+Each builder constructs the *real* framework step — the same
+``make_train_step``/``pp_lm`` machinery production uses — over a tiny
+model and a shapes-only state (``jax.eval_shape``; no parameter math
+runs), lowers it AOT, and pairs the compiled program with the strategy's
+declared :class:`~tpuframe.analysis.budgets.CommBudget`.  That makes the
+communication-structure contract of every strategy checkable in seconds
+on a CPU host: ``audit_strategy("lm-tensor-parallel")`` is the static
+equivalent of burning a pod slice to discover a mis-sharding.
+
+Capability gating: strategies whose step code needs jax features this
+interpreter lacks (the vma/pcast machinery behind ring/Ulysses sequence
+parallelism, GPipe PP and adasum on jax < 0.6) raise
+:class:`Unavailable` with the missing-API reason instead of failing —
+the CLI reports them as SKIP, tests ``pytest.skip`` on them, and on a
+current jax they audit for real.  An Unavailable is a *capability*
+statement, never a budget verdict.
+
+Everything here expects a multi-device backend; on a plain CPU host run
+under ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (the CLI's
+child process sets this up — see ``tpuframe.analysis.__main__``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpuframe.analysis import budgets as budgets_lib
+from tpuframe.analysis import hlo_audit
+
+# Exception types that signal "this jax cannot express the strategy",
+# as opposed to a real defect in the step program.
+_CAPABILITY_ERRORS = (AttributeError, ImportError, NotImplementedError)
+
+
+class Unavailable(Exception):
+    """The strategy cannot be built in this environment (missing jax
+    feature or too few devices) — a skip, not a failure."""
+
+
+@dataclass
+class StrategyAudit:
+    """Outcome of auditing one strategy's step program."""
+
+    name: str
+    status: str                    # "ok" | "violation" | "unavailable"
+    reason: str = ""               # set when unavailable
+    violations: list[str] = field(default_factory=list)
+    report: hlo_audit.CollectiveReport | None = None
+    budget: budgets_lib.CommBudget | None = None
+    param_bytes: int = 0
+    compiled: object = None        # the AOT executable, for chained checks
+
+    def __str__(self):
+        if self.status == "unavailable":
+            return f"SKIP {self.name}: {self.reason}"
+        head = "PASS" if self.status == "ok" else "FAIL"
+        body = self.report.summary() if self.report else "no report"
+        tail = "".join(f"\n    {v}" for v in self.violations)
+        return f"{head} {self.name}: {body}{tail}"
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+    import numpy as np
+
+    return int(sum(np.prod(l.shape or (1,)) * np.dtype(l.dtype).itemsize
+                   for l in jax.tree.leaves(tree)))
+
+
+def _require_devices(n: int):
+    import jax
+
+    have = len(jax.devices())
+    if have < n:
+        raise Unavailable(
+            f"needs {n} devices, have {have} — run under "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n} "
+            f"(python -m tpuframe.analysis does this automatically)")
+
+
+def _lm_pieces(batch: int = 8, seq: int = 32, **cfg_kw):
+    """Tiny TransformerLM + shapes-only state/batch for AOT lowering."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpuframe import models
+    from tpuframe.models import losses
+    from tpuframe.parallel import step as step_lib
+
+    model = models.get_model("transformer-lm", tiny=True, vocab_size=64,
+                             max_seq=seq, **cfg_kw)
+    variables = jax.eval_shape(model.init, jax.random.key(0),
+                               jax.ShapeDtypeStruct((1, seq), jnp.int32))
+    tx = optax.adamw(1e-3)
+
+    def loss_fn(params, model_state, b, rng):
+        logits = model.apply({"params": params}, b["input_ids"],
+                             train=True, rngs={"dropout": rng})
+        return losses.softmax_cross_entropy(logits, b["labels"]), ({}, {})
+
+    state = jax.eval_shape(lambda p: step_lib.TrainState.create(p, tx),
+                           variables["params"])
+    ids = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    example = (state, {"input_ids": ids, "labels": ids})
+    param_bytes = _tree_bytes(variables["params"])
+    # one activation tensor [B, S, H] in compute dtype (f32 for tiny)
+    act_bytes = batch * seq * 64 * 4
+    return model, loss_fn, tx, example, param_bytes, act_bytes
+
+
+# --------------------------------------------------------------------------
+# Builders.  Each returns (jitted_step, example_args, budget, param_bytes).
+# --------------------------------------------------------------------------
+
+
+def _build_dp(n_devices: int):
+    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
+
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=n_devices))
+    _, loss_fn, tx, example, pb, _ = _lm_pieces()
+    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False)
+    return step, example, budgets_lib.dp_budget(pb), pb
+
+
+def _build_fsdp(n_devices: int):
+    from tpuframe.parallel import fsdp as fsdp_lib
+    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
+
+    mesh = mesh_lib.make_mesh(
+        mesh_lib.MeshSpec(data=n_devices // 2, fsdp=2))
+    _, loss_fn, tx, (state, batch), pb, _ = _lm_pieces()
+    shardings = fsdp_lib.state_shardings(state, mesh)
+    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
+                                    state_shardings=shardings)
+    return step, (state, batch), budgets_lib.fsdp_budget(pb), pb
+
+
+def _build_tp(n_devices: int):
+    from tpuframe.parallel import fsdp as fsdp_lib, tp as tp_lib
+    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
+
+    tp = 4 if n_devices % 4 == 0 else 2
+    mesh = mesh_lib.make_mesh(
+        mesh_lib.MeshSpec(data=n_devices // tp, model=tp))
+    _, loss_fn, tx, (state, batch), pb, ab = _lm_pieces()
+    shardings = fsdp_lib.state_shardings(
+        state, mesh, tp_rules=tp_lib.rules_for_model("transformer-lm"))
+    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
+                                    state_shardings=shardings)
+    return step, (state, batch), budgets_lib.tp_budget(
+        pb, ab, num_layers=2), pb
+
+
+def _build_ring_sp(n_devices: int, seq_mode: str = "ring"):
+    from jax.sharding import PartitionSpec as P
+
+    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
+
+    sp = 4 if n_devices % 4 == 0 else 2
+    mesh = mesh_lib.make_mesh(
+        mesh_lib.MeshSpec(data=n_devices // sp, seq=sp))
+    _, loss_fn, tx, (state, batch), pb, ab = _lm_pieces(seq_mode=seq_mode)
+    part = P(mesh_lib.BATCH_AXES, "seq")
+    step = step_lib.make_train_step(
+        loss_fn, tx, mesh, donate=False, batch_partition=part,
+        reduce_axes=(*mesh_lib.BATCH_AXES, "seq"))
+    if seq_mode == "ring":
+        budget = budgets_lib.ring_sp_budget(pb, kv_bytes=2 * ab,
+                                            sp_degree=sp)
+    else:
+        budget = budgets_lib.ulysses_sp_budget(pb, ab)
+    return step, (state, batch), budget, pb
+
+
+def _build_ulysses(n_devices: int):
+    return _build_ring_sp(n_devices, seq_mode="ulysses")
+
+
+def _build_pp(n_devices: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpuframe.models.transformer_lm import LMConfig, ScanBlockLM
+    from tpuframe.parallel import mesh as mesh_lib, pp_lm
+    from tpuframe.parallel import step as step_lib
+
+    pipe = 4 if n_devices % 4 == 0 else 2
+    mesh = mesh_lib.make_mesh(
+        mesh_lib.MeshSpec(data=n_devices // pipe, pipe=pipe))
+    cfg = LMConfig.tiny(vocab_size=64, hidden_size=32, num_layers=pipe,
+                        num_heads=2, intermediate_size=64, max_seq=16)
+    model = ScanBlockLM(cfg)
+    tx = optax.adamw(1e-3)
+    variables = jax.eval_shape(model.init, jax.random.key(0),
+                               jax.ShapeDtypeStruct((1, 16), jnp.int32))
+    n_micro = 2
+    factory, _place_state, _place_batch = pp_lm.make_pp_lm_step(
+        model, tx, mesh, n_micro=n_micro)
+    state = jax.eval_shape(lambda p: step_lib.TrainState.create(p, tx),
+                           variables["params"])
+    ids = jax.ShapeDtypeStruct((8, 16), jnp.int32)
+    step = factory(state)
+    pb = _tree_bytes(variables["params"])
+    ab = 8 * 16 * 32 * 4
+    return (step, (state, {"input_ids": ids, "labels": ids}),
+            budgets_lib.pp_budget(pb, ab, n_micro=n_micro), pb)
+
+
+def _build_ep(n_devices: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpuframe.models import losses
+    from tpuframe.models.transformer_lm import LMConfig, TransformerLM
+    from tpuframe.parallel import fsdp as fsdp_lib, tp as tp_lib
+    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
+
+    ep = 2
+    mesh = mesh_lib.make_mesh(
+        mesh_lib.MeshSpec(data=n_devices // ep, expert=ep))
+    cfg = LMConfig.tiny(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, intermediate_size=64, max_seq=16,
+                        moe_experts=4, moe_k=2, moe_every=1)
+    model = TransformerLM(cfg)
+    variables = jax.eval_shape(model.init, jax.random.key(0),
+                               jax.ShapeDtypeStruct((1, 16), jnp.int32))
+    tx = optax.adamw(1e-3)
+
+    def loss_fn(params, model_state, b, rng):
+        logits, sown = model.apply({"params": params}, b["input_ids"],
+                                   train=True, rngs={"dropout": rng},
+                                   mutable=["aux_loss"])
+        loss = losses.softmax_cross_entropy(logits, b["labels"])
+        leaves = jax.tree.leaves(sown)
+        aux = sum(leaves) / max(len(leaves), 1)
+        return loss + cfg.moe_aux_weight * aux, ({}, {"moe_aux": aux})
+
+    state = jax.eval_shape(lambda p: step_lib.TrainState.create(p, tx),
+                           variables["params"])
+    shardings = fsdp_lib.state_shardings(
+        state, mesh, tp_rules=tp_lib.rules_for_model("transformer-lm"))
+    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
+                                    state_shardings=shardings)
+    ids = jax.ShapeDtypeStruct((8, 16), jnp.int32)
+    pb = _tree_bytes(variables["params"])
+    ab = 8 * 16 * 32 * 4
+    return (step, (state, {"input_ids": ids, "labels": ids}),
+            budgets_lib.ep_budget(pb, ab), pb)
+
+
+def _build_adasum(n_devices: int):
+    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
+
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=n_devices))
+    _, loss_fn, tx, example, pb, _ = _lm_pieces()
+    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
+                                    grad_reduce="adasum")
+    return step, example, budgets_lib.adasum_budget(pb, n_devices), pb
+
+
+#: MULTICHIP_r05.json strategy name -> builder.
+STRATEGIES = {
+    "dp": _build_dp,
+    "resnet-fsdp": _build_fsdp,
+    "lm-tensor-parallel": _build_tp,
+    "lm-seq-parallel": _build_ring_sp,
+    "lm-seq-ulysses": _build_ulysses,
+    "pipeline-parallel": _build_pp,
+    "expert-parallel": _build_ep,
+    "dp-adasum": _build_adasum,
+}
+
+
+def audit_strategy(name: str, n_devices: int = 8) -> StrategyAudit:
+    """Build, AOT-compile and budget-check one strategy's step program."""
+    if name not in STRATEGIES:
+        raise ValueError(f"unknown strategy {name!r}; "
+                         f"have {sorted(STRATEGIES)}")
+    try:
+        _require_devices(n_devices)
+        step, example, budget, pb = STRATEGIES[name](n_devices)
+        report, compiled = hlo_audit.audit_jitted(step, *example)
+    except Unavailable as e:
+        return StrategyAudit(name=name, status="unavailable",
+                             reason=str(e))
+    except _CAPABILITY_ERRORS as e:
+        return StrategyAudit(
+            name=name, status="unavailable",
+            reason=f"{type(e).__name__}: {e} (jax {_jax_version()} lacks "
+                   f"an API this strategy's step code needs)")
+    violations = budgets_lib.check_budget(report, budget)
+    return StrategyAudit(
+        name=name, status="ok" if not violations else "violation",
+        violations=violations, report=report, budget=budget,
+        param_bytes=pb, compiled=compiled)
+
+
+def audit_all(n_devices: int = 8,
+              names: tuple[str, ...] | None = None) -> list[StrategyAudit]:
+    return [audit_strategy(n, n_devices)
+            for n in (names or tuple(STRATEGIES))]
+
+
+def _jax_version() -> str:
+    import jax
+
+    return jax.__version__

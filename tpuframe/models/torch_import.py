@@ -95,6 +95,22 @@ def _stage_block_index(params_flat, block) -> dict[tuple[int, int], int]:
     return mapping
 
 
+def _reject_fused(params_flat) -> None:
+    """Refuse ``bn='fused'`` trees up front instead of dying on a raw
+    KeyError mid-import: FusedConvBN renames the Bottleneck 1x1 conv+BN
+    pairs (FusedConvBN_N / downsample_fused), so the torchvision name map
+    above does not apply to them."""
+    fused = sorted({k.split("/")[0] for k in params_flat
+                    if "FusedConvBN" in k or "downsample_fused" in k})
+    if fused:
+        raise ValueError(
+            "load_torchvision_resnet does not support bn='fused' models "
+            f"(found fused modules {fused[:4]}...): FusedConvBN folds the "
+            "1x1 conv+BN pairs into one module with its own param names. "
+            "Import into a bn='flax' model, then rebuild with bn='fused' — "
+            "the two share identical per-layer weights (PERF.md §7.4b).")
+
+
 def load_torchvision_resnet(variables: dict, state_dict: dict) -> dict:
     """Return a new ``{"params", "batch_stats"}`` tree with every leaf
     replaced from the torchvision ``state_dict``.  Raises KeyError on a
@@ -103,6 +119,7 @@ def load_torchvision_resnet(variables: dict, state_dict: dict) -> dict:
     block = _block_prefix(variables)
     params = _flat(variables["params"])
     stats = _flat(variables["batch_stats"])
+    _reject_fused(params)
     idx = _stage_block_index(params, block)
 
     def conv(w):

@@ -43,12 +43,48 @@ def assert_uniform_across_hosts(tag: str, payload: bytes | str) -> None:
             f"randomness.")
 
 
-def check_step_program(compiled_or_jitted, tag: str, *example_args) -> None:
+def check_step_program(compiled_or_jitted, tag: str, *example_args,
+                       budget=None) -> None:
     """Hash the step function's lowered StableHLO across hosts.
 
     ``lower()`` traces but does not backend-compile, so this is cheap enough
     for a startup debug check; the trace also warms nothing (jit caches by
     avals, and the same args are about to be used for real).
+
+    ``budget``: an optional :class:`tpuframe.analysis.budgets.CommBudget`.
+    When given, the same lowering is backend-compiled and its collectives
+    are audited against the budget (see ``audit_step_program``) — the hash
+    check and the collective audit run off one trace, so they cannot
+    disagree about which program they inspected.
     """
     lowered = compiled_or_jitted.lower(*example_args)
     assert_uniform_across_hosts(f"{tag}:stablehlo", lowered.as_text())
+    if budget is not None:
+        audit_lowered(lowered, tag, budget)
+
+
+def audit_lowered(lowered, tag: str, budget) -> None:
+    """Compile an already-lowered step and check its collectives against a
+    declared :class:`tpuframe.analysis.budgets.CommBudget`; raise
+    RuntimeError on any violation.  Split out of ``check_step_program`` so
+    single-host runs (where the hash allgather is a no-op) can still audit.
+    """
+    from tpuframe.analysis.budgets import check_budget
+    from tpuframe.analysis.hlo_audit import audit_compiled
+
+    report = audit_compiled(lowered.compile())
+    violations = check_budget(report, budget)
+    if violations:
+        lines = "\n  ".join(violations)
+        raise RuntimeError(
+            f"collective budget violation in {tag!r} (budget "
+            f"{budget.name!r}):\n  {lines}\n"
+            f"wire summary: {report.summary()}")
+
+
+def audit_step_program(compiled_or_jitted, tag: str, *example_args,
+                       budget) -> None:
+    """Startup collective-budget audit of a step program (no cross-host
+    hash check) — ``check_step_program(..., budget=...)`` minus the
+    allgather, for use outside ``TPUFRAME_CHECK_SPMD`` debug mode."""
+    audit_lowered(compiled_or_jitted.lower(*example_args), tag, budget)

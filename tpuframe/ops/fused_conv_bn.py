@@ -54,6 +54,17 @@ The 3x3 convs and the stem keep the XLA path: their g tensors are the
 small-C minority of the bytes and an implicit-GEMM halo kernel is not
 worth the risk for them (measured priority, not principle).
 
+KNOWN EXCLUSION — ResNet-50 layer4 downsample: the VMEM gate in
+``_pick_tiles`` keeps the resident weight block + f32 dW accumulator
+under the 10 MB budget via ``k * c * 6 <= _VMEM_BUDGET``; the layer4
+downsample 1x1 is K=1024 -> C=2048, i.e. 1024*2048*6 = 12.58 MB, so
+``supported()`` returns False and that one pair falls back to the
+plain-XLA composition (correct, just unfused).  Every other ResNet-50
+1x1 fits.  Tracked as the first entry of
+``tpuframe.analysis.budgets.KNOWN_VMEM_EXCLUSIONS`` — the analysis CI
+gate cross-checks the registry against this gate so the exclusion list
+cannot silently drift from the code (PERF.md §11).
+
 Reference parity: the reference's ResNet comes from torchvision
 (SURVEY.md §3a); its conv+BN backward is cuDNN's fused
 ``cudnnBatchNormalizationBackwardEx`` + conv grad kernels.  This is the

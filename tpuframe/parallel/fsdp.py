@@ -21,7 +21,12 @@ from typing import Any
 
 import jax
 import numpy as np
-from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax.sharding import AxisType
+except ImportError:  # older jax: no sharding-in-types; all axes are Auto
+    AxisType = None
 
 PyTree = Any
 
@@ -35,6 +40,8 @@ def auto_mesh(mesh: Mesh) -> Mesh:
     auto-SPMD propagation refuses ambiguous ops (e.g. embedding gathers from
     an fsdp-sharded table).  The FSDP path wants classic GSPMD propagation,
     so its shardings are built on an Auto twin of the same device layout."""
+    if AxisType is None or not hasattr(mesh, "axis_types"):
+        return mesh  # pre-AxisType jax: every mesh already propagates Auto
     if all(t == AxisType.Auto for t in mesh.axis_types):
         return mesh
     return Mesh(mesh.devices, mesh.axis_names,

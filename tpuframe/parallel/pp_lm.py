@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuframe.models import losses
 from tpuframe.parallel import mesh as mesh_lib, pp
-from tpuframe.parallel.step import TrainState
+from tpuframe.parallel.step import TrainState, _shard_map
 
 
 def state_partition(state: TrainState) -> TrainState:
@@ -167,7 +167,7 @@ def make_pp_lm_step(model, tx: optax.GradientTransformation, mesh: Mesh, *,
     def step_fn_factory(state):
         sp = specs(state)
         batch_part = P(mesh_lib.BATCH_AXES)
-        mapped = jax.shard_map(
+        mapped = _shard_map(
             body, mesh=mesh,
             in_specs=(sp, {"input_ids": batch_part, "labels": batch_part}),
             out_specs=(sp, P()),
@@ -221,7 +221,7 @@ def make_pp_lm_eval(model, mesh: Mesh, *, n_micro: int,
         if spec_tree is None:
             spec_tree = state_partition(state)
         batch_part = P(mesh_lib.BATCH_AXES)
-        mapped = jax.shard_map(
+        mapped = _shard_map(
             body, mesh=mesh,
             in_specs=(spec_tree,
                       {"input_ids": batch_part, "labels": batch_part}),

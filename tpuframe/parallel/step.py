@@ -30,6 +30,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuframe.parallel import mesh as mesh_lib
 
+_LEGACY_SHARD_MAP = not hasattr(jax, "shard_map")
+if not _LEGACY_SHARD_MAP:
+    _shard_map = jax.shard_map
+else:  # older jax: jax.experimental.shard_map, no vma types
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def _shard_map(f, *, mesh, in_specs, out_specs):
+        # The legacy static replication checker cannot infer through the
+        # step body (no vma types), so it is disabled — which ALSO
+        # disables the psum-transpose rewrite that the pmean-of-loss
+        # gradient path relies on.  _grad_step compensates by taking
+        # local gradients and reducing them explicitly when
+        # _LEGACY_SHARD_MAP is set (verified against the single-device
+        # step; see tests/test_analysis.py).
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False)
+
 PyTree = Any
 
 # loss_fn(params, model_state, batch, rng) -> (loss, (new_model_state, metrics))
@@ -100,6 +117,10 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     # pmean-of-loss transpose (which pre-averages) cannot be used.
     explicit = bool(axes) and (fusion_threshold is not None
                                or grad_reduce == "adasum")
+    # Legacy shard_map (check_rep=False) has no psum-transpose rewrite:
+    # differentiating the pmean-ed loss there yields LOCAL grads with no
+    # implicit reduction, so the reduction must be explicit.
+    legacy_local = bool(axes) and _LEGACY_SHARD_MAP and not explicit
     diff_params = state.params
     if explicit:
         diff_params = jax.tree.map(
@@ -107,7 +128,7 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
 
     def global_loss(params, model_state, batch, rng):
         loss, aux = loss_fn(params, model_state, batch, rng)
-        if axes and not explicit:
+        if axes and not explicit and not legacy_local:
             loss = lax.pmean(loss, axes)
         return loss, aux
 
@@ -116,7 +137,7 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
 
     return _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, state,
                              grads, loss, metrics, model_state,
-                             reduce_grads=explicit)
+                             reduce_grads=explicit or legacy_local)
 
 
 def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, state, grads,
@@ -341,7 +362,7 @@ def make_train_step(
 
     body = functools.partial(_grad_step, loss_fn, tx, axes, fusion_threshold,
                              accum_steps, grad_reduce)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         body, mesh=mesh,
         in_specs=(P(), batch_part),
         out_specs=(P(), P()),
@@ -386,7 +407,7 @@ def make_eval_step(
         metrics = metric_fn(state.params, state.model_state, batch)
         return jax.tree.map(lambda m: lax.pmean(m, axes), metrics)
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         body, mesh=mesh,
         in_specs=(P(), batch_part),
         out_specs=P(),

@@ -129,7 +129,8 @@ def _cifar10_resnet18() -> TrainConfig:
     return TrainConfig(
         name="cifar10_resnet18", model="resnet18",
         model_kwargs={"num_classes": 10, "cifar_stem": True},
-        dataset="cifar10", optimizer="sgd", base_lr=0.1, warmup_steps=200,
+        dataset="cifar10", dataset_kwargs={"keep_u8": True},
+        optimizer="sgd", base_lr=0.1, warmup_steps=200,
         schedule="cosine", weight_decay=5e-4, global_batch=256,
         total_steps=2000, eval_every=500,
         augment="pad_crop_flip",   # the classic CIFAR train recipe
