@@ -403,6 +403,89 @@ def test_lint_nested_def_inherits_tracedness():
     """) == ["TF101"]
 
 
+def test_tf105_raw_gcs_call_outside_gcs_layer():
+    src = """
+        def fetch(bucket, key):
+            return bucket.blob(key).download_as_bytes()
+    """
+    findings = source_lint.lint_source(textwrap.dedent(src),
+                                       "tpuframe/data/loader.py")
+    assert [f.rule for f in findings] == ["TF105"]
+    # ...and uploads / listings too
+    src2 = """
+        def push(bucket, key, data):
+            bucket.blob(key).upload_from_string(data)
+            return list(client.list_blobs(bucket))
+    """
+    findings2 = source_lint.lint_source(textwrap.dedent(src2),
+                                        "tpuframe/ckpt/uploader.py")
+    assert [f.rule for f in findings2] == ["TF105", "TF105"]
+
+
+def test_tf105_gcs_layer_itself_is_exempt():
+    src = """
+        def _read_bytes_once(path):
+            return _client().bucket(b).blob(k).download_as_bytes(timeout=60)
+    """
+    assert source_lint.lint_source(textwrap.dedent(src),
+                                   "tpuframe/data/gcs.py") == []
+
+
+def test_tf105_unbounded_sleep_retry_loop():
+    assert _rules("""
+        import time
+
+        def poll(path):
+            while True:
+                if fetch(path):
+                    break
+                time.sleep(1.0)
+    """) == ["TF105"]
+
+
+def test_tf105_bounded_retry_loops_are_clean():
+    # a comparison (attempt bound) in the loop body makes it bounded...
+    assert _rules("""
+        import time
+
+        def poll(path):
+            attempt = 0
+            while True:
+                attempt += 1
+                if attempt >= 5:
+                    return None
+                time.sleep(1.0)
+    """) == []
+    # ...as does reading a clock (deadline pattern), or raising
+    assert _rules("""
+        import time
+
+        def poll(deadline):
+            while True:
+                now = time.monotonic()
+                time.sleep(1.0)
+    """) == []
+    # and a non-`while True` loop never matches at all
+    assert _rules("""
+        import time
+
+        def poll(tries):
+            while tries:
+                tries -= 1
+                time.sleep(1.0)
+    """) == []
+
+
+def test_tf105_suppression():
+    assert _rules("""
+        import time
+
+        def forever():
+            while True:  # tf-lint: ok[TF105]
+                time.sleep(60.0)
+    """) == []
+
+
 def test_shipped_tree_self_lints_clean():
     import tpuframe
 

@@ -197,12 +197,14 @@ def test_run_with_relaunch_retries_then_succeeds():
         return 13 if calls["n"] < 3 else 0  # stall-abort rc twice, then ok
 
     msgs = []
-    assert run_with_relaunch(run_once, 5, log=msgs.append) == 0
+    assert run_with_relaunch(run_once, 5, log=msgs.append,
+                             sleep=lambda s: None) == 0
     assert calls["n"] == 3
     assert any("relaunch 2/5" in m for m in msgs)
     # budget exhausted: the last nonzero rc propagates
     calls["n"] = -10
-    assert run_with_relaunch(run_once, 2, log=msgs.append) == 13
+    assert run_with_relaunch(run_once, 2, log=msgs.append,
+                             sleep=lambda s: None) == 13
 
 
 @pytest.mark.slow

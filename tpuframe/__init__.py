@@ -18,6 +18,8 @@ Layering (SURVEY.md §2):
   - ``tpuframe.launch``   — L5/L6: TPU-VM provisioning + SSH fan-out launcher.
   - ``tpuframe.obs``      — tracing, metrics, heartbeat/stall detection.
   - ``tpuframe.ops``      — pallas TPU kernels + native C++ host runtime.
+  - ``tpuframe.resilience`` — I/O retry policies, the preemption contract
+    (rc 14), structured fault injection (docs/DESIGN.md "Failure model").
 """
 
 __version__ = "0.1.0"

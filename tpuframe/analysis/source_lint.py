@@ -22,6 +22,14 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          through Mosaic presenting itself as a TPU kernel.  Every call
          site must say how it decides (the ``_auto_interpret()``
          pattern).
+  TF105  resilience bypass — (a) a raw GCS client call
+         (``download_as_bytes``/``upload_from_string``/``list_blobs``/
+         ...) anywhere outside ``data/gcs.py``: every storage op must go
+         through the retry-wrapped layer, or it silently loses backoff,
+         timeouts, fault seams and retry metrics; (b) a ``while True:``
+         loop that sleeps but never compares, raises, or reads a clock —
+         an unbounded retry loop with no exit condition, the shape that
+         wedges a supervisor forever (use RetryPolicy).
 
 Scope: TF101/TF102 only fire *inside functions known to be traced*
 (decorated with ``jax.jit``/``pmap``/``shard_map`` or passed to
@@ -47,6 +55,15 @@ RULES = {
     "TF102": "Python control flow on a traced (array) value",
     "TF103": "duration measured around device work without a sync",
     "TF104": "pallas_call without an explicit interpret= decision",
+    "TF105": "storage call or retry loop bypassing the resilience layer",
+}
+
+# TF105a: google.cloud.storage blob/bucket methods — allowed only inside
+# the retry-wrapped data/gcs.py layer.
+_RAW_GCS_METHODS = {
+    "download_as_bytes", "download_as_string", "download_to_filename",
+    "upload_from_string", "upload_from_file", "upload_from_filename",
+    "list_blobs", "rename_blob",
 }
 
 # Decorators that make a function body traced code.
@@ -233,11 +250,49 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
                      "pallas_call without interpret= — decide "
                      "Mosaic-vs-interpret explicitly (_auto_interpret())",
                      fn)
-        elif traced and isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _RAW_GCS_METHODS
+                    and not path.replace("\\", "/").endswith("data/gcs.py")):
+                emit("TF105", node,
+                     f".{node.func.attr}() raw GCS client call outside "
+                     f"data/gcs.py — route it through the retry-wrapped "
+                     f"gcs layer (tpuframe.resilience)", fn)
+        elif isinstance(node, ast.While):
+            if (isinstance(node.test, ast.Constant)
+                    and node.test.value is True):
+                _check_unbounded_retry(node, fn)
+            if traced and _test_touches_arrays(node.test):
+                emit("TF102", node,
+                     "Python branch on an array-valued test inside "
+                     "traced code — use lax.cond/jnp.where", fn)
+        elif traced and isinstance(node, (ast.If, ast.IfExp)):
             if _test_touches_arrays(node.test):
                 emit("TF102", node,
                      "Python branch on an array-valued test inside "
                      "traced code — use lax.cond/jnp.where", fn)
+
+    def _check_unbounded_retry(node: ast.While, fn: _FnInfo | None):
+        """TF105b: ``while True`` + sleep with no comparison, raise, or
+        clock read in the loop's own body is a retry loop that can never
+        give up — it outlives deadlines, watchdogs and operators."""
+        sleeps = False
+        bounded = False
+        for child in node.body:
+            for sub in [child, *_iter_local(child)]:
+                if isinstance(sub, (ast.Compare, ast.Raise)):
+                    bounded = True
+                elif isinstance(sub, ast.Call):
+                    tail = _dotted(sub.func).rsplit(".", 1)[-1]
+                    if tail == "sleep":
+                        sleeps = True
+                    elif tail in ("time", "monotonic", "perf_counter"):
+                        bounded = True
+        if sleeps and not bounded:
+            emit("TF105", node,
+                 "unbounded `while True` retry loop: sleeps but never "
+                 "compares, raises, or reads a clock — use "
+                 "resilience.RetryPolicy (bounded attempts + deadline)",
+                 fn)
 
     def _check_timing(node, fn: _FnInfo):
         timing_names: set[str] = set()

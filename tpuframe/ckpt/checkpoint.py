@@ -38,6 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuframe.data import gcs
+from tpuframe.resilience import faults
 
 PyTree = Any
 
@@ -160,7 +161,10 @@ def _write_files(path: str, owned_files) -> dict:
         buf = io.BytesIO()
         np.save(buf, data)
         raw = buf.getvalue()
-        gcs.write_bytes(gcs.join(path, fname), raw)
+        # The ckpt_shard fault seam mangles the bytes actually written while
+        # the CRC is computed over the CLEAN bytes — modeling storage-side
+        # corruption, which restore must catch via the CRC mismatch.
+        gcs.write_bytes(gcs.join(path, fname), faults.mangle("ckpt_shard", raw))
         crc_local[fname] = _crc32(raw)
     return crc_local
 
@@ -542,13 +546,32 @@ def _barrier() -> None:
     bootstrap.host_barrier("tpuframe_ckpt_commit")
 
 
-def latest_step(directory: str) -> int | None:
+def _committed_steps(directory: str) -> list[int]:
+    """Committed checkpoint steps, ascending.  Quarantined ``.corrupt``
+    dirs don't match ``_STEP_RE`` and so are invisible here by design."""
     steps = []
     for name in gcs.listdir(directory):
         m = _STEP_RE.match(name)
         if m and gcs.exists(gcs.join(directory, name, _COMMIT)):
             steps.append(int(m.group(1)))
-    return max(steps) if steps else None
+    return sorted(steps)
+
+
+def latest_step(directory: str) -> int | None:
+    steps = _committed_steps(directory)
+    return steps[-1] if steps else None
+
+
+def quarantine_step(directory: str, step: int) -> str:
+    """Rename ``step_N`` to ``step_N.corrupt`` so resume skips it forever
+    while the evidence survives for post-mortem.  Process 0 only — a pod
+    of hosts discovering the same bad checkpoint must not race the rename
+    (losers would see FileNotFoundError on a directory already moved)."""
+    src = gcs.join(directory, f"step_{step:08d}")
+    dst = src + ".corrupt"
+    if jax.process_index() == 0:
+        gcs.rename_tree(src, dst)
+    return dst
 
 
 class CheckpointManager:
@@ -710,12 +733,36 @@ class CheckpointManager:
 
     def restore_latest(self, *, mesh: Mesh | None = None,
                        target: PyTree | None = None):
-        """(step, tree) of the newest committed checkpoint, or None — the
-        automatic resume path for slice-restart recovery (SURVEY.md §5.3)."""
-        step = latest_step(self.directory)
-        if step is None:
-            return None
-        return step, restore(self.directory, step, mesh=mesh, target=target)
+        """(step, tree) of the newest *readable* committed checkpoint, or
+        None — the automatic resume path for slice-restart recovery
+        (SURVEY.md §5.3).
+
+        Hardened: a committed-but-unreadable latest checkpoint (CRC
+        mismatch, torn/garbled manifest, vanished shard) is quarantined to
+        ``step_N.corrupt`` and resume walks back to the previous committed
+        step with a loud warning, instead of bricking the job on an error
+        the operator can do nothing about mid-run.  Structure mismatches
+        (ValueError from a target/treedef disagreement) still raise: that
+        is a config error, and silently walking past it would resume every
+        misconfigured job from step 0."""
+        tried: set[int] = set()
+        while True:
+            steps = [s for s in _committed_steps(self.directory)
+                     if s not in tried]
+            if not steps:
+                return None
+            step = steps[-1]
+            tried.add(step)
+            try:
+                return step, restore(self.directory, step, mesh=mesh,
+                                     target=target)
+            except (OSError, EOFError, KeyError,
+                    json.JSONDecodeError) as e:
+                quarantined = quarantine_step(self.directory, step)
+                print(f"[ckpt] WARNING: checkpoint step {step} is "
+                      f"unreadable ({type(e).__name__}: {e}) — quarantined "
+                      f"to {quarantined}; walking back to the previous "
+                      f"committed step", flush=True)
 
     def _gc(self) -> None:
         if jax.process_index() != 0:

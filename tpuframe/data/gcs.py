@@ -6,6 +6,15 @@ the framework one path API that works on ``gs://bucket/key`` URIs when the
 ``google-cloud-storage`` client is importable and on plain local paths always —
 so every pipeline and checkpoint codepath is testable in the zero-egress
 sandbox with local directories standing in for buckets.
+
+Every operation runs under a :class:`tpuframe.resilience.policy.RetryPolicy`
+(exponential backoff + decorrelated jitter + deadline; transient-only
+classification) and passes through a named fault-injection seam
+(``gcs_read``/``gcs_write``/``gcs_list``/...; see
+tpuframe.resilience.faults) so flaky-storage recovery is deterministically
+testable.  Raw ``google.cloud.storage`` blob calls live ONLY in this
+module — lint rule TF105 keeps un-retried client calls out of the rest of
+the tree.
 """
 
 from __future__ import annotations
@@ -13,6 +22,20 @@ from __future__ import annotations
 import os
 import shutil
 from pathlib import Path
+
+from tpuframe.resilience import faults
+from tpuframe.resilience.policy import RetryPolicy
+
+# One policy for all storage ops.  Env knobs exist for ops teams tuning a
+# genuinely bad network day, not for code: code that needs different
+# semantics should construct its own policy.
+_POLICY = RetryPolicy(
+    max_attempts=int(os.environ.get("TPUFRAME_IO_RETRIES", "5")),
+    base_delay_s=float(os.environ.get("TPUFRAME_IO_RETRY_BASE_S", "0.05")),
+    max_delay_s=float(os.environ.get("TPUFRAME_IO_RETRY_MAX_S", "5.0")),
+    attempt_timeout_s=float(os.environ.get("TPUFRAME_IO_TIMEOUT_S", "60")),
+    deadline_s=float(os.environ.get("TPUFRAME_IO_DEADLINE_S", "120")),
+)
 
 
 def is_gcs_path(path: str) -> bool:
@@ -38,17 +61,33 @@ def _split(path: str) -> tuple[str, str]:
     return bucket, key
 
 
+def _timeout() -> float | None:
+    return _POLICY.attempt_timeout_s
+
+
 def read_bytes(path: str) -> bytes:
+    return _POLICY.call(_read_bytes_once, path, op="gcs_read")
+
+
+def _read_bytes_once(path: str) -> bytes:
+    faults.fire("gcs_read")
     if is_gcs_path(path):
         bucket, key = _split(path)
-        return _gcs_client().bucket(bucket).blob(key).download_as_bytes()
+        return (_gcs_client().bucket(bucket).blob(key)
+                .download_as_bytes(timeout=_timeout()))
     return Path(path).read_bytes()
 
 
 def write_bytes(path: str, data: bytes) -> None:
+    _POLICY.call(_write_bytes_once, path, data, op="gcs_write")
+
+
+def _write_bytes_once(path: str, data: bytes) -> None:
+    faults.fire("gcs_write")
     if is_gcs_path(path):
         bucket, key = _split(path)
-        _gcs_client().bucket(bucket).blob(key).upload_from_string(data)
+        (_gcs_client().bucket(bucket).blob(key)
+         .upload_from_string(data, timeout=_timeout()))
         return
     p = Path(path)
     p.parent.mkdir(parents=True, exist_ok=True)
@@ -58,18 +97,30 @@ def write_bytes(path: str, data: bytes) -> None:
 
 
 def exists(path: str) -> bool:
+    return _POLICY.call(_exists_once, path, op="gcs_stat")
+
+
+def _exists_once(path: str) -> bool:
+    faults.fire("gcs_stat")
     if is_gcs_path(path):
         bucket, key = _split(path)
-        return _gcs_client().bucket(bucket).blob(key).exists()
+        return _gcs_client().bucket(bucket).blob(key).exists(
+            timeout=_timeout())
     return Path(path).exists()
 
 
 def listdir(path: str) -> list[str]:
     """Immediate children (names, not full paths)."""
+    return _POLICY.call(_listdir_once, path, op="gcs_list")
+
+
+def _listdir_once(path: str) -> list[str]:
+    faults.fire("gcs_list")
     if is_gcs_path(path):
         bucket, key = _split(path)
         prefix = key.rstrip("/") + "/" if key else ""
-        it = _gcs_client().list_blobs(bucket, prefix=prefix, delimiter="/")
+        it = _gcs_client().list_blobs(bucket, prefix=prefix, delimiter="/",
+                                      timeout=_timeout())
         names = [os.path.basename(b.name) for b in it]
         names += [p.rstrip("/").split("/")[-1] for p in it.prefixes]
         return sorted(n for n in names if n)
@@ -85,23 +136,34 @@ def makedirs(path: str) -> None:
 def mtime(path: str) -> float:
     """Last-modified time (unix seconds) of an object/file; 0.0 if absent.
     GCS timestamps are server-side, so cross-host comparisons are sound."""
+    return _POLICY.call(_mtime_once, path, op="gcs_stat")
+
+
+def _mtime_once(path: str) -> float:
+    faults.fire("gcs_stat")
     if is_gcs_path(path):
         bucket, key = _split(path)
-        blob = _gcs_client().bucket(bucket).get_blob(key)
+        blob = _gcs_client().bucket(bucket).get_blob(key,
+                                                     timeout=_timeout())
         return blob.updated.timestamp() if blob and blob.updated else 0.0
     try:
         return os.path.getmtime(path)
-    except OSError:
+    except FileNotFoundError:
         return 0.0
 
 
 def delete(path: str) -> None:
     """Delete one object/file (no-op if absent)."""
+    _POLICY.call(_delete_once, path, op="gcs_delete")
+
+
+def _delete_once(path: str) -> None:
+    faults.fire("gcs_delete")
     if is_gcs_path(path):
         bucket, key = _split(path)
         blob = _gcs_client().bucket(bucket).blob(key)
-        if blob.exists():
-            blob.delete()
+        if blob.exists(timeout=_timeout()):
+            blob.delete(timeout=_timeout())
         return
     try:
         os.remove(path)
@@ -110,13 +172,42 @@ def delete(path: str) -> None:
 
 
 def delete_tree(path: str) -> None:
+    _POLICY.call(_delete_tree_once, path, op="gcs_delete")
+
+
+def _delete_tree_once(path: str) -> None:
+    faults.fire("gcs_delete")
     if is_gcs_path(path):
         bucket, key = _split(path)
         client = _gcs_client()
         for blob in client.list_blobs(bucket, prefix=key.rstrip("/") + "/"):
-            blob.delete()
+            blob.delete(timeout=_timeout())
         return
     shutil.rmtree(path, ignore_errors=True)
+
+
+def rename_tree(src: str, dst: str) -> None:
+    """Rename a directory/prefix (the corrupt-checkpoint quarantine path:
+    ``step_N`` → ``step_N.corrupt``).  Local rename is atomic; the GCS
+    variant is per-object rename — a retried partial rename re-lists and
+    finishes, which is all quarantine needs (restore ignores both the
+    partially- and fully-renamed prefix, since COMMIT moves too)."""
+    _POLICY.call(_rename_tree_once, src, dst, op="gcs_write")
+
+
+def _rename_tree_once(src: str, dst: str) -> None:
+    faults.fire("gcs_write")
+    if is_gcs_path(src):
+        bucket, key = _split(src)
+        _, dst_key = _split(dst)
+        client = _gcs_client()
+        b = client.bucket(bucket)
+        for blob in client.list_blobs(bucket, prefix=key.rstrip("/") + "/"):
+            suffix = blob.name[len(key.rstrip("/")):]
+            b.rename_blob(blob, dst_key.rstrip("/") + suffix,
+                          timeout=_timeout())
+        return
+    os.replace(src, dst)
 
 
 def join(*parts: str) -> str:

@@ -23,14 +23,17 @@ Two launchers:
 
 from __future__ import annotations
 
+import random
 import re
 import os
 import socket
 import subprocess
 import sys
+import time
 from dataclasses import dataclass, field
 
 from tpuframe.launch.provision import SliceConfig
+from tpuframe.resilience.preempt import RC_PREEMPTED
 
 
 def _free_port() -> int:
@@ -123,7 +126,12 @@ class SliceLauncher:
         return subprocess.run(cmd, check=True)
 
 
-def run_with_relaunch(run_once, relaunches: int, *, log=print) -> int:
+def run_with_relaunch(run_once, relaunches: int, *, log=print,
+                      progress=None, backoff_base_s: float | None = None,
+                      backoff_max_s: float | None = None,
+                      max_stalled: int | None = None,
+                      sleep=time.sleep, rng: random.Random | None = None
+                      ) -> int:
     """Supervise a job through slice-restart recovery (SURVEY.md §5.3).
 
     The failure model: jobs that stall or lose a host exit nonzero (the
@@ -131,18 +139,101 @@ def run_with_relaunch(run_once, relaunches: int, *, log=print) -> int:
     it), and the restarted job auto-resumes from the latest committed
     checkpoint — the TPU-native replacement for hvd.elastic's in-place
     re-rendezvous.  ``run_once() -> int`` is re-invoked until it returns 0
-    or ``relaunches`` restarts are spent."""
+    or ``relaunches`` restarts are spent.
+
+    Hardened semantics (docs/DESIGN.md "Failure model & resilience"):
+
+      * rc 14 (:data:`RC_PREEMPTED`) is *cooperative*: the job already
+        committed a final checkpoint, so it relaunches immediately —
+        no backoff and no charge against the relaunch budget.
+      * Crashes back off exponentially with jitter before each relaunch
+        (base ``TPUFRAME_RELAUNCH_BACKOFF_S`` [1s], doubling to
+        ``backoff_max_s`` [60s]) so a hard-down dependency is not hammered.
+      * Crash-loop detection: when ``progress() -> int|None`` (typically
+        ``latest_step`` on the job's checkpoint dir) shows no advance
+        across ``max_stalled`` (``TPUFRAME_RELAUNCH_MAX_STALLED`` [3])
+        consecutive relaunches, the supervisor gives up early — a job
+        dying at the same step every time will not burn a day of budget.
+      * Any checkpoint progress *refreshes* the budget: attempts, the
+        stall counter and the backoff all reset, so a long job that fails
+        occasionally-but-productively can keep going indefinitely.
+    """
+    if backoff_base_s is None:
+        backoff_base_s = float(
+            os.environ.get("TPUFRAME_RELAUNCH_BACKOFF_S", "1.0"))
+    if backoff_max_s is None:
+        backoff_max_s = 60.0
+    if max_stalled is None:
+        max_stalled = int(
+            os.environ.get("TPUFRAME_RELAUNCH_MAX_STALLED", "3"))
+    rng = rng or random.Random()
     attempt = 0
+    stalled = 0
+    delay = backoff_base_s
+    last_progress = progress() if progress is not None else None
     while True:
         rc = run_once()
-        if rc == 0 or attempt >= relaunches:
-            if rc != 0 and relaunches > 0:
+        if rc == 0:
+            return rc
+        if rc == RC_PREEMPTED:
+            log(f"[tpuframe.launch] job preempted (rc={rc}); relaunching "
+                f"immediately (checkpoint committed, budget untouched)")
+            continue
+        if progress is not None:
+            now = progress()
+            if now is not None and (last_progress is None
+                                    or now > last_progress):
+                if attempt or stalled:
+                    log(f"[tpuframe.launch] checkpoint progress "
+                        f"(latest step {now}) — relaunch budget refreshed")
+                last_progress = now
+                attempt = 0
+                stalled = 0
+                delay = backoff_base_s
+            else:
+                stalled += 1
+                if stalled > max_stalled:
+                    log(f"[tpuframe.launch] crash loop: no checkpoint "
+                        f"progress across {stalled} relaunches — giving up; "
+                        f"last rc={rc}")
+                    return rc
+        if attempt >= relaunches:
+            if relaunches > 0:
                 log(f"[tpuframe.launch] giving up after {attempt} "
                     f"relaunch(es); last rc={rc}")
             return rc
         attempt += 1
         log(f"[tpuframe.launch] job exited rc={rc}; relaunch "
-            f"{attempt}/{relaunches} (resume from latest checkpoint)")
+            f"{attempt}/{relaunches} in {delay:.1f}s "
+            f"(resume from latest checkpoint)")
+        sleep(delay * rng.uniform(0.5, 1.0))
+        delay = min(backoff_max_s, delay * 2.0)
+
+
+def _progress_probe(cmd: list[str]):
+    """A ``progress()`` callable for :func:`run_with_relaunch`, watching the
+    job's checkpoint directory when one is discoverable from its argv
+    (``--ckpt-dir X`` or ``--ckpt-dir=X``).  None when there isn't one —
+    crash-loop detection simply stays off."""
+    ckpt_dir = None
+    for i, arg in enumerate(cmd):
+        if arg == "--ckpt-dir" and i + 1 < len(cmd):
+            ckpt_dir = cmd[i + 1]
+        elif arg.startswith("--ckpt-dir="):
+            ckpt_dir = arg.split("=", 1)[1]
+    if not ckpt_dir:
+        return None
+
+    def probe():
+        from tpuframe.ckpt.checkpoint import latest_step
+
+        try:
+            return latest_step(ckpt_dir)
+        except Exception:  # noqa: BLE001 — a flaky probe must not kill the
+            # supervisor; "unknown" just means no budget refresh this round.
+            return None
+
+    return probe
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -207,7 +298,8 @@ def main(argv: list[str] | None = None) -> int:
                     print(prefix + line)
             return 0
 
-        return run_with_relaunch(run_once, args.relaunch)
+        return run_with_relaunch(run_once, args.relaunch,
+                                 progress=_progress_probe(cmd))
 
     cfg = SliceConfig(name=args.name, zone=args.zone,
                       accelerator=args.accelerator)
@@ -232,7 +324,8 @@ def main(argv: list[str] | None = None) -> int:
             return e.returncode or 1
         return 0
 
-    return run_with_relaunch(run_once, args.relaunch)
+    return run_with_relaunch(run_once, args.relaunch,
+                             progress=_progress_probe(args.cmd))
 
 
 if __name__ == "__main__":

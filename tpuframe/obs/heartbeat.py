@@ -71,4 +71,8 @@ class Heartbeat:
                     "input pipeline stall, hung I/O, or peer failure",
                     idle, self._step)
                 if self.on_stall:
-                    self.on_stall(idle)
+                    try:
+                        self.on_stall(idle)
+                    except Exception:  # noqa: BLE001 — a broken callback must
+                        # not kill the watchdog thread silently; keep watching.
+                        logger.exception("on_stall callback raised")

@@ -10,10 +10,43 @@ from __future__ import annotations
 
 import contextlib
 import json
+import threading
 import time
 from pathlib import Path
 
 import jax
+
+# ---------------------------------------------------------------------------
+# Process-wide event counters.  The resilience layer bumps these from retry
+# loops (``retry.gcs_read.retries`` etc.), which may run in checkpoint/data
+# threads — hence the lock.  Deliberately not jax-aware: counters are
+# per-host facts and must work before any backend exists.
+# ---------------------------------------------------------------------------
+
+_counters: dict[str, int] = {}
+_counters_lock = threading.Lock()
+
+
+def bump(name: str, n: int = 1) -> None:
+    """Increment the process-wide counter ``name`` by ``n``."""
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def counters(prefix: str | None = None) -> dict[str, int]:
+    """Snapshot of counters, optionally filtered to ``prefix``."""
+    with _counters_lock:
+        return {k: v for k, v in _counters.items()
+                if prefix is None or k.startswith(prefix)}
+
+
+def reset_counters(prefix: str | None = None) -> None:
+    with _counters_lock:
+        if prefix is None:
+            _counters.clear()
+        else:
+            for k in [k for k in _counters if k.startswith(prefix)]:
+                del _counters[k]
 
 
 class RateMeter:

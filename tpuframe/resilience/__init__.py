@@ -1,0 +1,47 @@
+"""Failure-model implementation (SURVEY.md §5.3, docs/DESIGN.md "Failure
+model & resilience").
+
+The reference system's value proposition was surviving real GCP failure
+modes — preemptible hosts, flaky GCS, stalled ranks — via Horovod's
+elastic/stall machinery.  tpuframe's equivalent is job-restart recovery
+(TPU pods fail as a unit): this package hardens every seam of that model.
+
+  * :mod:`tpuframe.resilience.policy` — retry policies for transient I/O:
+    exponential backoff with decorrelated jitter, per-attempt timeout,
+    overall deadline, retryable-exception classification.  Applied to
+    every ``data/gcs.py`` operation and checkpoint shard I/O; retry
+    counts surface through ``obs/metrics.py`` counters.
+  * :mod:`tpuframe.resilience.faults` — structured fault injection
+    (``TPUFRAME_FAULTS``): I/O errors, slow reads, torn/corrupt shards,
+    crashes and signals at named seams, so every recovery path is
+    deterministically testable on CPU.
+  * :mod:`tpuframe.resilience.preempt` — the GCP preemption contract:
+    SIGTERM/SIGINT set a flag, the harness checkpoints at the next step
+    boundary and exits rc 14 so the supervisor resumes instead of
+    counting a crash.
+
+Exit-code table (the supervisor's vocabulary, see launch/launcher.py):
+
+  ====  =====================================================
+  rc    meaning
+  ====  =====================================================
+  0     clean completion
+  13    stall watchdog abort (obs/heartbeat via train.py)
+  14    preemption: final checkpoint committed, resume me
+  42    injected crash (fault kind ``crash``)
+  ====  =====================================================
+
+This package must stay importable without jax (the launcher and the gcs
+layer import it before any backend exists).
+"""
+
+from tpuframe.resilience.policy import (  # noqa: F401
+    RetryPolicy,
+    is_retryable,
+    retrying,
+)
+from tpuframe.resilience import faults  # noqa: F401
+from tpuframe.resilience.preempt import (  # noqa: F401
+    RC_PREEMPTED,
+    PreemptionGuard,
+)

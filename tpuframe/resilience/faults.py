@@ -1,0 +1,248 @@
+"""Structured fault injection — every recovery path deterministically
+testable on CPU (SURVEY.md §5.3's "test-only hook", grown into a registry).
+
+Spec grammar (``TPUFRAME_FAULTS``, comma-separated entries)::
+
+    TPUFRAME_FAULTS="gcs_read:step=13:kind=ioerror,ckpt_shard:kind=corrupt,
+                     host:step=20:kind=sigterm"
+
+    <seam>[:step=N][:kind=K][:times=T][:rank=R][:once=1][:delay_s=X]
+
+Seams are named injection points the framework calls into:
+
+  ============  ======================================================
+  seam          where it fires
+  ============  ======================================================
+  gcs_read      ``data/gcs.py`` read_bytes (every manifest/shard read)
+  gcs_write     ``data/gcs.py`` write_bytes
+  gcs_list      ``data/gcs.py`` listdir
+  ckpt_shard    checkpoint shard serialization (``mangle`` on the bytes
+                actually written — kinds ``corrupt``/``torn``)
+  host          the training loop, once per step (crash/signal kinds)
+  ============  ======================================================
+
+Kinds: ``ioerror`` (raise a retryable :class:`InjectedFault`), ``slow``
+(sleep ``delay_s``), ``corrupt`` (flip bytes), ``torn`` (truncate),
+``crash`` (``os._exit(42)``, no cleanup — the hard-kill model),
+``sigterm``/``sigint`` (deliver the real signal to this process — drives
+the preemption contract), ``hang`` (sleep forever — the stall class).
+
+Matching: ``step=N`` gates on the training step (the harness calls
+:func:`set_step`); ``times=T`` caps firings (default 1); ``rank=R``
+restricts to one process; ``once=1`` drops the fault on a *resumed* run
+(start_step > 0) so relaunch tests survive the step that killed them —
+the old ``TPUFRAME_FAULT_ONCE`` semantics.
+
+Back-compat: ``TPUFRAME_FAULT_STEP=N`` (+ ``TPUFRAME_FAULT_ONCE=1``)
+still works — it compiles into ``host:step=N:kind=crash[:once=1]`` with
+a one-line deprecation notice.
+
+No jax import: gcs and the launcher pull this in before any backend.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from dataclasses import dataclass
+
+_KINDS = ("ioerror", "slow", "corrupt", "torn", "crash", "sigterm",
+          "sigint", "hang")
+_SEAMS = ("gcs_read", "gcs_write", "gcs_list", "gcs_stat", "gcs_delete",
+          "ckpt_shard", "host")
+_CRASH_RC = 42
+
+
+class InjectedFault(IOError):
+    """Raised by ``kind=ioerror`` — an OSError subclass, so the default
+    retry classification treats it as transient (that is the point)."""
+
+
+@dataclass
+class Fault:
+    seam: str
+    kind: str = "ioerror"
+    step: int | None = None
+    times: int = 1
+    rank: int | None = None
+    once: bool = False
+    delay_s: float = 1.0
+
+
+def parse(spec: str) -> list[Fault]:
+    """Parse a ``TPUFRAME_FAULTS`` value; raises ValueError loudly on
+    unknown seams/kinds/keys (a silently-ignored fault spec would make a
+    recovery test pass vacuously)."""
+    faults: list[Fault] = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        seam, *opts = entry.split(":")
+        if seam not in _SEAMS:
+            raise ValueError(f"unknown fault seam {seam!r} in {entry!r}; "
+                             f"have {_SEAMS}")
+        f = Fault(seam=seam)
+        for opt in opts:
+            key, sep, val = opt.partition("=")
+            if not sep:
+                raise ValueError(f"fault option {opt!r} needs key=value "
+                                 f"(in {entry!r})")
+            if key == "kind":
+                if val not in _KINDS:
+                    raise ValueError(f"unknown fault kind {val!r} in "
+                                     f"{entry!r}; have {_KINDS}")
+                f.kind = val
+            elif key == "step":
+                f.step = int(val)
+            elif key == "times":
+                f.times = int(val)
+            elif key == "rank":
+                f.rank = int(val)
+            elif key == "once":
+                f.once = val not in ("0", "false", "")
+            elif key == "delay_s":
+                f.delay_s = float(val)
+            else:
+                raise ValueError(f"unknown fault option {key!r} in "
+                                 f"{entry!r}")
+        faults.append(f)
+    return faults
+
+
+def _process_index() -> int:
+    """This process's rank without forcing a jax import: the launcher env
+    var is authoritative in the fake cluster; fall back to jax only when
+    it is already imported (TPU metadata autodetection)."""
+    env = os.environ.get("TPUFRAME_PROCESS_ID")
+    if env:
+        return int(env)
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return jax.process_index()
+        except Exception:  # noqa: BLE001 — backend not initialized yet
+            return 0
+    return 0
+
+
+class FaultRegistry:
+    def __init__(self, faults: list[Fault] | None = None):
+        self.faults = list(faults or [])
+        self.step = 0
+
+    def set_step(self, step: int) -> None:
+        self.step = step
+
+    def set_resumed(self, resumed: bool) -> None:
+        """Drop ``once`` faults on a resumed run (start_step > 0)."""
+        if resumed:
+            self.faults = [f for f in self.faults if not f.once]
+
+    def _take(self, seam: str, kinds: tuple[str, ...]) -> Fault | None:
+        for f in self.faults:
+            if (f.seam == seam and f.kind in kinds and f.times > 0
+                    and (f.step is None or f.step == self.step)
+                    and (f.rank is None or f.rank == _process_index())):
+                f.times -= 1
+                return f
+        return None
+
+    def fire(self, seam: str) -> None:
+        """Run any control-flow fault armed at ``seam`` (everything except
+        the data-mangling kinds, which go through :meth:`mangle`)."""
+        f = self._take(seam, ("ioerror", "slow", "crash", "sigterm",
+                              "sigint", "hang"))
+        if f is None:
+            return
+        if f.kind == "ioerror":
+            raise InjectedFault(f"injected ioerror at seam {seam} "
+                                f"(step {self.step})")
+        if f.kind == "slow":
+            print(f"[tpuframe] FAULT INJECTION: slow {seam} "
+                  f"({f.delay_s:.1f}s) at step {self.step}", flush=True)
+            time.sleep(f.delay_s)
+            return
+        if f.kind == "crash":
+            print(f"[tpuframe] FAULT INJECTION: dying at step {self.step}",
+                  flush=True)
+            os._exit(_CRASH_RC)
+        if f.kind in ("sigterm", "sigint"):
+            sig = signal.SIGTERM if f.kind == "sigterm" else signal.SIGINT
+            print(f"[tpuframe] FAULT INJECTION: raising {f.kind.upper()} "
+                  f"at step {self.step}", flush=True)
+            os.kill(os.getpid(), sig)
+            return
+        if f.kind == "hang":
+            print(f"[tpuframe] FAULT INJECTION: hanging at step "
+                  f"{self.step}", flush=True)
+            time.sleep(10 ** 6)
+
+    def mangle(self, seam: str, data: bytes) -> bytes:
+        """Return ``data`` corrupted/truncated when a data fault is armed
+        at ``seam`` (simulates storage-side corruption: the writer's CRC
+        is computed over the CLEAN bytes, so restore sees a mismatch)."""
+        f = self._take(seam, ("corrupt", "torn"))
+        if f is None:
+            return data
+        print(f"[tpuframe] FAULT INJECTION: {f.kind} bytes at seam {seam} "
+              f"(step {self.step})", flush=True)
+        if f.kind == "torn":
+            return data[: max(1, len(data) // 2)]
+        mangled = bytearray(data)
+        for i in (0, len(mangled) // 2, len(mangled) - 1):
+            mangled[i] ^= 0xFF
+        return bytes(mangled)
+
+
+# ---------------------------------------------------------------------------
+# Module-level default registry (the one the framework's seams consult).
+# ---------------------------------------------------------------------------
+
+_registry: FaultRegistry | None = None
+_warned_legacy = False
+
+
+def reset_from_env(env=os.environ) -> FaultRegistry:
+    """(Re)build the active registry from ``TPUFRAME_FAULTS`` plus the
+    legacy ``TPUFRAME_FAULT_STEP``/``TPUFRAME_FAULT_ONCE`` aliases."""
+    global _registry, _warned_legacy
+    faults = parse(env.get("TPUFRAME_FAULTS", ""))
+    legacy_step = int(env.get("TPUFRAME_FAULT_STEP", "0") or "0")
+    if legacy_step:
+        once = env.get("TPUFRAME_FAULT_ONCE") == "1"
+        if not _warned_legacy:
+            print(f"[tpuframe] TPUFRAME_FAULT_STEP is deprecated — use "
+                  f"TPUFRAME_FAULTS='host:step={legacy_step}:kind=crash"
+                  f"{':once=1' if once else ''}'", flush=True)
+            _warned_legacy = True
+        faults.append(Fault(seam="host", kind="crash", step=legacy_step,
+                            once=once))
+    _registry = FaultRegistry(faults)
+    return _registry
+
+
+def registry() -> FaultRegistry:
+    global _registry
+    if _registry is None:
+        _registry = reset_from_env()
+    return _registry
+
+
+def fire(seam: str) -> None:
+    registry().fire(seam)
+
+
+def mangle(seam: str, data: bytes) -> bytes:
+    return registry().mangle(seam, data)
+
+
+def set_step(step: int) -> None:
+    registry().set_step(step)
+
+
+def set_resumed(resumed: bool) -> None:
+    registry().set_resumed(resumed)

@@ -1,0 +1,162 @@
+"""Retry policies for transient I/O failures.
+
+Every storage operation in the framework (``data/gcs.py``, checkpoint
+shard reads/writes) runs under a :class:`RetryPolicy`: exponential
+backoff with *decorrelated jitter* (each delay is drawn uniformly from
+``[base, prev * 3]``, capped — avoids retry synchronization across a
+pod's hosts, which all lose the same GCS endpoint at the same moment),
+a per-attempt timeout plumbed into the client call where the client
+supports one, and an overall deadline so a retry loop can never stall a
+job longer than the heartbeat watchdog's window.
+
+Classification is explicit: only *transient* errors retry.  A
+``FileNotFoundError`` is a fact about the bucket, not the network, and
+retrying it just turns a crisp error into a slow one.
+
+Retry activity is surfaced through ``tpuframe.obs.metrics`` counters
+(``retry.<op>.retries`` / ``.recovered`` / ``.exhausted``) so a flaky
+storage backend is visible in the training log, not just in latency.
+
+The module must import without jax (gcs/launch import it first); the
+metrics bump is lazy and best-effort.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+# Exception types that are facts about the request, not the transport —
+# retrying them cannot help.
+_NON_RETRYABLE_OS = (
+    FileNotFoundError,
+    FileExistsError,
+    IsADirectoryError,
+    NotADirectoryError,
+    PermissionError,
+)
+
+# Transient google-cloud / requests / urllib3 error classes, matched by
+# name so the classification works without those packages importable
+# (the sandbox has no GCS client; production TPU-VMs do).
+_RETRYABLE_NAMES = frozenset({
+    "ServiceUnavailable",       # 503
+    "TooManyRequests",          # 429
+    "InternalServerError",      # 500
+    "BadGateway",               # 502
+    "GatewayTimeout",           # 504
+    "DeadlineExceeded",
+    "RetryError",
+    "TransportError",
+    "ChunkedEncodingError",
+    "ProtocolError",
+    "IncompleteRead",
+})
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Default transient-vs-permanent classification."""
+    if isinstance(exc, _NON_RETRYABLE_OS):
+        return False
+    # ConnectionError/TimeoutError are OSError subclasses; generic OSError
+    # (reset, EIO, transient NFS/FUSE failures) is treated as transient —
+    # the permanent shapes are excluded above.
+    if isinstance(exc, OSError):
+        return True
+    return any(c.__name__ in _RETRYABLE_NAMES for c in type(exc).__mro__)
+
+
+def _bump(name: str) -> None:
+    """Best-effort counter increment — a broken metrics import must never
+    break a retry loop mid-recovery."""
+    try:
+        from tpuframe.obs import metrics
+
+        metrics.bump(name)
+    except Exception:  # noqa: BLE001 — observability is strictly optional here
+        pass
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded retry with decorrelated jitter.
+
+    ``clock``/``sleep``/``rng`` are injectable so the timing behavior is
+    unit-testable with a fake clock (tests/test_resilience.py).
+    """
+
+    max_attempts: int = 5
+    base_delay_s: float = 0.05
+    max_delay_s: float = 5.0
+    # Plumbed into client calls that accept a timeout (the GCS blob API
+    # does); enforcement of a hung attempt that ignores it is the stall
+    # watchdog's job (obs/heartbeat).
+    attempt_timeout_s: float | None = 60.0
+    deadline_s: float | None = 120.0
+    retryable: Callable[[BaseException], bool] = field(default=is_retryable)
+    clock: Callable[[], float] = field(default=time.monotonic)
+    sleep: Callable[[float], None] = field(default=time.sleep)
+    rng: random.Random = field(default_factory=random.Random)
+
+    def call(self, fn: Callable[..., Any], *args: Any, op: str = "io",
+             **kwargs: Any) -> Any:
+        """Run ``fn(*args, **kwargs)`` under this policy; re-raises the
+        last error when attempts or the deadline run out, immediately for
+        non-retryable errors."""
+        start = self.clock()
+        delay = self.base_delay_s
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                out = fn(*args, **kwargs)
+                if attempt > 1:
+                    _bump(f"retry.{op}.recovered")
+                return out
+            except Exception as e:  # noqa: BLE001 — classified right below
+                if not self.retryable(e):
+                    raise
+                if attempt >= self.max_attempts:
+                    _bump(f"retry.{op}.exhausted")
+                    raise
+                # Decorrelated jitter: uniform over [base, prev*3], capped.
+                delay = min(self.max_delay_s,
+                            self.rng.uniform(self.base_delay_s,
+                                             max(self.base_delay_s,
+                                                 delay * 3.0)))
+                if self.deadline_s is not None:
+                    remaining = self.deadline_s - (self.clock() - start)
+                    if remaining <= 0.0:
+                        _bump(f"retry.{op}.exhausted")
+                        raise
+                    delay = min(delay, remaining)
+                _bump(f"retry.{op}.retries")
+                print(f"[resilience] {op} failed "
+                      f"(attempt {attempt}/{self.max_attempts}): "
+                      f"{type(e).__name__}: {e} — retrying in {delay:.2f}s",
+                      file=sys.stderr, flush=True)
+                self.sleep(delay)
+
+    def wrap(self, fn: Callable[..., Any], *, op: str | None = None
+             ) -> Callable[..., Any]:
+        """``fn`` bound to this policy (``op`` defaults to the fn name)."""
+        name = op or getattr(fn, "__name__", "io")
+
+        @functools.wraps(fn)
+        def wrapped(*args: Any, **kwargs: Any) -> Any:
+            return self.call(fn, *args, op=name, **kwargs)
+
+        return wrapped
+
+
+def retrying(policy: RetryPolicy, *, op: str | None = None):
+    """Decorator form: ``@retrying(policy, op="gcs_read")``."""
+
+    def deco(fn: Callable[..., Any]) -> Callable[..., Any]:
+        return policy.wrap(fn, op=op)
+
+    return deco

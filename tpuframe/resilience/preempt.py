@@ -1,0 +1,100 @@
+"""The GCP preemption contract (SURVEY.md §5.3; docs/DESIGN.md).
+
+Preemptible/spot TPU-VMs get SIGTERM with a short grace window before
+the host disappears.  The old behavior — die mid-step, lose everything
+since the last periodic checkpoint — wastes up to ``ckpt_every`` steps
+of pod time per preemption.  The contract implemented here:
+
+  1. :class:`PreemptionGuard` turns SIGTERM/SIGINT into a *flag*, never
+     an exception: a signal mid-collective must not unwind the runtime.
+  2. The training loop checks the flag at each step boundary, commits a
+     final checkpoint, and exits with :data:`RC_PREEMPTED` (14).
+  3. The supervisor (``launch/launcher.py:run_with_relaunch``) treats
+     rc 14 as "resume me" — it relaunches immediately without consuming
+     the crash budget or backing off.
+
+A second SIGINT restores default handling, so an interactive ^C ^C
+still kills a wedged run the usual way.
+
+No jax import; the guard must be installable before any backend.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+
+RC_PREEMPTED = 14
+
+
+class PreemptionGuard:
+    """Flag-setting SIGTERM/SIGINT handler with install/uninstall.
+
+    Signal handlers only work in the main thread; ``install()`` in any
+    other thread is a visible no-op (``active`` stays False) rather than
+    an error, so library code can call it unconditionally.
+    """
+
+    def __init__(self, signals: tuple[int, ...] = (signal.SIGTERM,
+                                                   signal.SIGINT)):
+        self.signals = signals
+        self.active = False
+        self._requested = False
+        self.signal_name: str | None = None
+        self._saved: dict[int, object] = {}
+
+    @property
+    def requested(self) -> bool:
+        return self._requested
+
+    def _handle(self, signum, frame) -> None:
+        if self._requested and signum == signal.SIGINT:
+            # Second ^C: the user means it — stop shielding.
+            self.uninstall()
+            raise KeyboardInterrupt
+        self._requested = True
+        self.signal_name = signal.Signals(signum).name
+        print(f"[tpuframe] received {self.signal_name} — will checkpoint "
+              f"at the next step boundary and exit rc {RC_PREEMPTED} "
+              f"(supervisor resumes)", file=sys.stderr, flush=True)
+
+    def install(self) -> "PreemptionGuard":
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        for sig in self.signals:
+            self._saved[sig] = signal.getsignal(sig)
+            signal.signal(sig, self._handle)
+        self.active = True
+        return self
+
+    def reassert(self) -> None:
+        """Re-register after something else replaced the handler.
+
+        ``jax.distributed.initialize`` starts XLA's preemption notifier,
+        which installs its own SIGTERM handler that only logs the signal —
+        silently disabling the rc-14 contract.  Callers that initialize a
+        distributed backend after :meth:`install` must call this to take
+        the signal back.  ``_saved`` is left untouched so ``uninstall()``
+        still restores the pre-guard handlers.
+        """
+        if not self.active:
+            return
+        for sig in self.signals:
+            if signal.getsignal(sig) is not self._handle:
+                signal.signal(sig, self._handle)
+
+    def uninstall(self) -> None:
+        for sig, old in self._saved.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, TypeError):  # not main thread / exotic old
+                pass
+        self._saved.clear()
+        self.active = False
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

@@ -34,7 +34,10 @@ from tpuframe.data import ShardedLoader, datasets
 from tpuframe.models import losses
 from tpuframe.obs import (Heartbeat, MetricLogger, RateMeter, StepTimeline,
                           profile_trace)
+from tpuframe.obs import metrics as obs_metrics
 from tpuframe.parallel import bootstrap
+from tpuframe.resilience import faults as faults_lib
+from tpuframe.resilience.preempt import RC_PREEMPTED, PreemptionGuard
 from tpuframe.parallel import mesh as mesh_lib
 from tpuframe.parallel import step as step_lib
 from tpuframe.utils import build_optimizer, get_config
@@ -548,7 +551,19 @@ def _finalize_eval(avg: dict) -> dict:
 def train(cfg: TrainConfig, *, trace_dir: str | None = None,
           log_file: str | None = None) -> dict:
     """Run the workload; returns final metrics (the driver/test surface)."""
+    # Preemption contract (resilience/preempt.py): installed before the
+    # harness so a SIGTERM during compile/restore is already caught; the
+    # loop below checkpoints at the next step boundary and exits rc 14.
+    guard = PreemptionGuard().install()
+    # Re-parse TPUFRAME_FAULTS per run: in-process callers (tests) invoke
+    # train() repeatedly under different envs, and restore-time gcs reads
+    # inside build_harness already pass through the seams.
+    faults_lib.reset_from_env()
     h = build_harness(cfg)
+    # In distributed mode build_harness ran jax.distributed.initialize,
+    # whose preemption notifier steals SIGTERM (it only logs the signal);
+    # take it back so rc-14 preemption works under the supervisor too.
+    guard.reassert()
     logger = MetricLogger(
         log_file, tb_dir=cfg.tb_dir or os.environ.get("TPUFRAME_TB_DIR"))
     rate = RateMeter()
@@ -590,15 +605,13 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
               f"global_batch={cfg.global_batch} steps={cfg.total_steps}",
               flush=True)
 
-    # Test-only fault injection (SURVEY.md §5.3): simulate a host crash at an
-    # exact step — os._exit skips all cleanup, so resume must cope with torn
-    # trailing state (uncommitted checkpoints, open logs).  HANG_STEP instead
-    # simulates a wedged host (the peer-stall class the watchdog must catch).
-    fault_step = int(os.environ.get("TPUFRAME_FAULT_STEP", "0") or "0")
-    # FAULT_ONCE: only fault on a from-scratch run — the relaunch/resume
-    # supervisor tests need the restarted job to survive the same step.
-    if os.environ.get("TPUFRAME_FAULT_ONCE") == "1" and h.start_step > 0:
-        fault_step = 0
+    # Structured fault injection (resilience/faults.py): TPUFRAME_FAULTS
+    # arms named seams; the legacy TPUFRAME_FAULT_STEP/_ONCE aliases still
+    # compile into a host-crash fault.  once=1 faults are dropped on a
+    # resumed run so relaunch/resume tests survive the step that killed
+    # them.  HANG_STEP/HANG_RANK stay env-level: the rank gate below needs
+    # jax.process_index().
+    faults_lib.set_resumed(h.start_step > 0)
     hang_step = int(os.environ.get("TPUFRAME_HANG_STEP", "0") or "0")
     hang_rank = int(os.environ.get("TPUFRAME_HANG_RANK", "-1") or "-1")
     if hang_rank >= 0 and jax.process_index() != hang_rank:
@@ -641,10 +654,8 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             batch = next(data_iter)
             state, metrics = h.train_step(state, batch)
         step += 1
-        if fault_step and step == fault_step:
-            print(f"[tpuframe] FAULT INJECTION: dying at step {step}",
-                  flush=True)
-            os._exit(42)
+        faults_lib.set_step(step)
+        faults_lib.fire("host")  # crash/signal faults, once per step
         if hang_step and step == hang_step:
             print(f"[tpuframe] FAULT INJECTION: hanging at step {step}",
                   flush=True)
@@ -659,6 +670,9 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             if r is not None:
                 final_train_metrics["examples_per_sec"] = r
                 final_train_metrics["examples_per_sec_per_chip"] = rate.per_chip()
+            # Retry-loop activity (resilience/policy.py) — empty unless the
+            # storage layer actually retried, so clean runs log nothing new.
+            final_train_metrics.update(obs_metrics.counters("retry."))
             logger.log(step, final_train_metrics)
 
         if step % cfg.eval_every == 0 or step == cfg.total_steps:
@@ -691,6 +705,26 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
                     h.manager.maybe_save(step, state)
                 heartbeat.beat(step)  # a long blocking save is progress too
 
+        if guard.requested:
+            # Preemption contract: commit a final checkpoint at this step
+            # boundary and exit rc 14 so the supervisor resumes (no crash
+            # charged, no backoff) instead of losing up to ckpt_every steps.
+            if h.manager is not None:
+                if not h.manager.should_save(step):  # else just saved above
+                    h.manager.save(step, state)
+                h.manager.wait_pending()
+            heartbeat.stop()
+            if timeline is not None:
+                timeline.instant("preempted", step=step)
+                timeline.close()
+            logger.close()
+            guard.uninstall()
+            if bootstrap.is_primary():
+                print(f"[tpuframe] preempted ({guard.signal_name}): "
+                      f"checkpoint committed at step {step}; exiting rc "
+                      f"{RC_PREEMPTED} for supervisor resume", flush=True)
+            raise SystemExit(RC_PREEMPTED)
+
     if t_trace is not None:
         t_trace.__exit__(None, None, None)
     if h.manager is not None and step % cfg.ckpt_every != 0:
@@ -704,7 +738,9 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             print(f"[tpuframe] step timeline written to {timeline.path}",
                   flush=True)
     logger.close()
+    guard.uninstall()
     final_train_metrics["step"] = step
+    final_train_metrics.update(obs_metrics.counters("retry."))
     return final_train_metrics
 
 
