@@ -254,18 +254,21 @@ def run(batch_per_chip: int, warmup: int, measure: int) -> float:
     # travels inside the compile request, so it survives the relay's
     # remote-compile hop where env vars (XLA_FLAGS / LIBTPU_INIT_ARGS)
     # either crash the local flag parser or never reach the compiler.
-    xla_opts = None
-    opts_env = os.environ.get("TPUFRAME_XLA_OPTS", "")
-    if opts_env:
-        pairs = [kv.strip() for kv in opts_env.split(",") if kv.strip()]
-        bad = [kv for kv in pairs
-               if "=" not in kv or not kv.split("=", 1)[0].strip()
-               or not kv.split("=", 1)[1].strip()]
-        if bad:
-            raise SystemExit(f"TPUFRAME_XLA_OPTS entries need key=value, "
-                             f"got {bad!r}")
-        xla_opts = {k.strip(): v.strip() for k, v in
-                    (kv.split("=", 1) for kv in pairs)}
+    from tpuframe.tune import db as tune_db
+    from tpuframe.utils import xla_opts as xla_opts_lib
+
+    try:
+        xla_opts = xla_opts_lib.from_env()
+    except ValueError as e:
+        raise SystemExit(str(e))
+    if xla_opts is None:
+        # No env override: consult the offline tuning DB (only applies
+        # when the target TPU generation is known; tpuframe.tune).
+        xla_opts = tune_db.resolve_xla_opts(
+            f"bench_resnet50_b{batch_per_chip}", family="bench_resnet50")
+        if xla_opts:
+            _log(f"compiler_options from tuning DB: {xla_opts}")
+    else:
         _log(f"compiler_options: {xla_opts}")
     train_step = step_lib.make_train_step(loss_fn, tx, mesh, donate=True,
                                           compiler_options=xla_opts)
@@ -324,12 +327,14 @@ def main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
     _RESULT["stage"] = "import-jax"
     _log("importing jax (remote TPU relay init can be slow)...")
-    import jax
+    import jax  # noqa: F401 — backend init is the slow part being timed
 
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   ".xla_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from tpuframe.utils import compile_cache
+
+    # Shared persistent-cache helper (tpuframe.utils.compile_cache): same
+    # <repo>/.xla_cache dir + 1.0s threshold as before, now with
+    # compile_cache.hits/misses counters in obs.metrics.
+    compile_cache.enable()
 
     n_chips = 0
     try:

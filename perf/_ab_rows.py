@@ -1,0 +1,71 @@
+"""Supersession-aware parser for perf/results/offline_ab.jsonl.
+
+PERF.md §11 invalidated every round-4 offline pallas row (interpret-mode
+kernels lowered as XLA while loops — the census measured programs that
+never run on chip) in favor of ``*_r5`` / ``*_v4222`` regenerations, and
+regenerated rows are APPENDED to the jsonl with the same tag.  The rule,
+shared by ``summarize_results.py`` and ``exp_offline_ab.py show`` and
+pinned by tests/test_offline_ab_parser.py:
+
+  - the program key is the row's ``tag``; the LATEST line per tag wins
+    (a regeneration supersedes every earlier row with its tag, including
+    earlier ``compile_error`` rows — and a later compile_error likewise
+    supersedes an earlier success: the latest compiler verdict is the
+    verdict);
+  - suffixed tags (``_r5``, ``_v4_221``, ...) are DISTINCT keys — a v4
+    regeneration never hides the v5e row.
+
+Deliberately side-effect-free (no jax, no env scrub, no AOT lock):
+tests and the summarizer import this without touching
+``exp_offline_ab``'s module-level backend setup.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def parse_rows(lines) -> list:
+    """Latest-wins filter over jsonl lines; returns the surviving record
+    dicts in first-seen tag order.  Unparsable lines are skipped (the
+    jsonl is append-only across crashes; a torn final line is normal)."""
+    latest: dict = {}
+    order: list = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        tag = rec.get("tag", "?")
+        if tag not in latest:
+            order.append(tag)
+        latest[tag] = rec
+    return [latest[t] for t in order]
+
+
+def load_rows(path: str) -> list:
+    with open(path) as f:
+        return parse_rows(f)
+
+
+def superseded_count(lines) -> int:
+    """How many rows the latest-wins rule dropped (for report honesty:
+    'N rows, M superseded' instead of a silently shrunken table)."""
+    lines = list(lines)
+    total = 0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            total += 1
+    return total - len(parse_rows(lines))

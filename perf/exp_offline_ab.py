@@ -265,10 +265,24 @@ def dp32():
         "grad_tree_f32_mb": 102.4}))
 
 
+def show():
+    """Print the SURVIVING rows (supersession rule in _ab_rows: latest
+    line per tag wins — §11 regenerations hide the round-4 rows)."""
+    from _ab_rows import load_rows, superseded_count
+
+    rows = load_rows(OUT)
+    dropped = superseded_count(open(OUT).read().strip().splitlines())
+    log(f"{len(rows)} surviving row(s), {dropped} superseded")
+    for row in rows:
+        print(json.dumps(row))
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     steps = {"lm_xent": lm_xent, "lm_8k": lm_8k, "dp32": dp32,
              "bert_b256": bert_b256}
+    if which == "show":
+        return show()
     if which == "all":
         for name, fn in steps.items():
             log(f"=== {name} ===")

@@ -115,19 +115,20 @@ def offline_ab_rows():
     path = os.path.join(RES, "offline_ab.jsonl")
     if not os.path.exists(path):
         return
-    latest: dict[str, dict] = {}
-    for line in open(path).read().strip().splitlines():
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        latest[rec.get("tag", "?")] = rec
-    if not latest:
+    # Supersession rule lives in _ab_rows (latest line per tag wins;
+    # pinned by tests/test_offline_ab_parser.py).
+    from _ab_rows import load_rows, superseded_count
+
+    rows = load_rows(path)
+    if not rows:
         return
-    print(f"\n### offline AOT A/Bs ({mtime(path)}; latest row per tag)\n")
+    dropped = superseded_count(open(path).read().strip().splitlines())
+    print(f"\n### offline AOT A/Bs ({mtime(path)}; latest row per tag, "
+          f"{dropped} superseded row(s) hidden)\n")
     print("| tag | GB/dev | TFLOP/dev | temp GB | resident GB | note |")
     print("|---|---|---|---|---|---|")
-    for tag, r in latest.items():
+    for r in rows:
+        tag = r.get("tag", "?")
         if "compile_error" in r:
             print(f"| {tag} | — | — | — | — | "
                   f"ERROR: {r['compile_error'][:60]} |")

@@ -49,3 +49,79 @@ def test_non_collective_lines_ignored():
 """
     payload, ops = allreduce_payload(txt)
     assert ops == 0 and payload["bf16"] == 0 and payload["f32"] == 0
+
+
+# ---------------------------------------------------------------------------
+# offline_ab.jsonl supersession (perf/_ab_rows): PERF.md §11 regenerated the
+# round-4 offline pallas rows in place — regenerations APPEND with the same
+# tag, so the parser must keep only the latest line per tag.  _ab_rows is
+# deliberately import-side-effect-free (exp_offline_ab grabs the AOT lock
+# at import; tests must never).
+# ---------------------------------------------------------------------------
+
+import json  # noqa: E402
+
+from _ab_rows import load_rows, parse_rows, superseded_count  # noqa: E402
+
+
+def _lines(*rows):
+    return [json.dumps(r) for r in rows]
+
+
+def test_latest_row_per_tag_wins():
+    rows = parse_rows(_lines(
+        {"tag": "lm_2k_pallas_fusedxent", "gb": 999.0, "round": 4},
+        {"tag": "resnet50_dp32", "gb": 6.84},
+        {"tag": "lm_2k_pallas_fusedxent", "gb": 99.83, "round": 5},
+    ))
+    assert len(rows) == 2
+    by_tag = {r["tag"]: r for r in rows}
+    # the round-5 regeneration supersedes the round-4 interpret-mode row
+    assert by_tag["lm_2k_pallas_fusedxent"]["gb"] == 99.83
+    assert by_tag["resnet50_dp32"]["gb"] == 6.84
+
+
+def test_suffixed_tags_are_distinct_keys():
+    # a v4-topology regeneration must never hide the v5e row
+    rows = parse_rows(_lines(
+        {"tag": "resnet50_dp32", "gb": 6.84},
+        {"tag": "resnet50_dp32_v4_221", "gb": 7.5},
+        {"tag": "resnet50_dp32_r5", "gb": 6.9},
+    ))
+    assert [r["tag"] for r in rows] == [
+        "resnet50_dp32", "resnet50_dp32_v4_221", "resnet50_dp32_r5"]
+
+
+def test_compile_error_rows_supersedeable_both_ways():
+    # error -> success: the fix wins; success -> error: the latest
+    # compiler verdict wins (a regression must not hide behind old data)
+    rows = parse_rows(_lines(
+        {"tag": "a", "compile_error": "RESOURCE_EXHAUSTED"},
+        {"tag": "a", "gb": 1.0},
+        {"tag": "b", "gb": 2.0},
+        {"tag": "b", "compile_error": "vmem"},
+    ))
+    by_tag = {r["tag"]: r for r in rows}
+    assert "compile_error" not in by_tag["a"] and by_tag["a"]["gb"] == 1.0
+    assert by_tag["b"]["compile_error"] == "vmem"
+
+
+def test_garbage_and_blank_lines_skipped():
+    rows = parse_rows(["", "not json {", json.dumps({"tag": "x", "gb": 1}),
+                       "[1,2,3]"])
+    assert len(rows) == 1 and rows[0]["tag"] == "x"
+
+
+def test_superseded_count():
+    lines = _lines({"tag": "a", "v": 1}, {"tag": "a", "v": 2},
+                   {"tag": "b", "v": 1})
+    assert superseded_count(lines) == 1
+    assert superseded_count(_lines({"tag": "a", "v": 1})) == 0
+
+
+def test_real_results_file_round_trips(tmp_path):
+    p = tmp_path / "offline_ab.jsonl"
+    p.write_text("\n".join(_lines({"tag": "a", "v": 1},
+                                  {"tag": "a", "v": 2})) + "\n")
+    rows = load_rows(str(p))
+    assert rows == [{"tag": "a", "v": 2}]

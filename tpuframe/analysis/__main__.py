@@ -3,14 +3,18 @@
 Runs all three analysis layers against the shipped tree and exits
 non-zero on any finding:
 
-  1. source lint (TF101-TF104) over ``tpuframe/``;
+  1. source lint (TF101-TF106) over ``tpuframe/``;
   2. per-strategy collective budget audits — every strategy step program
      in :mod:`tpuframe.analysis.strategies` is AOT-compiled on a forced
      multi-device CPU backend and its collectives checked against the
      declared :class:`~tpuframe.analysis.budgets.CommBudget`;
   3. registry cross-checks — every
      :data:`~tpuframe.analysis.budgets.KNOWN_VMEM_EXCLUSIONS` entry must
-     still be excluded by the gate it cites.
+     still be excluded by the gate it cites;
+  4. tune self-check — the roofline hardware tables must keep
+     reproducing PERF.md §2's recorded anchors, the shipped tuning DB
+     (if any) must validate against the schema, and the tuner's own
+     flag plumbing must pass TF106 (``tpuframe.tune.check``).
 
 Strategies this interpreter cannot express (see
 :class:`~tpuframe.analysis.strategies.Unavailable`) print as SKIP and do
@@ -95,6 +99,16 @@ def _run_strategies(names, n_devices) -> int:
     return failures
 
 
+def _run_tune_check() -> int:
+    from tpuframe import tune
+
+    problems = tune.check()
+    for p in problems:
+        print(f"TUNE {p}")
+    print(f"[analysis] tune self-check: {len(problems)} problem(s)")
+    return len(problems)
+
+
 def _run_registry_checks() -> int:
     from tpuframe.analysis.budgets import check_known_exclusions
 
@@ -125,6 +139,7 @@ def main(argv=None) -> int:
         n_findings += _run_strategies(
             tuple(args.strategy) if args.strategy else None, args.devices)
         n_findings += _run_registry_checks()
+        n_findings += _run_tune_check()
 
     if n_findings:
         print(f"[analysis] FAIL: {n_findings} finding(s)")

@@ -30,6 +30,19 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          loop that sleeps but never compares, raises, or reads a clock —
          an unbounded retry loop with no exit condition, the shape that
          wedges a supervisor forever (use RetryPolicy).
+  TF106  compiler-env mutation that can run after jax backend init —
+         ``os.environ["XLA_FLAGS"] = ...`` (or ``LIBTPU_INIT_ARGS``,
+         via assignment/setdefault/update/putenv) is snapshotted by the
+         backend at init and silently ignored afterwards: the exact
+         footgun ``parallel/tuning.py:apply()`` can only catch at
+         runtime with a warning.  Fires on any such write inside a
+         function (functions run at arbitrary times) unless the
+         function probes backend init first (references ``xla_bridge``
+         or ``_backends``, tuning.apply's pattern), and on
+         module-level writes placed *after* a module-level
+         ``import jax``.  Per-compile ``compiler_options``
+         (``TPUFRAME_XLA_OPTS`` / tpuframe.tune) is the safe carrier —
+         it travels inside the compile request.
 
 Scope: TF101/TF102 only fire *inside functions known to be traced*
 (decorated with ``jax.jit``/``pmap``/``shard_map`` or passed to
@@ -56,7 +69,12 @@ RULES = {
     "TF103": "duration measured around device work without a sync",
     "TF104": "pallas_call without an explicit interpret= decision",
     "TF105": "storage call or retry loop bypassing the resilience layer",
+    "TF106": "compiler-env (XLA_FLAGS/LIBTPU_INIT_ARGS) mutation that can "
+             "run after jax backend init",
 }
+
+# TF106: env keys the backend snapshots at init — a later write is dead.
+_COMPILER_ENV_KEYS = {"XLA_FLAGS", "LIBTPU_INIT_ARGS"}
 
 # TF105a: google.cloud.storage blob/bucket methods — allowed only inside
 # the retry-wrapped data/gcs.py layer.
@@ -154,9 +172,28 @@ def _test_touches_arrays(test: ast.AST) -> bool:
 
 
 class _FnInfo:
-    def __init__(self, node, traced: bool):
+    def __init__(self, node, traced: bool, probes_backend: bool = False):
         self.node = node
         self.traced = traced
+        self.probes_backend = probes_backend
+
+
+def _probes_backend(fn_node) -> bool:
+    """TF106 exemption: the function checks whether the backend already
+    initialized (``jax._src.xla_bridge._backends`` — tuning.apply's
+    pattern) or replaces the process outright (``os.execvpe``: the next
+    process re-initializes from the new env)."""
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("_backends",
+                                                           "xla_bridge"):
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "xla_bridge":
+            return True
+        if (isinstance(sub, ast.Call) and _dotted(sub.func)
+                .rsplit(".", 1)[-1] in ("execv", "execve", "execvp",
+                                        "execvpe")):
+            return True
+    return False
 
 
 def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
@@ -169,6 +206,20 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
     lines = src.splitlines()
     jitted = _jitted_names(tree)
     findings: list[LintFinding] = []
+
+    # TF106: a module-level compiler-env write is safe only BEFORE the
+    # module-level jax import (the conftest/bootstrap pattern).
+    jax_import_line = None
+    for top in tree.body:
+        if isinstance(top, ast.Import) and any(
+                a.name == "jax" or a.name.startswith("jax.")
+                for a in top.names):
+            jax_import_line = top.lineno
+            break
+        if isinstance(top, ast.ImportFrom) and top.module and (
+                top.module == "jax" or top.module.startswith("jax.")):
+            jax_import_line = top.lineno
+            break
 
     def suppressed(rule: str, *linenos: int) -> bool:
         for ln in linenos:
@@ -200,7 +251,7 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
                   or node.name in jitted
                   or any(_is_tracing_decorator(d)
                          for d in node.decorator_list))
-        info = _FnInfo(node, traced)
+        info = _FnInfo(node, traced, probes_backend=_probes_backend(node))
         _check_timing(node, info)
         for child in _iter_local(node):
             _check_node(child, info)
@@ -221,8 +272,47 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
         rec(node)
         return out
 
+    def _tf106(node, key, fn: _FnInfo | None):
+        if fn is not None:
+            if fn.probes_backend:
+                return  # checked backend init / re-execs — tuning.apply
+        elif jax_import_line is None or node.lineno < jax_import_line:
+            return  # module-level write before the jax import: safe
+        emit("TF106", node,
+             f"os.environ[{key!r}] written where the jax backend may "
+             f"already be initialized — the backend snapshots compiler "
+             f"env at init and later writes are silently dead; pass "
+             f"per-compile compiler_options (TPUFRAME_XLA_OPTS / "
+             f"tpuframe.tune) or probe xla_bridge._backends first", fn)
+
     def _check_node(node, fn: _FnInfo | None):
         traced = fn is not None and fn.traced
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if (isinstance(t, ast.Subscript)
+                        and _dotted(t.value) == "os.environ"
+                        and isinstance(t.slice, ast.Constant)
+                        and t.slice.value in _COMPILER_ENV_KEYS):
+                    _tf106(node, t.slice.value, fn)
+        if isinstance(node, ast.Call):
+            callee106 = _dotted(node.func)
+            if (callee106 in ("os.environ.setdefault", "os.putenv")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value in _COMPILER_ENV_KEYS):
+                _tf106(node, node.args[0].value, fn)
+            elif callee106 == "os.environ.update":
+                keys = [kw.arg for kw in node.keywords
+                        if kw.arg in _COMPILER_ENV_KEYS]
+                for a in node.args:
+                    if isinstance(a, ast.Dict):
+                        keys += [k.value for k in a.keys
+                                 if isinstance(k, ast.Constant)
+                                 and k.value in _COMPILER_ENV_KEYS]
+                for key in keys:
+                    _tf106(node, key, fn)
         if isinstance(node, ast.Call):
             callee = _dotted(node.func)
             tail = callee.rsplit(".", 1)[-1]

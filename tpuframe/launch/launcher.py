@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 
 from tpuframe.launch.provision import SliceConfig
 from tpuframe.resilience.preempt import RC_PREEMPTED
+from tpuframe.utils import compile_cache
 
 
 def _free_port() -> int:
@@ -83,6 +84,12 @@ class LocalCluster:
                 "TPUFRAME_NUM_PROCESSES": str(self.num_processes),
                 "TPUFRAME_PROCESS_ID": str(pid),
             })
+            # Pin all ranks (and any relaunch of this cluster) to one
+            # persistent compilation cache so warm restarts skip the
+            # recompile (utils/compile_cache; train() enables it from
+            # this env var).  An operator's explicit setting wins.
+            env.setdefault("TPUFRAME_COMPILE_CACHE",
+                           compile_cache.default_cache_dir())
             env.update(self.extra_env)
             procs.append(subprocess.Popen(
                 argv, env=env, stdout=subprocess.PIPE,

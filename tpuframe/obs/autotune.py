@@ -163,6 +163,62 @@ def subprocess_measure(argv: list[str], *, timeout: float = 1800) -> Measure:
     return measure
 
 
+def replay_offline_topk(measure: Measure, *, program: str | None = None,
+                        family: str | None = None,
+                        generation: str | None = None, k: int = 3,
+                        db=None, save: bool = True, log=None) -> Report:
+    """Bridge from the offline autotuner (tpuframe.tune): when a chip
+    window opens, replay the offline-RANKED top-k candidates through the
+    real measured loop and upgrade their tuning-DB records from predicted
+    to measured.
+
+    The offline sweep's roofline ranking is a compiler-derived lower
+    bound (and blind inside pallas custom calls, PERF.md §8) — this is
+    the step that turns it into ground truth.  Every candidate that
+    measures successfully is upgraded, not just the winner: a measured
+    loser is exactly as valuable to the DB's ranking as a measured
+    winner.  ``measure`` follows this module's contract (env-override
+    dict -> metric, higher is better) — e.g. ``subprocess_measure`` over
+    bench.py on the bench chip.
+    """
+    from tpuframe.tune import db as tune_db
+
+    if db is None:
+        db = tune_db.TuningDB.open()
+    candidates = db.top_k(k, program=program, family=family,
+                          generation=generation)
+    if log:
+        log(f"replaying offline top-{len(candidates)} "
+            f"(program={program}, family={family}, gen={generation})")
+    report = Report()
+    for rec in candidates:
+        overrides = rec.env_overrides()
+        t0 = time.time()
+        try:
+            value = float(measure(dict(overrides)))
+            err = None
+        except Exception as e:  # noqa: BLE001 — a failed trial is data
+            value, err = float("-inf"), f"{type(e).__name__}: {e}"[:200]
+        trial = {"env": dict(overrides),
+                 "value": None if err else value,
+                 "seconds": round(time.time() - t0, 1),
+                 "config": dict(rec.config)}
+        if err:
+            trial["error"] = err
+        report.trials.append(trial)
+        if log:
+            log(f"trial {rec.config} -> {value}"
+                + (f" ({err})" if err else ""))
+        if err is None:
+            db.upgrade_measured(rec, value, unit="value", maximize=True)
+        if value > report.best_value:
+            report.best_value = value
+            report.best_env = dict(overrides)
+    if save and any(t["value"] is not None for t in report.trials):
+        db.save()
+    return report
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="greedy env-knob autotune over a benchmark command")

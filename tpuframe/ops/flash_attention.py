@@ -31,10 +31,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# 128 = the MXU tile edge; env-overridable so on-chip sweeps
-# (perf/bench_attention.py) can tune without code edits.
-DEFAULT_BLOCK_Q = int(os.environ.get("TPUFRAME_FA_BLOCK_Q", "128"))
-DEFAULT_BLOCK_K = int(os.environ.get("TPUFRAME_FA_BLOCK_K", "128"))
+# 128 = the MXU tile edge.  Resolution order (tpuframe.tune):
+# TPUFRAME_FA_BLOCK_Q/K env > tuning-DB measured > tuning-DB predicted >
+# 128 — and the DB tiers only engage when the target TPU generation is
+# known (TPUFRAME_TUNE_GEN / PALLAS_AXON_TPU_GEN), so plain CPU runs and
+# the fast test tier always see 128/128.
+from tpuframe.tune import db as _tune_db  # noqa: E402 — stdlib-only module
+
+DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K = _tune_db.resolve_fa_blocks(128, 128)
 NEG_INF = -1e30  # softmax mask fill; finite so (x - x) stays 0, not nan
 
 _LANES = 128  # VMEM lane width: per-row stats are stored lane-broadcast

@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import ast
 import os
+import sys
 import time
 from dataclasses import dataclass
 from typing import Any, Iterator
@@ -253,12 +254,24 @@ def build_harness(cfg: TrainConfig) -> Harness:
 
         loss_fn = make_loss_fn(cfg, model)
         from tpuframe.parallel import tuning
+        from tpuframe.tune import db as tune_db
+        from tpuframe.utils import xla_opts as xla_opts_lib
+
+        # Per-compile compiler options: TPUFRAME_XLA_OPTS env wins, else
+        # the offline tuning DB (tpuframe.tune; only engages when the
+        # target TPU generation is known).  This is how queue-6's
+        # scheduler-flag A/Bs run through the real training loop.
+        xla_opts = xla_opts_lib.from_env()
+        if xla_opts is None:
+            xla_opts = tune_db.resolve_xla_opts(cfg.name,
+                                                family="train_step")
         train_step = step_lib.make_train_step(
             loss_fn, tx, mesh, batch_partition=step_part,
             reduce_axes=reduce_axes, state_shardings=state_shardings,
             fusion_threshold=tuning.step_threshold(),
             accum_steps=cfg.accum_steps,
-            grad_reduce=cfg.grad_reduce)
+            grad_reduce=cfg.grad_reduce,
+            compiler_options=xla_opts)
         eval_step = step_lib.make_eval_step(
             make_metric_fn(cfg, model), mesh, batch_partition=step_part,
             reduce_axes=reduce_axes, state_shardings=state_shardings)
@@ -555,6 +568,20 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
     # harness so a SIGTERM during compile/restore is already caught; the
     # loop below checkpoints at the next step boundary and exits rc 14.
     guard = PreemptionGuard().install()
+    # Persistent compilation cache (utils/compile_cache): a relaunch or
+    # crash-loop restart of the same program compiles from the on-disk
+    # cache instead of from scratch — hit/miss counters land in the final
+    # metrics below next to the retry.* counters.  Gated: the train step
+    # returns typed PRNG keys (state.rng), which jax 0.4.x cannot serve
+    # from the cache without a hard C++ abort.
+    from tpuframe.utils import compile_cache
+
+    if compile_cache.safe_for_key_outputs():
+        compile_cache.enable()
+    else:
+        print("[tpuframe] compile cache: disabled (this jax aborts on "
+              "cached executables with typed-PRNG-key outputs)",
+              file=sys.stderr)
     # Re-parse TPUFRAME_FAULTS per run: in-process callers (tests) invoke
     # train() repeatedly under different envs, and restore-time gcs reads
     # inside build_harness already pass through the seams.
@@ -673,6 +700,8 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             # Retry-loop activity (resilience/policy.py) — empty unless the
             # storage layer actually retried, so clean runs log nothing new.
             final_train_metrics.update(obs_metrics.counters("retry."))
+            final_train_metrics.update(
+                obs_metrics.counters("compile_cache."))
             logger.log(step, final_train_metrics)
 
         if step % cfg.eval_every == 0 or step == cfg.total_steps:
@@ -741,6 +770,7 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
     guard.uninstall()
     final_train_metrics["step"] = step
     final_train_metrics.update(obs_metrics.counters("retry."))
+    final_train_metrics.update(obs_metrics.counters("compile_cache."))
     return final_train_metrics
 
 

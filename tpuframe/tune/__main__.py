@@ -1,0 +1,119 @@
+"""CLI for the offline autotuner.
+
+    python -m tpuframe.tune sweep --topology v5e:2x2   # the whole thing
+    python -m tpuframe.tune show                        # ranked DB contents
+    python -m tpuframe.tune check                       # CI self-check
+
+Runs CPU-only: the sweep compiles against a compile-only TPU topology on
+the CPU host (PERF.md §7) — no chip, no relay.  The env scrub below keeps
+the axon TPU plugin from registering (it self-registers whenever
+PALLAS_AXON_POOL_IPS is set) and forces real Mosaic lowering for pallas
+kernels; it must run before jax initializes a backend.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _ensure_cpu_env() -> None:
+    """CPU-host env scrub (perf/_common.ensure_cpu_backend's rule).
+
+    jax is imported by the tpuframe package root before this runs, but the
+    backend is chosen lazily — re-exec is only needed when JAX_PLATFORMS
+    was already forced to something other than cpu or the axon plugin
+    would self-register.
+    """
+    os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    os.environ.setdefault("TPUFRAME_PALLAS_INTERPRET", "0")
+    # Off-GCP hosts: libtpu's topology init otherwise polls the GCE
+    # metadata server 30x per variable (~minutes of 403s) before giving up.
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    if (os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu")
+            or os.environ.get("PALLAS_AXON_POOL_IPS", "")):
+        print("[tune] re-exec on the plain CPU backend...", flush=True)
+        os.environ.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        os.execvpe(sys.executable,
+                   [sys.executable, "-m", "tpuframe.tune"] + sys.argv[1:],
+                   os.environ)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _cmd_sweep(args) -> int:
+    from tpuframe.tune import search
+
+    search.sweep(args.topology, db_path=args.db, report_path=args.report,
+                 seq=args.seq, head_dim=args.head_dim,
+                 blocks=tuple(args.blocks),
+                 bench_batches=tuple(args.bench_batches))
+    return 0
+
+
+def _cmd_show(args) -> int:
+    from tpuframe.tune import db as tune_db
+
+    path = args.db or tune_db.default_db_path()
+    if not os.path.exists(path):
+        print(f"no tuning DB at {path}")
+        return 1
+    db = tune_db.TuningDB.open(path)
+    for fam in sorted({r.family for r in db.records()}):
+        print(f"[{fam}]")
+        for rec in db.top_k(10, family=fam):
+            tier = ("measured" if rec.measured
+                    and rec.measured.get("value") is not None
+                    else "predicted")
+            print(f"  {rec.program} {rec.generation} "
+                  f"{json.dumps(rec.config, sort_keys=True)} "
+                  f"-> {rec.predicted.get('predicted_ms')} ms "
+                  f"({tier})")
+    return 0
+
+
+def _cmd_check(args) -> int:
+    """Self-check the analysis gate registers: hardware-table sanity, DB
+    schema validation, TF106 self-lint of the tuner's own flag plumbing."""
+    from tpuframe.tune import check as run_check
+
+    problems = run_check(db_path=args.db)
+    for p in problems:
+        print(f"[tune-check] {p}")
+    print(f"[tune-check] {'FAIL' if problems else 'OK'}")
+    return 1 if problems else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tpuframe.tune",
+                                 description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sw = sub.add_parser("sweep", help="offline AOT sweep on a compile-only "
+                                      "topology")
+    sw.add_argument("--topology", default="v5e:2x2")
+    sw.add_argument("--db", default=None, help="tuning DB path "
+                    "(default: <repo>/tune_db.json)")
+    sw.add_argument("--report", default=None)
+    sw.add_argument("--seq", type=int, default=2048)
+    sw.add_argument("--head-dim", type=int, default=64)
+    sw.add_argument("--blocks", type=int, nargs="+",
+                    default=[128, 256, 512])
+    sw.add_argument("--bench-batches", type=int, nargs="+", default=[256])
+    sw.set_defaults(fn=_cmd_sweep)
+
+    sh = sub.add_parser("show", help="print ranked DB contents")
+    sh.add_argument("--db", default=None)
+    sh.set_defaults(fn=_cmd_show)
+
+    ck = sub.add_parser("check", help="CI self-check (schema + tables + "
+                                      "TF106 self-lint)")
+    ck.add_argument("--db", default=None)
+    ck.set_defaults(fn=_cmd_check)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    _ensure_cpu_env()
+    sys.exit(main())

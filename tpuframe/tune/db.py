@@ -1,0 +1,345 @@
+"""Persistent tuning database (JSON), consulted at startup by ``train.py``,
+``bench.py`` and ``ops/flash_attention.py``.
+
+Records are keyed by (program, topology, generation, config) and carry a
+two-tier score:
+
+  predicted — written by the offline AOT sweep (``tpuframe.tune.search``):
+              roofline lower-bound ms, binding resource, fits verdict,
+              VMEM footprint for pallas candidates.  Compiler-measured,
+              never chip-measured.
+  measured  — written when a chip window opens and
+              ``obs.autotune.replay_offline_topk`` re-runs the offline
+              top-k through the real measured loop, upgrading the record.
+
+Resolution precedence (docs/DESIGN.md "The tuning subsystem"):
+
+    env override  >  measured  >  predicted  >  hard default
+
+and DB resolution only engages when the target TPU generation is known
+(``TPUFRAME_TUNE_GEN`` or ``PALLAS_AXON_TPU_GEN``) — a plain CPU test run
+sees the hard defaults, untouched.
+
+Pure stdlib; import-time cost is nil by design (flash_attention resolves
+its block sizes through here at import).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+SCHEMA_VERSION = 1
+
+# Env knobs.  TPUFRAME_TUNE_DB: path to the DB file; "", "0" or "off"
+# disables DB resolution entirely.  TPUFRAME_TUNE_GEN: target generation
+# for resolution when PALLAS_AXON_TPU_GEN (the relay's own hint) is unset.
+_DB_ENV = "TPUFRAME_TUNE_DB"
+_GEN_ENVS = ("TPUFRAME_TUNE_GEN", "PALLAS_AXON_TPU_GEN")
+_OFF = ("", "0", "off", "none")
+
+_REQUIRED_KEYS = ("program", "family", "fingerprint", "topology",
+                  "generation", "config", "predicted")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_db_path() -> str:
+    env = os.environ.get(_DB_ENV)
+    if env and env.strip().lower() not in _OFF:
+        return env
+    return os.path.join(repo_root(), "tune_db.json")
+
+
+def db_disabled() -> bool:
+    env = os.environ.get(_DB_ENV)
+    return env is not None and env.strip().lower() in _OFF
+
+
+def target_generation() -> str | None:
+    """The TPU generation runtime resolution should tune for, or None when
+    unknown (-> callers keep their hard defaults; CPU test runs land
+    here)."""
+    for var in _GEN_ENVS:
+        val = os.environ.get(var, "").strip().lower()
+        if val:
+            return val.split(":", 1)[0]
+    return None
+
+
+def fingerprint(desc, xla_opts: dict | None = None) -> str:
+    """Stable program fingerprint: sha256 over the canonical JSON of a
+    program description plus the (sorted) compiler-option set — so a seeded
+    ``TPUFRAME_XLA_OPTS`` candidate yields a different fingerprint even
+    when the lowered module text is identical (compiler options travel in
+    the compile request, not the module)."""
+    payload = {"desc": desc,
+               "xla_opts": sorted((xla_opts or {}).items())}
+    blob = json.dumps(payload, sort_keys=True, separators=(",", ":"),
+                      default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+class Record:
+    """Thin read-mostly wrapper over one DB record dict."""
+
+    def __init__(self, data: dict):
+        self.data = data
+
+    def __getitem__(self, k):
+        return self.data[k]
+
+    def get(self, k, default=None):
+        return self.data.get(k, default)
+
+    @property
+    def program(self) -> str:
+        return self.data["program"]
+
+    @property
+    def family(self) -> str:
+        return self.data["family"]
+
+    @property
+    def generation(self) -> str:
+        return self.data["generation"]
+
+    @property
+    def topology(self) -> str:
+        return self.data["topology"]
+
+    @property
+    def config(self) -> dict:
+        return self.data.get("config", {})
+
+    @property
+    def predicted(self) -> dict:
+        return self.data.get("predicted", {})
+
+    @property
+    def measured(self) -> dict | None:
+        return self.data.get("measured")
+
+    def env_overrides(self) -> dict:
+        """This record's config as the env vars the existing knobs read —
+        the bridge into ``obs.autotune``'s subprocess measure loop."""
+        env = {}
+        cfg = self.config
+        if "fa_block_q" in cfg:
+            env["TPUFRAME_FA_BLOCK_Q"] = str(cfg["fa_block_q"])
+        if "fa_block_k" in cfg:
+            env["TPUFRAME_FA_BLOCK_K"] = str(cfg["fa_block_k"])
+        if cfg.get("xla_opts"):
+            env["TPUFRAME_XLA_OPTS"] = ",".join(
+                f"{k}={v}" for k, v in sorted(cfg["xla_opts"].items()))
+        if "batch" in cfg:
+            env["TPUFRAME_BENCH_BATCH"] = str(cfg["batch"])
+        return env
+
+    def _key(self):
+        return (self.program, self.topology, self.generation,
+                json.dumps(self.config, sort_keys=True))
+
+    def _rank(self):
+        """Sort key, best first.  Measured tier always beats predicted.
+        Within measured: higher value wins when the measure maximizes
+        (throughput — obs.autotune's convention), else lower.  Within
+        predicted: lower roofline ms, then higher VMEM utilization — for
+        pallas kernels cost_analysis cannot see inside the custom call
+        (PERF.md §8), so roofline ms ties across block sizes and the
+        fatter in-budget tiling (fewer grid steps, better pipelining) is
+        the honest tiebreak."""
+        m = self.measured
+        if m and m.get("value") is not None:
+            v = float(m["value"])
+            return (0, -v if m.get("maximize", True) else v)
+        p = self.predicted
+        ms = p.get("predicted_ms")
+        ms = float("inf") if ms is None else float(ms)
+        return (1, ms, -float(p.get("vmem_bytes") or 0))
+
+
+class TuningDB:
+    def __init__(self, path: str, data: dict | None = None):
+        self.path = path
+        self.data = data or {"version": SCHEMA_VERSION, "records": []}
+
+    @classmethod
+    def open(cls, path: str | None = None) -> "TuningDB":
+        path = path or default_db_path()
+        data = None
+        if os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+        db = cls(path, data)
+        problems = validate(db.data)
+        if problems:
+            raise ValueError(f"tuning DB {path}: " + "; ".join(problems))
+        return db
+
+    def save(self, path: str | None = None) -> str:
+        path = path or self.path
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.data, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+    def records(self, *, program: str | None = None,
+                family: str | None = None,
+                generation: str | None = None,
+                topology: str | None = None) -> list:
+        out = []
+        for raw in self.data.get("records", []):
+            rec = Record(raw)
+            if program is not None and rec.program != program:
+                continue
+            if family is not None and rec.family != family:
+                continue
+            if generation is not None and rec.generation != generation:
+                continue
+            if topology is not None and rec.topology != topology:
+                continue
+            out.append(rec)
+        return out
+
+    def add(self, record: dict) -> Record:
+        """Insert or replace (same program/topology/generation/config key
+        replaces — a re-sweep supersedes its own older predictions but
+        never clobbers a different config's measured entry)."""
+        missing = [k for k in _REQUIRED_KEYS if k not in record]
+        if missing:
+            raise ValueError(f"tuning record missing keys {missing}")
+        rec = Record(record)
+        kept = [r for r in self.data["records"]
+                if Record(r)._key() != rec._key()]
+        kept.append(record)
+        self.data["records"] = kept
+        return rec
+
+    def top_k(self, k: int = 3, **filters) -> list:
+        return sorted(self.records(**filters),
+                      key=lambda r: r._rank())[:k]
+
+    def best(self, **filters) -> Record | None:
+        top = self.top_k(1, **filters)
+        return top[0] if top else None
+
+    def upgrade_measured(self, record: Record, value: float, *,
+                         unit: str = "value", maximize: bool = True,
+                         at: str | None = None) -> None:
+        """Predicted -> measured upgrade in place (call save() after)."""
+        for raw in self.data["records"]:
+            if Record(raw)._key() == record._key():
+                raw["measured"] = {"value": value, "unit": unit,
+                                   "maximize": maximize}
+                if at is not None:
+                    raw["measured"]["at"] = at
+                return
+        raise KeyError(f"record not in DB: {record.program} "
+                       f"{record.config}")
+
+    def lookup(self, program: str, fp: str, **filters) -> Record | None:
+        """Fingerprint-checked lookup: best record for ``program`` whose
+        fingerprint matches ``fp``.  A mismatch (the program changed since
+        the sweep) returns None — callers fall back to defaults rather
+        than apply a stale tuning."""
+        for rec in self.top_k(k=10 ** 6, program=program, **filters):
+            if rec["fingerprint"] == fp:
+                return rec
+        return None
+
+
+def validate(data) -> list:
+    """Schema validation for the CI gate.  Returns problem strings."""
+    problems = []
+    if not isinstance(data, dict):
+        return [f"DB root must be an object, got {type(data).__name__}"]
+    if data.get("version") != SCHEMA_VERSION:
+        problems.append(f"version {data.get('version')!r} != "
+                        f"{SCHEMA_VERSION}")
+    recs = data.get("records")
+    if not isinstance(recs, list):
+        return problems + ["'records' must be a list"]
+    for i, raw in enumerate(recs):
+        if not isinstance(raw, dict):
+            problems.append(f"records[{i}]: not an object")
+            continue
+        missing = [k for k in _REQUIRED_KEYS if k not in raw]
+        if missing:
+            problems.append(f"records[{i}]: missing {missing}")
+            continue
+        if not isinstance(raw["config"], dict):
+            problems.append(f"records[{i}]: config must be an object")
+        pred = raw["predicted"]
+        if not isinstance(pred, dict):
+            problems.append(f"records[{i}]: predicted must be an object")
+        m = raw.get("measured")
+        if m is not None and (not isinstance(m, dict) or "value" not in m):
+            problems.append(f"records[{i}]: measured needs a 'value'")
+        gen = str(raw["generation"])
+        from tpuframe.tune import roofline
+        if gen.split(":", 1)[0] not in roofline.HARDWARE:
+            problems.append(f"records[{i}]: unknown generation {gen!r}")
+    return problems
+
+
+def _open_for_resolution() -> TuningDB | None:
+    if db_disabled():
+        return None
+    path = default_db_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        return TuningDB.open(path)
+    except Exception:  # noqa: BLE001 — a corrupt DB must never take down
+        return None    # a training run; the analysis gate reports it.
+
+
+def resolve_fa_blocks(default_q: int, default_k: int) -> tuple:
+    """Flash-attention block sizes: env > measured > predicted > default.
+    DB tiers only engage when the target generation is known — plain CPU
+    runs (the whole fast test tier) see the hard defaults."""
+    q, k = default_q, default_k
+    gen = target_generation()
+    if gen is not None:
+        db = _open_for_resolution()
+        if db is not None:
+            rec = db.best(family="flash_attention", generation=gen)
+            if rec is not None:
+                q = int(rec.config.get("fa_block_q", q))
+                k = int(rec.config.get("fa_block_k", k))
+    env_q = os.environ.get("TPUFRAME_FA_BLOCK_Q")
+    env_k = os.environ.get("TPUFRAME_FA_BLOCK_K")
+    if env_q:
+        q = int(env_q)
+    if env_k:
+        k = int(env_k)
+    return q, k
+
+
+def resolve_xla_opts(program: str, family: str | None = None) -> dict | None:
+    """Compiler-option set for ``program``: None unless the DB has a tuned
+    set for the target generation.  Callers apply ``TPUFRAME_XLA_OPTS``
+    themselves FIRST (via utils.xla_opts.from_env) — when that env var is
+    set this returns None so the override is unambiguous."""
+    if os.environ.get("TPUFRAME_XLA_OPTS", "").strip():
+        return None
+    gen = target_generation()
+    if gen is None:
+        return None
+    db = _open_for_resolution()
+    if db is None:
+        return None
+    rec = db.best(program=program, generation=gen)
+    if rec is None and family is not None:
+        rec = db.best(family=family, generation=gen)
+    if rec is None:
+        return None
+    opts = rec.config.get("xla_opts")
+    return dict(opts) if opts else None

@@ -1,0 +1,159 @@
+"""Roofline scorer: compiled-program cost/memory analysis -> predicted step
+time lower bound, binding-resource verdict, and a fits/OOM check.
+
+Per-generation hardware tables.  The v5e numbers are the ones every PERF.md
+roofline uses (197 TFLOPs bf16, 0.81 TB/s HBM, 15.75 GB usable HBM) and the
+v4/v5p/v6e peak-flops column matches bench.py's ``BF16_PEAK_FLOPS`` table so
+the two can never disagree on MFU.
+
+Honesty caveats carried from PERF.md:
+
+  - §8: XLA's ``cost_analysis`` counts a ``lax.scan`` body ONCE per program
+    (not once per iteration) and cannot see inside a pallas custom call, so
+    flop/byte totals of scan-containing programs are LOWER BOUNDS.  Scores
+    for such programs are tagged ``bytes_lower_bound=True``; temp/argument
+    memory and the fits verdict are exact either way.
+  - §7.4a: the roofline is a LOWER bound on step time — the measured
+    ResNet-50 step sits at ~81% of the HBM roofline (scheduling gap), so a
+    predicted 177 ms means "not faster than 177 ms", never "177 ms".
+
+Pure stdlib — no jax import.  ``score_compiled`` takes the compiled object
+duck-typed (anything with ``cost_analysis``/``memory_analysis``/``as_text``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+GiB = 1024 ** 3
+
+
+@dataclasses.dataclass(frozen=True)
+class Hardware:
+    """Per-chip peaks for one TPU generation."""
+
+    generation: str
+    bf16_flops: float      # peak bf16 FLOPs/s (MXU)
+    hbm_bytes_per_s: float  # peak HBM bandwidth, bytes/s
+    hbm_capacity_bytes: float  # usable HBM per chip, bytes
+
+
+# Sources: v5e column = PERF.md §2 (197e12 / 0.81e12 / 15.75 GB, the values
+# every recorded roofline in this repo was computed against).  Peak-flops
+# column for v4/v5p/v6e = bench.py BF16_PEAK_FLOPS.  v4 HBM = 1.23 TB/s /
+# 32 GB, v5p = 2.76 TB/s / 95 GB, v6e = 1.64 TB/s / 32 GB (public TPU
+# system specs; only the v5e row is pinned by recorded measurements here).
+HARDWARE = {
+    "v4": Hardware("v4", 275e12, 1.23e12, 32.0 * 1e9),
+    "v5e": Hardware("v5e", 197e12, 0.81e12, 15.75 * 1e9),
+    "v5p": Hardware("v5p", 459e12, 2.76e12, 95.0 * 1e9),
+    "v6e": Hardware("v6e", 918e12, 1.64e12, 32.0 * 1e9),
+}
+
+
+def generation_from_topology(topology: str) -> str:
+    """'v5e:2x2' -> 'v5e' (the topology-string prefix jax's
+    ``get_topology_desc`` accepts)."""
+    return topology.split(":", 1)[0].strip().lower()
+
+
+def get_hardware(generation: str) -> Hardware:
+    gen = generation.split(":", 1)[0].strip().lower()
+    if gen not in HARDWARE:
+        raise KeyError(f"unknown TPU generation {generation!r}; "
+                       f"have {sorted(HARDWARE)}")
+    return HARDWARE[gen]
+
+
+def score(generation: str, *, flops: float, bytes_accessed: float,
+          peak_memory_bytes: float | None = None,
+          contains_scan: bool = False) -> dict:
+    """Roofline score for one compiled program on one chip generation.
+
+    Returns a JSON-able dict:
+      t_mxu_ms / t_hbm_ms — compute and bandwidth rooflines
+      predicted_ms        — max of the two (the binding one); a LOWER bound
+      bound               — "mxu" | "hbm" (which roofline binds)
+      fits                — peak_memory_bytes <= HBM capacity (None if the
+                            caller didn't supply memory)
+      bytes_lower_bound   — §8 scan caveat: totals undercount, so
+                            predicted_ms is even more of a lower bound
+    """
+    hw = get_hardware(generation)
+    t_mxu_ms = flops / hw.bf16_flops * 1e3
+    t_hbm_ms = bytes_accessed / hw.hbm_bytes_per_s * 1e3
+    fits = None
+    if peak_memory_bytes is not None:
+        fits = peak_memory_bytes <= hw.hbm_capacity_bytes
+    return {
+        "generation": hw.generation,
+        "flops": flops,
+        "bytes": bytes_accessed,
+        "t_mxu_ms": round(t_mxu_ms, 2),
+        "t_hbm_ms": round(t_hbm_ms, 2),
+        "predicted_ms": round(max(t_mxu_ms, t_hbm_ms), 2),
+        "bound": "hbm" if t_hbm_ms >= t_mxu_ms else "mxu",
+        "fits": fits,
+        "peak_memory_bytes": peak_memory_bytes,
+        "bytes_lower_bound": bool(contains_scan),
+    }
+
+
+def contains_scan(hlo_text: str) -> bool:
+    """§8 detector: a lowered-to-TPU ``lax.scan`` shows up as an HLO while
+    loop.  (Interpret-mode pallas also lowers as a while loop — one more
+    reason the sweep forces real Mosaic lowering.)"""
+    return "while(" in hlo_text or " while " in hlo_text
+
+
+def score_compiled(compiled, generation: str) -> dict:
+    """Score a jax AOT ``compiled`` object (``.lower(...).compile()``).
+
+    Duck-typed so this module needs no jax import.  Any missing analysis
+    (some backends return None) degrades to zeros rather than raising —
+    the search driver records the row either way.
+    """
+    try:
+        ca = compiled.cost_analysis() or {}
+    except Exception:  # noqa: BLE001 — cost_analysis is best-effort too
+        ca = {}
+    if isinstance(ca, (list, tuple)):  # older jax: one dict per device
+        ca = ca[0] if ca else {}
+    flops = float(ca.get("flops", 0.0))
+    nbytes = float(ca.get("bytes accessed", 0.0))
+    peak = None
+    try:
+        ma = compiled.memory_analysis()
+        peak = float(ma.temp_size_in_bytes + ma.argument_size_in_bytes)
+    except Exception:  # noqa: BLE001 — memory_analysis is best-effort
+        pass
+    try:
+        scan = contains_scan(compiled.as_text())
+    except Exception:  # noqa: BLE001
+        scan = False
+    return score(generation, flops=flops, bytes_accessed=nbytes,
+                 peak_memory_bytes=peak, contains_scan=scan)
+
+
+def check_tables() -> list:
+    """Sanity checks for the CI gate (analysis `_run_tune_check`): every
+    generation has positive peaks, and the v5e row reproduces PERF.md §2's
+    recorded ResNet-50 b=512 anchors (1.252e13 flops / 1.435e11 bytes ->
+    63.6 ms MXU, 177 ms HBM, bandwidth-bound).  Returns a list of problem
+    strings; empty means healthy."""
+    problems = []
+    for gen, hw in sorted(HARDWARE.items()):
+        if not (hw.bf16_flops > 0 and hw.hbm_bytes_per_s > 0
+                and hw.hbm_capacity_bytes > 0):
+            problems.append(f"hardware table {gen}: non-positive peak")
+        if hw.bf16_flops / hw.hbm_bytes_per_s > 1000:
+            problems.append(f"hardware table {gen}: arithmetic intensity "
+                            f"ridge >1000 flops/byte — units wrong?")
+    s = score("v5e", flops=1.252e13, bytes_accessed=1.435e11)
+    if abs(s["t_mxu_ms"] - 63.6) > 0.5:
+        problems.append(f"v5e MXU anchor drifted: {s['t_mxu_ms']} != 63.6 ms")
+    if abs(s["t_hbm_ms"] - 177.2) > 0.5:
+        problems.append(f"v5e HBM anchor drifted: {s['t_hbm_ms']} != 177.2 ms")
+    if s["bound"] != "hbm":
+        problems.append("v5e ResNet-50 anchor must be bandwidth-bound")
+    return problems

@@ -1,0 +1,107 @@
+"""Shared persistent-compilation-cache startup helper.
+
+The JAX persistent compile cache was wired only into bench.py; this moves
+it into one helper used by ``train.py``, ``launch/launcher.py`` and
+``bench.py`` — so PR 2's preemption relaunches and crash-loop restarts
+stop recompiling every program from scratch.  Cache traffic is surfaced
+as process-wide counters in ``obs.metrics``:
+
+    compile_cache.hits    — programs served from the on-disk cache
+    compile_cache.misses  — fresh compiles written to it
+
+(train.py folds both into its final metrics next to the ``retry.*``
+counters, so a warm restart is visible in the run log.)
+
+Knobs:
+    TPUFRAME_COMPILE_CACHE        cache dir; "" / "0" / "off" disables
+                                  (default <repo>/.xla_cache — bench.py's
+                                  long-standing location)
+    TPUFRAME_COMPILE_CACHE_MIN_S  min compile seconds worth persisting
+                                  (default 1.0, bench.py's value)
+"""
+
+from __future__ import annotations
+
+import os
+
+_ENV_DIR = "TPUFRAME_COMPILE_CACHE"
+_ENV_MIN_S = "TPUFRAME_COMPILE_CACHE_MIN_S"
+_OFF = ("", "0", "off", "none")
+
+_LISTENER_INSTALLED = False
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def safe_for_key_outputs() -> bool:
+    """Whether this jax can serve programs whose OUTPUTS are typed PRNG
+    keys (e.g. the train step's ``TrainState.rng``) from the persistent
+    cache.  jax 0.4.x hard-aborts (C++ CHECK in the key result handler)
+    when such an executable is deserialized over a mesh — unprobeable at
+    runtime, so gate on the same jax>=0.6 capability marker the analysis
+    strategies use.  bench-style programs without key outputs are safe on
+    every version and need no gate."""
+    import jax
+
+    return hasattr(jax, "typeof")
+
+
+def default_cache_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), ".xla_cache")
+
+
+def enable(cache_dir: str | None = None, *,
+           min_compile_secs: float | None = None,
+           min_entry_size_bytes: int | None = None) -> str | None:
+    """Turn on the persistent compilation cache + hit/miss counters.
+
+    Returns the cache dir, or None when disabled via env.  Call before
+    the first compile; safe to call more than once (jax.config updates
+    are idempotent, the monitoring listener installs once).  jax is
+    imported lazily so stdlib-only callers (bench.py module level) can
+    import this module freely.
+    """
+    env = os.environ.get(_ENV_DIR)
+    if env is not None and env.strip().lower() in _OFF:
+        return None
+    cache_dir = cache_dir or env or default_cache_dir()
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    if min_compile_secs is None:
+        min_compile_secs = float(os.environ.get(_ENV_MIN_S, "1.0"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)
+    if min_entry_size_bytes is not None:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          min_entry_size_bytes)
+    # If anything compiled before enable(), jax has already latched its
+    # cache singleton as "no cache" and ignores the dir we just set —
+    # reset so the next compile re-initializes against it.
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private API; worst case is the
+        pass           # old behavior (cache engages only if set early)
+    _install_listener()
+    return cache_dir
+
+
+def _install_listener() -> None:
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return
+    import jax
+
+    from tpuframe.obs import metrics
+
+    def _on_event(event: str, **kwargs) -> None:
+        if event == _HIT_EVENT:
+            metrics.bump("compile_cache.hits")
+        elif event == _MISS_EVENT:
+            metrics.bump("compile_cache.misses")
+
+    jax.monitoring.register_event_listener(_on_event)
+    _LISTENER_INSTALLED = True
