@@ -486,6 +486,58 @@ def test_tf105_suppression():
     """) == []
 
 
+def test_tf107_print_and_clock_in_hot_path():
+    src = textwrap.dedent("""
+        import time
+
+        def make_batch(it):
+            t0 = time.time()
+            batch = next(it)
+            print("batch in", time.time() - t0)
+            return batch
+    """)
+    findings = source_lint.lint_source(src, "tpuframe/data/pipeline.py")
+    assert [f.rule for f in findings] == ["TF107", "TF107", "TF107"]
+    # The identical code outside a hot-path module is host code doing
+    # host things — no finding.
+    assert source_lint.lint_source(src, "tpuframe/launch/launcher.py") == []
+
+
+def test_tf107_print_in_traced_code_fires_anywhere():
+    assert _rules("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("loss", x)
+            return x * 2
+    """) == ["TF107"]
+
+
+def test_tf107_obs_routed_instrumentation_is_clean():
+    src = textwrap.dedent("""
+        from tpuframe.obs import events, metrics
+
+        def make_batch(it):
+            batch = next(it)
+            metrics.bump("data.batches")
+            events.emit("step", step=0, wall_ms=1.0)
+            return batch
+    """)
+    assert source_lint.lint_source(src, "tpuframe/data/pipeline.py") == []
+    # Module-level clock reads (import-time, not per-step) don't fire.
+    mod = "import time\n_T0 = time.time()\n"
+    assert source_lint.lint_source(mod, "tpuframe/parallel/step.py") == []
+
+
+def test_tf107_suppression():
+    src = textwrap.dedent("""
+        def debug_batch(b):
+            print("shape", b)  # tf-lint: ok[TF107]
+    """)
+    assert source_lint.lint_source(src, "tpuframe/data/pipeline.py") == []
+
+
 def test_shipped_tree_self_lints_clean():
     import tpuframe
 

@@ -14,7 +14,11 @@ non-zero on any finding:
   4. tune self-check — the roofline hardware tables must keep
      reproducing PERF.md §2's recorded anchors, the shipped tuning DB
      (if any) must validate against the schema, and the tuner's own
-     flag plumbing must pass TF106 (``tpuframe.tune.check``).
+     flag plumbing must pass TF106 (``tpuframe.tune.check``);
+  5. obs self-check — ``python -m tpuframe.obs summarize --selfcheck``
+     schema-validates the shipped sample event logs (docs/samples/), so
+     an event-schema change that strands existing logs fails CI before
+     it ships.
 
 Strategies this interpreter cannot express (see
 :class:`~tpuframe.analysis.strategies.Unavailable`) print as SKIP and do
@@ -109,6 +113,16 @@ def _run_tune_check() -> int:
     return len(problems)
 
 
+def _run_obs_check() -> int:
+    # Through the real CLI entry point, not an import — the gate then
+    # also catches a broken ``python -m tpuframe.obs`` invocation.
+    rc = subprocess.call([sys.executable, "-m", "tpuframe.obs",
+                          "summarize", "--selfcheck"])
+    if rc:
+        print(f"[analysis] obs selfcheck FAILED (rc {rc})")
+    return 1 if rc else 0
+
+
 def _run_registry_checks() -> int:
     from tpuframe.analysis.budgets import check_known_exclusions
 
@@ -140,6 +154,7 @@ def main(argv=None) -> int:
             tuple(args.strategy) if args.strategy else None, args.devices)
         n_findings += _run_registry_checks()
         n_findings += _run_tune_check()
+        n_findings += _run_obs_check()
 
     if n_findings:
         print(f"[analysis] FAIL: {n_findings} finding(s)")

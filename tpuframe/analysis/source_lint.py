@@ -30,6 +30,17 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          loop that sleeps but never compares, raises, or reads a clock —
          an unbounded retry loop with no exit condition, the shape that
          wedges a supervisor forever (use RetryPolicy).
+  TF107  ad-hoc step instrumentation in a hot path — a bare ``print()``
+         or ``time.time()``/``perf_counter()`` timer inside per-step
+         code (the train step in ``parallel/step.py``, the data
+         pipeline in ``data/pipeline.py``) bypasses the structured
+         event log: it costs host time every step, interleaves across
+         hosts, and is invisible to the offline analyzer.  Route it
+         through ``tpuframe.obs`` (``events.emit``/``metrics.bump`` —
+         the host loop in train.py owns the one sanctioned timer).
+         Also fires on ``print()`` inside *traced* code anywhere: a
+         print under jit runs at trace time only, so it is not the
+         instrumentation it looks like (use ``jax.debug.print``).
   TF106  compiler-env mutation that can run after jax backend init —
          ``os.environ["XLA_FLAGS"] = ...`` (or ``LIBTPU_INIT_ARGS``,
          via assignment/setdefault/update/putenv) is snapshotted by the
@@ -71,7 +82,16 @@ RULES = {
     "TF105": "storage call or retry loop bypassing the resilience layer",
     "TF106": "compiler-env (XLA_FLAGS/LIBTPU_INIT_ARGS) mutation that can "
              "run after jax backend init",
+    "TF107": "print()/time.time() step instrumentation in a hot path "
+             "bypassing tpuframe.obs",
 }
+
+# TF107: per-step code — every call here runs once per step/batch, so
+# ad-hoc prints and timers belong in obs.events/obs.metrics instead.
+_HOT_PATH_SUFFIXES = ("parallel/step.py", "data/pipeline.py")
+
+# TF107: clock reads that look like hand-rolled step timing.
+_CLOCK_CALLS = {"time.time", "time.perf_counter", "time.monotonic"}
 
 # TF106: env keys the backend snapshots at init — a later write is dead.
 _COMPILER_ENV_KEYS = {"XLA_FLAGS", "LIBTPU_INIT_ARGS"}
@@ -206,6 +226,7 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
     lines = src.splitlines()
     jitted = _jitted_names(tree)
     findings: list[LintFinding] = []
+    hot_path = path.replace("\\", "/").endswith(_HOT_PATH_SUFFIXES)
 
     # TF106: a module-level compiler-env write is safe only BEFORE the
     # module-level jax import (the conftest/bootstrap pattern).
@@ -347,6 +368,23 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
                      f".{node.func.attr}() raw GCS client call outside "
                      f"data/gcs.py — route it through the retry-wrapped "
                      f"gcs layer (tpuframe.resilience)", fn)
+            if callee == "print":
+                if traced:
+                    emit("TF107", node,
+                         "print() inside traced code runs at trace time "
+                         "only, not per step — use jax.debug.print, or "
+                         "emit from the host loop via tpuframe.obs", fn)
+                elif hot_path and fn is not None:
+                    emit("TF107", node,
+                         "print() in per-step hot-path code bypasses the "
+                         "structured event log — use tpuframe.obs "
+                         "(events.emit / metrics.bump)", fn)
+            elif hot_path and fn is not None and callee in _CLOCK_CALLS:
+                emit("TF107", node,
+                     f"{callee}() hand-rolled step timing in a hot path "
+                     f"— the train loop's goodput meter owns step "
+                     f"timing; route measurements through tpuframe.obs",
+                     fn)
         elif isinstance(node, ast.While):
             if (isinstance(node.test, ast.Constant)
                     and node.test.value is True):

@@ -38,6 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuframe.data import gcs
+from tpuframe.obs import events as obs_events
 from tpuframe.resilience import faults
 
 PyTree = Any
@@ -603,8 +604,12 @@ class CheckpointManager:
 
     def save(self, step: int, tree: PyTree) -> str:
         if not self.async_write:
+            t0 = time.perf_counter()
             path = save(self.directory, step, tree)
             self._gc()
+            obs_events.emit("ckpt_save", step=step,
+                            ms=round((time.perf_counter() - t0) * 1e3, 3),
+                            async_write=False)
             return path
         prep_t0 = time.time()
         path, manifest, owned_files = _prepare_save(self.directory, step,
@@ -628,6 +633,11 @@ class CheckpointManager:
                 _finalize(path, manifest, poll=True,
                           min_mtime=prep_t0 - 60.0)
                 self._gc()
+                # ms spans snapshot through commit; the train loop only
+                # blocked for the snapshot slice (its goodput charge).
+                obs_events.emit("ckpt_save", step=step,
+                                ms=round((time.time() - prep_t0) * 1e3, 3),
+                                async_write=True)
             except Exception as e:  # noqa: BLE001 — surfaced by wait_pending
                 self._errors.append(f"save step {step}: "
                                     f"{type(e).__name__}: {e}")
@@ -754,8 +764,15 @@ class CheckpointManager:
             step = steps[-1]
             tried.add(step)
             try:
-                return step, restore(self.directory, step, mesh=mesh,
-                                     target=target)
+                t0 = time.perf_counter()
+                out = step, restore(self.directory, step, mesh=mesh,
+                                    target=target)
+                # Times host-side I/O (restore reads + deserializes on
+                # host), not async device dispatch.
+                ms = (time.perf_counter() - t0) * 1e3  # tf-lint: ok[TF103]
+                obs_events.emit("ckpt_restore", step=step,
+                                ms=round(ms, 3))
+                return out
             except (OSError, EOFError, KeyError,
                     json.JSONDecodeError) as e:
                 quarantined = quarantine_step(self.directory, step)

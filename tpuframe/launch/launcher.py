@@ -178,7 +178,14 @@ def run_with_relaunch(run_once, relaunches: int, *, log=print,
     stalled = 0
     delay = backoff_base_s
     last_progress = progress() if progress is not None else None
+    # Attempt stitching for the structured event log (obs/events.py): every
+    # (re)launch — cooperative rc-14 resumes included — gets the next serial
+    # so one events.<host>.jsonl reconstructs the full supervised lifecycle.
+    # Env contract, not an import: run_once children inherit os.environ.
+    attempt_serial = int(os.environ.get("TPUFRAME_ATTEMPT", "0") or "0")
     while True:
+        os.environ["TPUFRAME_ATTEMPT"] = str(attempt_serial)
+        attempt_serial += 1
         rc = run_once()
         if rc == 0:
             return rc

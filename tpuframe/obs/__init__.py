@@ -1,7 +1,13 @@
 """Observability (SURVEY.md §5.1/§5.5): metrics, throughput, profiling,
-heartbeat/stall detection — the TPU-native stand-ins for Horovod Timeline and
-HOROVOD_STALL_CHECK."""
+heartbeat/stall detection, structured run-event tracing, goodput/MFU
+accounting and HBM telemetry — the TPU-native stand-ins for Horovod
+Timeline and HOROVOD_STALL_CHECK, plus the ``python -m tpuframe.obs``
+offline analyzer over ``events.<host>.jsonl`` logs."""
 
+from tpuframe.obs import devmem, events, goodput  # noqa: F401
+from tpuframe.obs.devmem import DevmemSampler  # noqa: F401
+from tpuframe.obs.events import EventLog  # noqa: F401
+from tpuframe.obs.goodput import GoodputMeter  # noqa: F401
 from tpuframe.obs.metrics import MetricLogger, RateMeter  # noqa: F401
 from tpuframe.obs.heartbeat import Heartbeat  # noqa: F401
 from tpuframe.obs.timeline import (StepTimeline, profile_trace,  # noqa: F401

@@ -1,0 +1,198 @@
+"""``python -m tpuframe.obs`` — offline analyzer over structured event logs.
+
+Subcommands (all take a directory of ``events.<host>.jsonl`` files, or a
+single file):
+
+  summarize  merged goodput breakdown (bucket seconds + % of wall),
+             step-time distribution, MFU, peak HBM, run_end counters.
+             ``--selfcheck`` instead schema-validates shipped/sample
+             event files (the analysis CI gate calls this).
+  merge      one time-ordered multi-host stream to stdout or ``-o``.
+  anomalies  step-time regressions vs. a rolling median, heartbeat
+             stalls, retry storms, low MFU, attempts with no run_end.
+             Exits 1 when anything is flagged (scriptable).
+
+Examples::
+
+    python -m tpuframe.obs summarize /runs/r7/events
+    python -m tpuframe.obs anomalies /runs/r7/events --mfu-min 0.3
+    python -m tpuframe.obs merge /runs/r7/events -o merged.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tpuframe.obs import events as events_lib
+from tpuframe.obs import goodput as goodput_lib
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.2f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n:.2f} GiB"
+
+
+def _load(directory: str) -> list[dict]:
+    files = events_lib.event_files(directory)
+    if not files:
+        print(f"[obs] no events.<host>.jsonl under {directory}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return events_lib.merge(directory)
+
+
+def _sample_paths() -> list[str]:
+    """The repo-shipped sample event files (docs/samples/) — the
+    selfcheck's default target, so a schema change that strands old logs
+    fails CI before it ships."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return events_lib.event_files(os.path.join(root, "docs", "samples"))
+
+
+def cmd_selfcheck(directory: str | None) -> int:
+    paths = (events_lib.event_files(directory) if directory
+             else _sample_paths())
+    if not paths:
+        print("[obs] selfcheck: no event files found", file=sys.stderr)
+        return 1
+    problems = events_lib.validate_files(paths)
+    for p in problems:
+        print(f"OBS {p}")
+    print(f"[obs] selfcheck: {len(paths)} file(s), "
+          f"{len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+def cmd_summarize(directory: str, generation: str | None) -> int:
+    merged = _load(directory)
+    summary = goodput_lib.from_events(merged, generation=generation)
+    hosts = sorted({r.get("host", "?") for r in merged})
+    start = next((r for r in merged if r.get("type") == "run_start"), None)
+
+    print(f"run: {len(merged)} events, {len(hosts)} host file(s), "
+          f"{summary['attempts']} attempt(s)")
+    if start is not None:
+        print(f"  config={start.get('config')} "
+              f"hash={start.get('config_hash', '')[:12]} "
+              f"jax={start.get('jax_version')} "
+              f"devices={start.get('devices')} mesh={start.get('mesh')}")
+
+    buckets = summary["buckets"]
+    wall = summary["wall_s"] or 1e-9
+    print(f"goodput breakdown (wall {summary['wall_s']:.1f}s, "
+          f"{summary['steps']} steps, final step "
+          f"{summary.get('final_step', 0)}):")
+    for name in goodput_lib.BUCKETS:
+        sec = buckets.get(name, 0.0)
+        print(f"  {name:<11} {sec:9.2f}s  {100.0 * sec / wall:5.1f}%")
+    if summary["attempts"] > 1:
+        print(f"  restart-lost {summary['restart_lost_s']:.2f}s across "
+              f"{summary['attempts']} attempts "
+              f"({summary['retrained_steps']} steps retrained)")
+
+    times = sorted(goodput_lib.step_times_ms(merged))
+    if times:
+        mean = sum(times) / len(times)
+        print(f"step time (ms, {len(times)} post-compile steps): "
+              f"mean={mean:.2f} p50={_percentile(times, 0.5):.2f} "
+              f"p90={_percentile(times, 0.9):.2f} max={times[-1]:.2f}")
+
+    for key in ("mfu_productive", "mfu_goodput"):
+        if summary.get(key) is not None:
+            print(f"{key}: {summary[key]:.4%}")
+    if summary.get("peak_hbm_bytes") is not None:
+        print(f"peak HBM per device: "
+              f"{_fmt_bytes(summary['peak_hbm_bytes'])}")
+
+    end = next((r for r in reversed(merged)
+                if r.get("type") == "run_end"), None)
+    if end and end.get("counters"):
+        print("counters at run_end:")
+        for k, v in sorted(end["counters"].items()):
+            print(f"  {k} = {v}")
+    return 0
+
+
+def cmd_merge(directory: str, out: str | None) -> int:
+    merged = _load(directory)
+    fh = open(out, "w") if out else sys.stdout
+    try:
+        for rec in merged:
+            fh.write(json.dumps(rec) + "\n")
+    finally:
+        if out:
+            fh.close()
+            print(f"[obs] merged {len(merged)} events -> {out}",
+                  file=sys.stderr)
+    return 0
+
+
+def cmd_anomalies(directory: str, args) -> int:
+    merged = _load(directory)
+    findings = goodput_lib.find_anomalies(
+        merged, slow_factor=args.slow_factor, window=args.window,
+        retry_storm=args.retry_storm, mfu_min=args.mfu_min)
+    for f in findings:
+        print(f"ANOMALY [{f['kind']}] {f['detail']}")
+    print(f"[obs] anomalies: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="python -m tpuframe.obs",
+                                description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("summarize", help="goodput/MFU/step-time summary")
+    sp.add_argument("dir", nargs="?", default=None,
+                    help="directory of events.<host>.jsonl files")
+    sp.add_argument("--gen", default=None,
+                    help="TPU generation for MFU recompute (default: the "
+                         "run manifest's, else v5e)")
+    sp.add_argument("--selfcheck", action="store_true",
+                    help="schema-validate event files (shipped samples "
+                         "when no dir given) instead of summarizing")
+
+    mp = sub.add_parser("merge", help="time-ordered multi-host merge")
+    mp.add_argument("dir")
+    mp.add_argument("-o", "--out", default=None)
+
+    ap = sub.add_parser("anomalies", help="flag suspicious run shapes")
+    ap.add_argument("dir")
+    ap.add_argument("--slow-factor", type=float, default=3.0,
+                    help="step regression threshold vs rolling median")
+    ap.add_argument("--window", type=int, default=16,
+                    help="rolling-median window (steps)")
+    ap.add_argument("--retry-storm", type=int, default=5,
+                    help="retries within 60s that count as a storm")
+    ap.add_argument("--mfu-min", type=float, default=None,
+                    help="flag MFU below this fraction (off by default)")
+
+    args = p.parse_args(argv)
+    if args.cmd == "summarize":
+        if args.selfcheck:
+            return cmd_selfcheck(args.dir)
+        if args.dir is None:
+            p.error("summarize needs a directory (or --selfcheck)")
+        return cmd_summarize(args.dir, args.gen)
+    if args.cmd == "merge":
+        return cmd_merge(args.dir, args.out)
+    return cmd_anomalies(args.dir, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,116 @@
+"""HBM telemetry — periodic ``memory_stats()`` sampling into the event log.
+
+TPU runtimes expose per-device allocator stats through
+``jax.local_devices()[i].memory_stats()`` (``bytes_in_use``,
+``peak_bytes_in_use``, ``bytes_limit``...).  A background sampler
+records them as ``devmem`` events so a creeping HBM leak or a
+fragmentation cliff is visible in the run record, and the peak lands
+in the run_end summary next to MFU.
+
+Guarded everywhere: CPU backends and older jax return ``None`` (or
+raise) from ``memory_stats()`` — the sampler then never emits and the
+peak summary is empty, by design (the "no-op on CPU" contract,
+tests/test_observability.py).  jax is imported lazily so this module
+stays importable in the stdlib-only analyzer.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# The stats keys worth recording when present (allocator-dependent).
+_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+         "largest_free_block_bytes")
+
+
+def sample() -> list[dict] | None:
+    """One snapshot: ``[{"id": ..., "bytes_in_use": ...}, ...]`` per
+    local device, or None when the backend has no memory stats
+    (CPU, old jax) — callers emit nothing in that case."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — no backend yet / import race
+        return None
+    out = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — older jax raises instead of None
+            return None
+        if not stats:
+            return None
+        entry = {"id": int(d.id)}
+        for key in _KEYS:
+            if key in stats:
+                entry[key] = int(stats[key])
+        out.append(entry)
+    return out or None
+
+
+class DevmemSampler:
+    """Background thread sampling every ``interval_s`` into ``emit_fn``
+    (normally ``events.emit``), tracking per-device peaks for run_end.
+
+    ``start()`` probes once synchronously: when the backend has no
+    memory stats the thread is never started at all — zero overhead on
+    CPU test runs.
+    """
+
+    def __init__(self, *, interval_s: float = 30.0, emit_fn=None):
+        from tpuframe.obs import events
+
+        self.interval_s = interval_s
+        self.emit_fn = emit_fn or (lambda **kw: events.emit("devmem", **kw))
+        self.active = False
+        self._peaks: dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _record(self, devices: list[dict]) -> None:
+        with self._lock:
+            for dev in devices:
+                seen = dev.get("peak_bytes_in_use", dev.get("bytes_in_use"))
+                if seen is not None:
+                    did = dev["id"]
+                    self._peaks[did] = max(self._peaks.get(did, 0),
+                                           int(seen))
+
+    def start(self) -> "DevmemSampler":
+        first = sample()
+        if first is None:
+            return self  # no stats on this backend: stay inert
+        self.active = True
+        self._record(first)
+        self.emit_fn(devices=first)
+        self._thread = threading.Thread(target=self._watch, daemon=True,
+                                        name="tpuframe-devmem")
+        self._thread.start()
+        return self
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            devices = sample()
+            if devices is None:
+                continue
+            self._record(devices)
+            self.emit_fn(devices=devices)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def peak_summary(self) -> dict:
+        """``{"peak_hbm_bytes": max-over-devices, "per_device": {...}}``
+        — empty dict when nothing was ever sampled (CPU)."""
+        with self._lock:
+            if not self._peaks:
+                return {}
+            return {
+                "peak_hbm_bytes": max(self._peaks.values()),
+                "per_device": {str(k): v
+                               for k, v in sorted(self._peaks.items())},
+            }

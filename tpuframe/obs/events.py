@@ -1,0 +1,309 @@
+"""Structured run-event log — the joinable record of what a run *did*.
+
+The reference's observability surface was the Horovod timeline plus rank-0
+throughput prints (SURVEY.md §5.1/§5.5); PR 1-3 replaced the timeline with
+XLA profiler hooks and grew counters, but steps, restarts, retries, stalls
+and compile events still lived in unjoinable stdout lines.  This module is
+the structured layer underneath all of them: a process-wide, thread-safe
+JSONL writer, one file per host (``events.<host>.jsonl``), every record
+carrying a common envelope so one directory of files reconstructs the full
+lifecycle of a run — including supervised relaunches, which are stitched
+together by the ``attempt`` field the supervisor increments
+(``launch/launcher.py:run_with_relaunch`` → ``TPUFRAME_ATTEMPT``).
+
+Record envelope (every line)::
+
+    {"schema": 1, "type": "<event type>", "t": <unix seconds>,
+     "host": "<hostname>", "proc": <process index>, "attempt": <int>,
+     ...type-specific fields}
+
+Event types (see ``REQUIRED_FIELDS`` for the per-type contract):
+
+  ============== ========================================================
+  run_start      run manifest: config name+hash, mesh/topology, jax
+                 version, tune-DB fingerprint, TPUFRAME_XLA_OPTS,
+                 resume step
+  step           step index, host wall ms, loss, examples processed
+  compile        a compilation observed (first-step wall, or a
+                 persistent-cache hit/miss from utils/compile_cache)
+  ckpt_save      checkpoint written (step, ms, async?)
+  ckpt_restore   checkpoint restored (step, ms)
+  retry          a retry-policy attempt fired (op, outcome)
+  fault_injected a TPUFRAME_FAULTS seam fired (seam, kind, step)
+  stall          heartbeat watchdog fired (last_step, idle_s)
+  preempt        SIGTERM/SIGINT preemption observed (signal[, step])
+  devmem         HBM telemetry sample (per-device memory_stats)
+  run_end        final step, wall s, goodput buckets, MFU, counters,
+                 peak HBM per device
+  ============== ========================================================
+
+Emission is *best-effort everywhere*: ``emit()`` is a no-op until
+``init()`` ran, and never raises after ``close()`` — a broken or absent
+event log must not take down a retry loop mid-recovery or a signal
+handler mid-preemption.
+
+Enable via ``TPUFRAME_EVENTS_DIR=<dir>`` (train.py also takes
+``--events-dir``).  Pure stdlib — no jax import; the writer must work in
+the launcher/supervisor before any backend exists, and the offline
+analyzer (``python -m tpuframe.obs``) must stay light.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+import threading
+import time
+
+SCHEMA_VERSION = 1
+
+ENV_DIR = "TPUFRAME_EVENTS_DIR"
+ENV_ATTEMPT = "TPUFRAME_ATTEMPT"
+
+# Per-type required fields (beyond the envelope); the contract the
+# ``--selfcheck`` schema validation and the analyzer both enforce.
+REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
+    "run_start": ("config", "config_hash", "jax_version"),
+    "step": ("step", "wall_ms"),
+    "compile": (),
+    "ckpt_save": ("step",),
+    "ckpt_restore": ("step",),
+    "retry": ("op",),
+    "fault_injected": ("seam", "kind"),
+    "stall": ("last_step", "idle_s"),
+    "preempt": ("signal",),
+    "devmem": ("devices",),
+    "run_end": ("final_step", "wall_s", "goodput"),
+}
+
+_ENVELOPE = ("schema", "type", "t", "host", "proc", "attempt")
+
+_FILE_RE = re.compile(r"^events\.(?P<host>.+)\.jsonl$")
+
+
+def _hostname() -> str:
+    try:
+        return socket.gethostname().split(".")[0] or "host"
+    except OSError:
+        return "host"
+
+
+def _process_index() -> int:
+    """Rank without forcing a jax import (the fault-registry pattern):
+    the launcher env var is authoritative in the fake cluster; jax is
+    consulted only when already imported."""
+    env = os.environ.get("TPUFRAME_PROCESS_ID")
+    if env:
+        return int(env)
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return jax.process_index()
+        except Exception:  # noqa: BLE001 — backend not initialized yet
+            return 0
+    return 0
+
+
+def attempt_id() -> int:
+    """The supervisor-stitched attempt counter (0 on a first launch)."""
+    try:
+        return int(os.environ.get(ENV_ATTEMPT, "0") or "0")
+    except ValueError:
+        return 0
+
+
+class EventLog:
+    """Thread-safe JSONL event writer, one file per (host, process).
+
+    The filename doubles as the merge key: ``events.<host>.jsonl`` where
+    ``<host>`` is ``<hostname>-p<process index>`` — unique per writer on
+    a shared filesystem, reconstructable by the offline merger.  Opened
+    in append mode so relaunched attempts extend the same file and the
+    analyzer sees one continuous, attempt-tagged stream.
+    """
+
+    def __init__(self, directory: str, *, host: str | None = None,
+                 proc: int | None = None):
+        self.proc = _process_index() if proc is None else proc
+        self.host = host or f"{_hostname()}-p{self.proc}"
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, f"events.{self.host}.jsonl")
+        self._lock = threading.Lock()
+        self._fh = open(self.path, "a", buffering=1)
+        self._closed = False
+
+    def emit(self, etype: str, **fields) -> dict | None:
+        """Append one record; returns it (None when the log is closed).
+        Never raises: observability must not take down the run."""
+        record = {
+            "schema": SCHEMA_VERSION,
+            "type": etype,
+            "t": round(time.time(), 3),
+            "host": self.host,
+            "proc": self.proc,
+            "attempt": attempt_id(),
+            **fields,
+        }
+        try:
+            line = json.dumps(record, default=str)
+        except (TypeError, ValueError):
+            return None
+        with self._lock:
+            if self._closed:
+                return None
+            try:
+                self._fh.write(line + "\n")
+            except (OSError, ValueError):
+                return None
+        return record
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# Module-level singleton — the log every instrumented seam writes through.
+# ---------------------------------------------------------------------------
+
+_log: EventLog | None = None
+_log_lock = threading.Lock()
+
+
+def init(directory: str | None = None) -> EventLog | None:
+    """(Re)open the process-wide event log.  ``directory=None`` consults
+    ``TPUFRAME_EVENTS_DIR``; unset/empty means events stay off and every
+    ``emit()`` is a cheap no-op."""
+    global _log
+    directory = directory or os.environ.get(ENV_DIR, "")
+    if not directory.strip():
+        return None
+    with _log_lock:
+        if _log is not None:
+            _log.close()
+        _log = EventLog(directory)
+        return _log
+
+
+def get() -> EventLog | None:
+    return _log
+
+
+def enabled() -> bool:
+    return _log is not None
+
+
+def emit(etype: str, **fields) -> dict | None:
+    """Write through the singleton; silent no-op when events are off."""
+    log = _log
+    if log is None:
+        return None
+    return log.emit(etype, **fields)
+
+
+def close() -> None:
+    global _log
+    with _log_lock:
+        if _log is not None:
+            _log.close()
+            _log = None
+
+
+# ---------------------------------------------------------------------------
+# Reading / validation — the offline half (CLI, tests, CI selfcheck).
+# ---------------------------------------------------------------------------
+
+def validate_record(rec: dict) -> list[str]:
+    """Problems with one parsed record; empty list means valid."""
+    problems = []
+    if not isinstance(rec, dict):
+        return [f"record is not an object: {rec!r:.80}"]
+    for key in _ENVELOPE:
+        if key not in rec:
+            problems.append(f"missing envelope key {key!r}")
+    if rec.get("schema") != SCHEMA_VERSION:
+        problems.append(f"unknown schema version {rec.get('schema')!r} "
+                        f"(this reader knows {SCHEMA_VERSION})")
+    etype = rec.get("type")
+    if etype in REQUIRED_FIELDS:
+        for key in REQUIRED_FIELDS[etype]:
+            if key not in rec:
+                problems.append(f"{etype} record missing field {key!r}")
+    elif etype is not None and etype not in REQUIRED_FIELDS:
+        problems.append(f"unknown event type {etype!r}")
+    return problems
+
+
+def read_file(path: str, *, strict: bool = False) -> list[dict]:
+    """Parse one events file.  Truncated/garbled trailing lines are
+    expected after a crash (the JSONL contract: each durable line is one
+    event) and are skipped unless ``strict``."""
+    out: list[dict] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                if strict:
+                    raise ValueError(f"{path}:{lineno}: unparseable event "
+                                     f"line {line!r:.80}")
+    return out
+
+
+def event_files(directory: str) -> list[str]:
+    """The ``events.<host>.jsonl`` files under ``directory``, sorted."""
+    try:
+        names = sorted(os.listdir(directory))
+    except (FileNotFoundError, NotADirectoryError):
+        if _FILE_RE.match(os.path.basename(directory)):
+            return [directory]  # a single file passed directly
+        return []
+    return [os.path.join(directory, n) for n in names if _FILE_RE.match(n)]
+
+
+def merge(directory: str) -> list[dict]:
+    """All hosts' events, merged into one stream ordered by timestamp
+    (ties broken by host then original order — a stable multi-host join,
+    the structured replacement for eyeballing N interleaved stdouts)."""
+    streams: list[dict] = []
+    for path in event_files(directory):
+        streams.extend(read_file(path))
+    return sorted(streams,
+                  key=lambda r: (r.get("t", 0.0), str(r.get("host", ""))))
+
+
+def validate_files(paths) -> list[str]:
+    """Schema-validate whole files (the ``--selfcheck`` surface).
+    Strict parsing: a *shipped* sample with a garbled line is a bug even
+    though a crashed run's tail is not."""
+    problems: list[str] = []
+    for path in paths:
+        try:
+            records = read_file(path, strict=True)
+        except (OSError, ValueError) as e:
+            problems.append(f"{path}: {e}")
+            continue
+        if not records:
+            problems.append(f"{path}: no events")
+        for i, rec in enumerate(records, 1):
+            problems += [f"{os.path.basename(path)}:{i}: {p}"
+                         for p in validate_record(rec)]
+    return problems

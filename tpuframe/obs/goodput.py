@@ -1,0 +1,367 @@
+"""Goodput & MFU accounting — "fast as the hardware allows", verified.
+
+The MLPerf TPU-pod scaling work (arXiv:1909.09756) reports MFU/step-time
+accounting as the north-star efficiency metric; ROADMAP's claim is
+unverifiable without it.  This module splits a run's wall clock into
+named buckets and turns step time into an MFU estimate against the
+roofline hardware tables (``tune/roofline.py`` — the same peaks every
+PERF.md roofline and bench.py's MFU column use, so the three can never
+disagree).
+
+Buckets (seconds; they partition attempt wall time):
+
+  init        process start → first step dispatched (harness build,
+              data/restore — includes ckpt_restore time)
+  compile     the first train step's wall time (XLA compile + one step;
+              host-side the two are indistinguishable, and the compile
+              dominates by orders of magnitude)
+  productive  steps 2..N — the only bucket that moves the loss
+  ckpt        blocking checkpoint time (async saves cost only their
+              snapshot slice)
+  eval        eval passes (incl. the eval program's first compile)
+  stall       watchdog-detected dead time (heartbeat ``stall`` events)
+  other       wall − sum(above): logging, GC, supervisor glue
+
+Restart-lost time is a *cross-attempt* fact: the analyzer computes it
+when stitching attempts — (crashed attempt's time past its last
+committed step) + (gap until the relaunch's first event).  A single
+attempt cannot know it died.
+
+Two MFU flavors are reported: ``mfu_productive`` (model flops / peak,
+over productive step time — the kernel-efficiency number) and
+``mfu_goodput`` (over total wall — the fleet-efficiency number; the gap
+between the two is exactly the non-productive buckets).
+
+Pure stdlib + ``tune.roofline`` (itself stdlib); both the live meter in
+train.py and the offline analyzer share these definitions, so the
+run_end summary and ``python -m tpuframe.obs summarize`` can never
+drift apart.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tpuframe.tune import roofline
+
+BUCKETS = ("init", "compile", "productive", "ckpt", "eval", "stall",
+           "other")
+
+DEFAULT_GENERATION = "v5e"
+
+
+class GoodputMeter:
+    """Live bucket accounting for one attempt (train.py's half).
+
+    The loop charges named buckets as it goes; ``summary()`` closes the
+    books — ``other`` absorbs the unattributed remainder so the buckets
+    always sum to wall time exactly (the analyzer asserts this).
+    ``clock`` is injectable for the fake-clock tests.
+    """
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._t0 = clock()
+        self._buckets = {b: 0.0 for b in BUCKETS if b != "other"}
+        self.steps = 0
+        self.first_step_s: float | None = None
+
+    def charge(self, bucket: str, seconds: float) -> None:
+        if bucket not in self._buckets:
+            raise ValueError(f"unknown goodput bucket {bucket!r}; "
+                             f"have {sorted(self._buckets)}")
+        self._buckets[bucket] += max(0.0, seconds)
+
+    def step(self, seconds: float) -> None:
+        """Charge one training step.  The first step is the compile."""
+        if self.first_step_s is None:
+            self.first_step_s = seconds
+            self.charge("compile", seconds)
+        else:
+            self.charge("productive", seconds)
+        self.steps += 1
+
+    def wall_s(self) -> float:
+        return self._clock() - self._t0
+
+    def unaccounted_s(self) -> float:
+        """Wall time not yet charged to any bucket — what ``other`` would
+        absorb right now.  The stall-abort path charges ``min(idle,
+        unaccounted_s())``: the watchdog's idle window can overlap a step
+        that completed without beating (the injected-hang seam sits
+        between the charge and the beat), and the cap keeps the buckets
+        from summing past wall."""
+        return max(0.0, self.wall_s() - sum(self._buckets.values()))
+
+    def summary(self) -> dict:
+        wall = self.wall_s()
+        buckets = dict(self._buckets)
+        buckets["other"] = max(0.0, wall - sum(buckets.values()))
+        return {
+            "wall_s": round(wall, 3),
+            "buckets": {k: round(v, 3) for k, v in buckets.items()},
+            "steps": self.steps,
+            "productive_steps": max(0, self.steps - 1),
+        }
+
+
+def mfu(flops_per_step: float, step_time_s: float, *,
+        generation: str = DEFAULT_GENERATION, n_devices: int = 1) -> float:
+    """Model FLOPs Utilization of one step against the roofline peak.
+
+    ``flops_per_step`` is the whole-program count (XLA ``cost_analysis``
+    convention — the same number ``tune.roofline.score`` consumes), so
+    the peak is the full slice's: per-chip bf16 peak × device count.
+    Carries roofline's §8 caveat: scan-containing programs undercount,
+    making this a LOWER bound on true utilization.
+    """
+    if step_time_s <= 0 or flops_per_step <= 0 or n_devices <= 0:
+        return 0.0
+    hw = roofline.get_hardware(generation)
+    return flops_per_step / (step_time_s * hw.bf16_flops * n_devices)
+
+
+def flops_fallback(n_params: int, examples_per_step: int,
+                   tokens_per_example: int = 1) -> float:
+    """Analytic fwd+bwd flops estimate when the compiled program's
+    cost_analysis is unavailable: the standard 6·N·D dense heuristic
+    (2 flops/param forward + 4 backward, per processed token/example).
+    An estimate — cost_analysis wins whenever it exists."""
+    return 6.0 * float(n_params) * float(examples_per_step) \
+        * float(tokens_per_example)
+
+
+# ---------------------------------------------------------------------------
+# Offline half: the same accounting recomputed from an event stream.
+# ---------------------------------------------------------------------------
+
+def _attempts(events: list[dict]) -> list[list[dict]]:
+    """Split a merged stream into per-attempt sub-streams (ascending)."""
+    by_attempt: dict[int, list[dict]] = {}
+    for rec in events:
+        by_attempt.setdefault(int(rec.get("attempt", 0)), []).append(rec)
+    return [by_attempt[a] for a in sorted(by_attempt)]
+
+
+def step_times_ms(events: list[dict], *,
+                  include_first: bool = False) -> list[float]:
+    """Per-step host wall ms from ``step`` events (first step — the
+    compile — excluded unless asked; it would dominate any statistic)."""
+    steps = [r for r in events if r.get("type") == "step"]
+    if not include_first and steps:
+        steps = steps[1:]
+    return [float(r["wall_ms"]) for r in steps if "wall_ms" in r]
+
+
+def from_events(events: list[dict], *,
+                generation: str | None = None) -> dict:
+    """Recompute the goodput breakdown from a (merged) event stream.
+
+    Prefers the writer's own ``run_end`` summary when one exists (the
+    live meter saw every boundary); otherwise reconstructs the buckets
+    from ``step``/``ckpt_*``/``stall`` events — the crashed-attempt
+    path, where no run_end was ever written.  Cross-attempt restart-lost
+    time is computed here either way: for each non-final attempt,
+    (that attempt's time past its last event) is unknowable, so the
+    charge is the *gap* between its last event and the next attempt's
+    first, plus any steps the relaunch retrained (visible as step
+    indices replayed below the prior attempt's high-water mark).
+    """
+    out: dict = {"attempts": 0, "restart_lost_s": 0.0,
+                 "retrained_steps": 0}
+    attempts = _attempts(events)
+    out["attempts"] = len(attempts)
+    if not attempts:
+        out["buckets"] = {b: 0.0 for b in BUCKETS}
+        out["wall_s"] = 0.0
+        out["steps"] = 0
+        return out
+
+    # Cross-attempt stitching.
+    for prev, nxt in zip(attempts, attempts[1:]):
+        prev_ts = [r["t"] for r in prev if "t" in r]
+        nxt_ts = [r["t"] for r in nxt if "t" in r]
+        if prev_ts and nxt_ts:
+            out["restart_lost_s"] += max(0.0, min(nxt_ts) - max(prev_ts))
+        prev_hi = max((int(r["step"]) for r in prev
+                       if r.get("type") == "step"), default=0)
+        replayed = [int(r["step"]) for r in nxt
+                    if r.get("type") == "step" and int(r["step"]) <= prev_hi]
+        out["retrained_steps"] += len(replayed)
+
+    # Per-attempt buckets, summed.
+    buckets = {b: 0.0 for b in BUCKETS}
+    wall = 0.0
+    final_step = 0
+    n_steps = 0
+    mfu_productive = None
+    mfu_goodput = None
+    peak_hbm = None
+    for stream in attempts:
+        end = next((r for r in stream if r.get("type") == "run_end"), None)
+        if end is not None:
+            g = end.get("goodput", {})
+            for k, v in g.get("buckets", {}).items():
+                if k in buckets:
+                    buckets[k] += float(v)
+            wall += float(g.get("wall_s", end.get("wall_s", 0.0)))
+            final_step = max(final_step, int(end.get("final_step", 0)))
+            n_steps += int(g.get("steps") or
+                           sum(1 for r in stream if r.get("type") == "step"))
+            if end.get("mfu_productive") is not None:
+                mfu_productive = float(end["mfu_productive"])
+            if end.get("mfu_goodput") is not None:
+                mfu_goodput = float(end["mfu_goodput"])
+            if end.get("peak_hbm_bytes") is not None:
+                peak_hbm = max(peak_hbm or 0,
+                               int(end["peak_hbm_bytes"]))
+            continue
+        # Crashed attempt: rebuild from raw events.  Buckets are
+        # accumulated attempt-locally so a later crashed attempt can't
+        # clobber an earlier attempt's recorded ``other``.
+        ts = [r["t"] for r in stream if "t" in r]
+        span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+        wall += span
+        local = {b: 0.0 for b in BUCKETS if b != "other"}
+        steps = [r for r in stream if r.get("type") == "step"]
+        n_steps += len(steps)
+        if steps:
+            final_step = max(final_step,
+                             max(int(r["step"]) for r in steps))
+            local["compile"] += float(steps[0].get("wall_ms", 0.0)) / 1e3
+            local["productive"] += sum(
+                float(r.get("wall_ms", 0.0)) for r in steps[1:]) / 1e3
+        for r in stream:
+            if r.get("type") == "ckpt_save":
+                local["ckpt"] += float(r.get("ms", 0.0)) / 1e3
+            elif r.get("type") == "stall":
+                local["stall"] += float(r.get("idle_s", 0.0))
+        for k, v in local.items():
+            buckets[k] += v
+        buckets["other"] += max(0.0, span - sum(local.values()))
+        for r in stream:
+            if r.get("type") == "devmem":
+                for dev in r.get("devices", []):
+                    b = dev.get("peak_bytes_in_use",
+                                dev.get("bytes_in_use"))
+                    if b is not None:
+                        peak_hbm = max(peak_hbm or 0, int(b))
+
+    out["buckets"] = {k: round(v, 3) for k, v in buckets.items()}
+    out["wall_s"] = round(wall, 3)
+    out["steps"] = n_steps
+    out["final_step"] = final_step
+    if mfu_productive is not None:
+        out["mfu_productive"] = mfu_productive
+    if mfu_goodput is not None:
+        out["mfu_goodput"] = mfu_goodput
+    if peak_hbm is not None:
+        out["peak_hbm_bytes"] = peak_hbm
+
+    # Recompute MFU offline when the manifest recorded a flops model
+    # (run_start carries it) — lets ``summarize`` work on crashed logs.
+    if mfu_productive is None:
+        start = next((r for r in events if r.get("type") == "run_start"),
+                     None)
+        times = step_times_ms(events)
+        if start and times and start.get("flops_per_step"):
+            gen = (generation or start.get("generation")
+                   or DEFAULT_GENERATION)
+            mean_s = sum(times) / len(times) / 1e3
+            out["mfu_productive"] = mfu(
+                float(start["flops_per_step"]), mean_s, generation=gen,
+                n_devices=int(start.get("devices", 1)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Anomaly detection — the "what went wrong" half of the analyzer.
+# ---------------------------------------------------------------------------
+
+def find_anomalies(events: list[dict], *, slow_factor: float = 3.0,
+                   window: int = 16, retry_storm: int = 5,
+                   retry_window_s: float = 60.0,
+                   mfu_min: float | None = None) -> list[dict]:
+    """Flag suspicious shapes in a merged event stream.
+
+    Detectors (each finding: ``{"kind", "detail", ...anchors}``):
+
+      step_regression — a step's wall ms exceeds ``slow_factor`` × the
+        rolling median of the previous ``window`` steps (first/compile
+        step excluded).  The rolling median, not the global one: a run
+        that *gradually* slows (fragmenting HBM, growing host GC) trips
+        the detector where a global median would absorb it.
+      stall            — every heartbeat ``stall`` event.
+      retry_storm      — ≥ ``retry_storm`` retry events inside any
+        ``retry_window_s`` window: a flaky backend being hammered.
+      low_mfu          — reported MFU below ``mfu_min`` (off by default;
+        thresholds are workload policy, not a universal constant).
+      no_run_end       — an attempt that never wrote ``run_end``: the
+        run died (crash, preemption without commit, or still live).
+    """
+    findings: list[dict] = []
+
+    steps = [r for r in events if r.get("type") == "step"
+             and "wall_ms" in r]
+    recent: list[float] = []
+    for r in steps[1:]:
+        ms = float(r["wall_ms"])
+        if len(recent) >= 3:
+            med = sorted(recent)[len(recent) // 2]
+            if med > 0 and ms > slow_factor * med:
+                findings.append({
+                    "kind": "step_regression", "step": int(r["step"]),
+                    "wall_ms": round(ms, 2),
+                    "rolling_median_ms": round(med, 2),
+                    "detail": f"step {r['step']} took {ms:.1f} ms — "
+                              f"{ms / med:.1f}x the rolling median "
+                              f"({med:.1f} ms)"})
+        recent.append(ms)
+        if len(recent) > window:
+            recent.pop(0)
+
+    for r in events:
+        if r.get("type") == "stall":
+            findings.append({
+                "kind": "stall", "last_step": r.get("last_step"),
+                "idle_s": r.get("idle_s"),
+                "detail": f"heartbeat stall: no step for "
+                          f"{r.get('idle_s', '?')}s after step "
+                          f"{r.get('last_step', '?')}"})
+
+    retries = sorted(float(r["t"]) for r in events
+                     if r.get("type") == "retry" and "t" in r)
+    lo = 0
+    reported_storm = False
+    for hi in range(len(retries)):
+        while retries[hi] - retries[lo] > retry_window_s:
+            lo += 1
+        if hi - lo + 1 >= retry_storm and not reported_storm:
+            findings.append({
+                "kind": "retry_storm", "count": hi - lo + 1,
+                "window_s": retry_window_s,
+                "detail": f"{hi - lo + 1} I/O retries within "
+                          f"{retry_window_s:.0f}s — storage backend "
+                          f"degraded"})
+            reported_storm = True  # one report per stream, not per pair
+
+    if mfu_min is not None:
+        summary = from_events(events)
+        got = summary.get("mfu_productive")
+        if got is not None and got < mfu_min:
+            findings.append({
+                "kind": "low_mfu", "mfu": round(got, 4),
+                "threshold": mfu_min,
+                "detail": f"MFU {got:.2%} below threshold "
+                          f"{mfu_min:.2%}"})
+
+    for stream in _attempts(events):
+        if not any(r.get("type") == "run_end" for r in stream):
+            att = stream[0].get("attempt", 0) if stream else 0
+            last = max((int(r["step"]) for r in stream
+                        if r.get("type") == "step"), default=None)
+            findings.append({
+                "kind": "no_run_end", "attempt": att, "last_step": last,
+                "detail": f"attempt {att} never wrote run_end (died or "
+                          f"still running); last seen step: {last}"})
+    return findings

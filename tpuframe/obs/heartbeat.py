@@ -16,6 +16,8 @@ import threading
 import time
 from typing import Callable
 
+from tpuframe.obs import events
+
 logger = logging.getLogger(__name__)
 
 
@@ -34,11 +36,14 @@ class Heartbeat:
         self._last_beat = time.monotonic()
         self._step = 0
         self._stalled = False
+        self.stall_count = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def beat(self, step: int) -> None:
-        """Call once per completed training step."""
+        """Call once per completed training step.  A beat after a stall
+        re-arms the watchdog: a recovered run that stalls again reports a
+        *second* stall instead of staying latched on the first one."""
         self._step = step
         self._beats += 1
         self._last_beat = time.monotonic()
@@ -66,10 +71,14 @@ class Heartbeat:
             idle = time.monotonic() - self._last_beat
             if idle > self.timeout_s and not self._stalled:
                 self._stalled = True
+                self.stall_count += 1
                 logger.warning(
                     "no training step completed in %.0fs (last step %d) — "
                     "input pipeline stall, hung I/O, or peer failure",
                     idle, self._step)
+                events.emit("stall", last_step=self._step,
+                            idle_s=round(idle, 3),
+                            stall_count=self.stall_count)
                 if self.on_stall:
                     try:
                         self.on_stall(idle)

@@ -28,9 +28,17 @@ _counters_lock = threading.Lock()
 
 
 def bump(name: str, n: int = 1) -> None:
-    """Increment the process-wide counter ``name`` by ``n``."""
-    with _counters_lock:
-        _counters[name] = _counters.get(name, 0) + n
+    """Increment the process-wide counter ``name`` by ``n``.
+
+    Callers are retry loops and cache listeners mid-recovery: this must
+    be safe at any point in the process lifecycle — before any logger
+    exists, after ``MetricLogger.close()``, during interpreter teardown —
+    and never raise back into the instrumented seam."""
+    try:
+        with _counters_lock:
+            _counters[name] = _counters.get(name, 0) + int(n)
+    except Exception:  # noqa: BLE001 — teardown / bad n; drop the bump
+        pass
 
 
 def counters(prefix: str | None = None) -> dict[str, int]:
@@ -47,6 +55,12 @@ def reset_counters(prefix: str | None = None) -> None:
         else:
             for k in [k for k in _counters if k.startswith(prefix)]:
                 del _counters[k]
+
+
+def counters_reset(prefix: str | None = None) -> None:
+    """Test-friendly alias for :func:`reset_counters` (the obs v2 API
+    name); both clear the process-wide counter table."""
+    reset_counters(prefix)
 
 
 class RateMeter:

@@ -129,6 +129,21 @@ def _process_index() -> int:
     return 0
 
 
+def _emit_fault(f: Fault, step: int) -> None:
+    """Best-effort ``fault_injected`` event — emitted *before* the fault
+    acts, so even a ``crash``/``hang`` leaves its record in the log.
+    Only when the event layer is already imported (no-jax guarantee)."""
+    try:
+        import sys
+
+        events = sys.modules.get("tpuframe.obs.events")
+        if events is not None:
+            events.emit("fault_injected", seam=f.seam, kind=f.kind,
+                        step=step)
+    except Exception:  # noqa: BLE001 — injection must act even if
+        pass  # observability is broken; the test asserts the fault, not the log
+
+
 class FaultRegistry:
     def __init__(self, faults: list[Fault] | None = None):
         self.faults = list(faults or [])
@@ -158,6 +173,7 @@ class FaultRegistry:
                               "sigint", "hang"))
         if f is None:
             return
+        _emit_fault(f, self.step)
         if f.kind == "ioerror":
             raise InjectedFault(f"injected ioerror at seam {seam} "
                                 f"(step {self.step})")
@@ -188,6 +204,7 @@ class FaultRegistry:
         f = self._take(seam, ("corrupt", "torn"))
         if f is None:
             return data
+        _emit_fault(f, self.step)
         print(f"[tpuframe] FAULT INJECTION: {f.kind} bytes at seam {seam} "
               f"(step {self.step})", flush=True)
         if f.kind == "torn":

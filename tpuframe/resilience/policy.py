@@ -81,6 +81,22 @@ def _bump(name: str) -> None:
         pass
 
 
+def _emit_retry(op: str, outcome: str, attempt: int) -> None:
+    """Best-effort structured ``retry`` event — same contract as
+    ``_bump``: never raises back into the retry loop.  Uses the event
+    module only when something else (train.py) already imported it, so
+    this module keeps its no-jax import guarantee (the obs package pulls
+    jax in)."""
+    try:
+        events = sys.modules.get("tpuframe.obs.events")
+        if events is not None:
+            # attempt_n, not attempt: the envelope's ``attempt`` is the
+            # supervisor relaunch counter, and emit's **fields override it.
+            events.emit("retry", op=op, outcome=outcome, attempt_n=attempt)
+    except Exception:  # noqa: BLE001 — observability is strictly optional here
+        pass
+
+
 @dataclass
 class RetryPolicy:
     """Bounded retry with decorrelated jitter.
@@ -116,12 +132,14 @@ class RetryPolicy:
                 out = fn(*args, **kwargs)
                 if attempt > 1:
                     _bump(f"retry.{op}.recovered")
+                    _emit_retry(op, "recovered", attempt)
                 return out
             except Exception as e:  # noqa: BLE001 — classified right below
                 if not self.retryable(e):
                     raise
                 if attempt >= self.max_attempts:
                     _bump(f"retry.{op}.exhausted")
+                    _emit_retry(op, "exhausted", attempt)
                     raise
                 # Decorrelated jitter: uniform over [base, prev*3], capped.
                 delay = min(self.max_delay_s,
@@ -132,9 +150,11 @@ class RetryPolicy:
                     remaining = self.deadline_s - (self.clock() - start)
                     if remaining <= 0.0:
                         _bump(f"retry.{op}.exhausted")
+                        _emit_retry(op, "exhausted", attempt)
                         raise
                     delay = min(delay, remaining)
                 _bump(f"retry.{op}.retries")
+                _emit_retry(op, "retrying", attempt)
                 print(f"[resilience] {op} failed "
                       f"(attempt {attempt}/{self.max_attempts}): "
                       f"{type(e).__name__}: {e} — retrying in {delay:.2f}s",

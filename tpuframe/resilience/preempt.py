@@ -55,6 +55,16 @@ class PreemptionGuard:
             raise KeyboardInterrupt
         self._requested = True
         self.signal_name = signal.Signals(signum).name
+        try:
+            # Structured record of the preemption moment (obs/events.py),
+            # only when the event layer is already loaded — this module
+            # keeps its no-jax guarantee, and a signal handler must never
+            # raise.
+            events = sys.modules.get("tpuframe.obs.events")
+            if events is not None:
+                events.emit("preempt", signal=self.signal_name)
+        except Exception:  # noqa: BLE001 — observability is optional here
+            pass
         print(f"[tpuframe] received {self.signal_name} — will checkpoint "
               f"at the next step boundary and exit rc {RC_PREEMPTED} "
               f"(supervisor resumes)", file=sys.stderr, flush=True)

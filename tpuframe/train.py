@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import argparse
 import ast
+import hashlib
+import itertools
 import os
 import sys
 import time
@@ -35,6 +37,9 @@ from tpuframe.data import ShardedLoader, datasets
 from tpuframe.models import losses
 from tpuframe.obs import (Heartbeat, MetricLogger, RateMeter, StepTimeline,
                           profile_trace)
+from tpuframe.obs import devmem as devmem_lib
+from tpuframe.obs import events as events_lib
+from tpuframe.obs import goodput as goodput_lib
 from tpuframe.obs import metrics as obs_metrics
 from tpuframe.parallel import bootstrap
 from tpuframe.resilience import faults as faults_lib
@@ -561,6 +566,37 @@ def _finalize_eval(avg: dict) -> dict:
     return {k: v for k, v in avg.items() if not k.startswith("_m_")}
 
 
+def _tune_db_fingerprint() -> str | None:
+    """sha256 prefix of the tuning-DB file feeding this run's XLA opts
+    (None when no DB exists) — the run_start manifest field that ties a
+    run record to the exact tuned-flag state it trained under."""
+    try:
+        from tpuframe.tune import db as tune_db
+
+        with open(tune_db.default_db_path(), "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()[:16]
+    except Exception:  # noqa: BLE001 — no DB / unreadable: not a run error
+        return None
+
+
+def _step_flops(train_step, state, batch):
+    """Whole-program flops of one train step from the *lowered* module's
+    cost analysis — tracing only, no compile (Lowered.cost_analysis works
+    pre-compile on this jax).  Returns (flops, "cost_analysis") or
+    (None, None) when the path is unavailable (pp factory steps, older
+    jax) — callers fall back to the analytic 6·N·D estimate."""
+    try:
+        ca = train_step.lower(state, batch).cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0) or 0.0)
+        if flops > 0:
+            return flops, "cost_analysis"
+    except Exception:  # noqa: BLE001 — cost model optional by design
+        pass
+    return None, None
+
+
 def train(cfg: TrainConfig, *, trace_dir: str | None = None,
           log_file: str | None = None) -> dict:
     """Run the workload; returns final metrics (the driver/test surface)."""
@@ -568,6 +604,12 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
     # harness so a SIGTERM during compile/restore is already caught; the
     # loop below checkpoints at the next step boundary and exits rc 14.
     guard = PreemptionGuard().install()
+    # Structured run-event log (obs/events.py): env-gated — opened before
+    # build_harness so restore-time ckpt_restore events land in the file.
+    # The goodput meter starts here too: everything before the first step
+    # (harness build, data, restore, compile-cache setup) is "init".
+    events_lib.init()
+    meter = goodput_lib.GoodputMeter()
     # Persistent compilation cache (utils/compile_cache): a relaunch or
     # crash-loop restart of the same program compiles from the on-disk
     # cache instead of from scratch — hit/miss counters land in the final
@@ -602,7 +644,40 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
     # restart the job and it auto-resumes from the last committed checkpoint.
     # The watchdog arms after the first completed step (compile is unbounded).
     stall_timeout = float(os.environ.get("TPUFRAME_STALL_TIMEOUT_S", "300"))
+    stall_poll = float(os.environ.get("TPUFRAME_STALL_POLL_S", "5"))
     stall_abort = os.environ.get("TPUFRAME_STALL_ABORT", "1") == "1"
+
+    # Mutable run facts the event-emitting closures need (filled in once
+    # the harness/flops model is known; read from the watchdog thread).
+    run_info: dict = {"flops": None, "flops_source": None,
+                      "generation": goodput_lib.DEFAULT_GENERATION,
+                      "devmem": None, "step": h.start_step}
+
+    def _emit_run_end(final_step: int) -> None:
+        """Close the books: goodput buckets, both MFU flavors, peak HBM
+        and the full counter table, in one run_end record."""
+        if not events_lib.enabled():
+            return
+        summary = meter.summary()
+        extra: dict = {}
+        flops = run_info["flops"]
+        prod_steps = summary["productive_steps"]
+        prod_s = summary["buckets"]["productive"]
+        if flops and prod_steps and prod_s > 0:
+            extra["mfu_productive"] = round(goodput_lib.mfu(
+                flops, prod_s / prod_steps,
+                generation=run_info["generation"],
+                n_devices=jax.device_count()), 6)
+            if summary["wall_s"] > 0:
+                extra["mfu_goodput"] = round(goodput_lib.mfu(
+                    flops * prod_steps, summary["wall_s"],
+                    generation=run_info["generation"],
+                    n_devices=jax.device_count()), 6)
+        if run_info["devmem"] is not None:
+            extra.update(run_info["devmem"].peak_summary())
+        events_lib.emit("run_end", final_step=final_step,
+                        wall_s=summary["wall_s"], goodput=summary,
+                        counters=obs_metrics.counters(), **extra)
 
     def _on_stall(idle: float) -> None:
         if not stall_abort:
@@ -613,6 +688,15 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
               f"aborting for clean restart + checkpoint resume (exit 13)",
               file=sys.stderr, flush=True)
         try:
+            # The heartbeat already emitted the structured stall event;
+            # here the dying attempt commits its own books so summarize
+            # works from the recorded run_end instead of reconstructing.
+            # Capped at the unattributed remainder: the idle window can
+            # overlap a step that completed without beating, and the
+            # buckets must never sum past wall.
+            meter.charge("stall", min(idle, meter.unaccounted_s()))
+            _emit_run_end(run_info["step"])
+            events_lib.close()
             logger.close()
             if timeline is not None:
                 timeline.instant("stall_abort", idle_s=idle)
@@ -620,7 +704,8 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
         finally:
             os._exit(13)
 
-    heartbeat = Heartbeat(timeout_s=stall_timeout, on_stall=_on_stall,
+    heartbeat = Heartbeat(timeout_s=stall_timeout, poll_s=stall_poll,
+                          on_stall=_on_stall,
                           arm_after_first_beat=True).start()
     examples_per_step = cfg.global_batch
 
@@ -653,8 +738,6 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
         # Debug mode (SURVEY.md §5.2): every host verifies it built the same
         # config AND the same lowered step program before any collective runs
         # — the host-dependent-trace divergence class.
-        import itertools
-
         from tpuframe.obs import spmd_check
 
         spmd_check.assert_uniform_across_hosts("config", repr(cfg))
@@ -663,6 +746,44 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             spmd_check.check_step_program(h.train_step, "train_step",
                                           state, first)
             data_iter = itertools.chain([first], data_iter)
+
+    if events_lib.enabled():
+        # Run manifest + flops model.  The flops count comes from tracing
+        # the step once (no compile); the analytic 6·N·D estimate is the
+        # fallback — either way run_start records a nonzero flops_per_step
+        # so MFU is recomputable offline even from a crashed log.
+        from tpuframe.tune import db as tune_db
+
+        n_params = sum(int(np.prod(p.shape))
+                       for p in jax.tree.leaves(h.state.params))
+        run_info["generation"] = (tune_db.target_generation()
+                                  or goodput_lib.DEFAULT_GENERATION)
+        if step < cfg.total_steps:
+            first = next(data_iter)
+            flops, src = _step_flops(h.train_step, state, first)
+            data_iter = itertools.chain([first], data_iter)
+        else:
+            flops, src = None, None
+        if not flops:
+            flops = goodput_lib.flops_fallback(n_params, examples_per_step)
+            src = "analytic_6nd"
+        run_info["flops"], run_info["flops_source"] = flops, src
+        events_lib.emit(
+            "run_start", config=cfg.name,
+            config_hash=hashlib.sha256(repr(cfg).encode()).hexdigest()[:16],
+            jax_version=jax.__version__,
+            devices=jax.device_count(), processes=jax.process_count(),
+            mesh=dict(h.mesh.shape) if h.mesh is not None else None,
+            tune_db=_tune_db_fingerprint(),
+            xla_opts=os.environ.get("TPUFRAME_XLA_OPTS") or None,
+            start_step=h.start_step, total_steps=cfg.total_steps,
+            global_batch=cfg.global_batch, n_params=n_params,
+            generation=run_info["generation"],
+            flops_per_step=flops, flops_source=src)
+        run_info["devmem"] = devmem_lib.DevmemSampler(
+            interval_s=float(os.environ.get("TPUFRAME_DEVMEM_INTERVAL_S",
+                                            "30"))).start()
+        meter.charge("init", meter.wall_s())
     t_trace = None
     while step < cfg.total_steps:
         if trace_dir is not None and step == h.start_step + 5:
@@ -672,6 +793,7 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             t_trace.__exit__(None, None, None)
             t_trace = None
 
+        t_step0 = time.perf_counter()
         if timeline is not None:
             with timeline.phase("data_wait", step=step):
                 batch = next(data_iter)
@@ -681,6 +803,29 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             batch = next(data_iter)
             state, metrics = h.train_step(state, batch)
         step += 1
+        step_s = time.perf_counter() - t_step0
+        first_step = meter.first_step_s is None
+        meter.step(step_s)
+        run_info["step"] = step
+        is_log_step = step % cfg.log_every == 0 or step == cfg.total_steps
+        fetched = None
+        if events_lib.enabled():
+            # Step event BEFORE the fault seam fires: a crash fault must
+            # not erase the record of the step that preceded it.  Loss
+            # rides along only on log steps — those device_get anyway, so
+            # the event costs no extra host↔device sync.
+            extra: dict = {}
+            if is_log_step:
+                fetched = jax.device_get(metrics)
+                if "loss" in fetched:
+                    extra["loss"] = float(fetched["loss"])
+            events_lib.emit("step", step=step,
+                            wall_ms=round(step_s * 1e3, 3),
+                            examples=examples_per_step, **extra)
+            if first_step:
+                events_lib.emit("compile", step=step,
+                                wall_ms=round(step_s * 1e3, 3),
+                                source="first_step")
         faults_lib.set_step(step)
         faults_lib.fire("host")  # crash/signal faults, once per step
         if hang_step and step == hang_step:
@@ -690,8 +835,9 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
         rate.update(examples_per_step)
         heartbeat.beat(step)
 
-        if step % cfg.log_every == 0 or step == cfg.total_steps:
-            metrics = jax.device_get(metrics)
+        if is_log_step:
+            metrics = fetched if fetched is not None \
+                else jax.device_get(metrics)
             final_train_metrics = {k: float(v) for k, v in metrics.items()}
             r = rate.rate()
             if r is not None:
@@ -706,12 +852,14 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
 
         if step % cfg.eval_every == 0 or step == cfg.total_steps:
             h.state = state
+            t_eval0 = time.perf_counter()
             with rate.paused():  # eval time isn't training throughput
                 if timeline is not None:
                     with timeline.phase("eval", step=step):
                         eval_metrics = evaluate(h, cfg.eval_batches)
                 else:
                     eval_metrics = evaluate(h, cfg.eval_batches)
+            meter.charge("eval", time.perf_counter() - t_eval0)
             logger.log(step, eval_metrics, prefix="eval")
             final_train_metrics.update(
                 {f"eval_{k}": v for k, v in eval_metrics.items()})
@@ -726,26 +874,36 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             heartbeat.beat(step)  # eval (incl. its first compile) is progress
 
         if h.manager is not None:
+            will_save = h.manager.should_save(step)
+            t_ckpt0 = time.perf_counter()
             with rate.paused():
-                if timeline is not None and h.manager.should_save(step):
+                if timeline is not None and will_save:
                     with timeline.phase("checkpoint", step=step):
                         h.manager.maybe_save(step, state)
                 else:
                     h.manager.maybe_save(step, state)
                 heartbeat.beat(step)  # a long blocking save is progress too
+            if will_save:
+                meter.charge("ckpt", time.perf_counter() - t_ckpt0)
 
         if guard.requested:
             # Preemption contract: commit a final checkpoint at this step
             # boundary and exit rc 14 so the supervisor resumes (no crash
             # charged, no backoff) instead of losing up to ckpt_every steps.
             if h.manager is not None:
+                t_ckpt0 = time.perf_counter()
                 if not h.manager.should_save(step):  # else just saved above
                     h.manager.save(step, state)
                 h.manager.wait_pending()
+                meter.charge("ckpt", time.perf_counter() - t_ckpt0)
             heartbeat.stop()
             if timeline is not None:
                 timeline.instant("preempted", step=step)
                 timeline.close()
+            if run_info["devmem"] is not None:
+                run_info["devmem"].stop()
+            _emit_run_end(step)
+            events_lib.close()
             logger.close()
             guard.uninstall()
             if bootstrap.is_primary():
@@ -756,10 +914,12 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
 
     if t_trace is not None:
         t_trace.__exit__(None, None, None)
+    t_ckpt0 = time.perf_counter()
     if h.manager is not None and step % cfg.ckpt_every != 0:
         h.manager.save(step, state)  # final state always durable
     if h.manager is not None:
         h.manager.wait_pending()  # async saves must commit before exit
+        meter.charge("ckpt", time.perf_counter() - t_ckpt0)
     heartbeat.stop()
     if timeline is not None:
         timeline.close()
@@ -767,6 +927,10 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             print(f"[tpuframe] step timeline written to {timeline.path}",
                   flush=True)
     logger.close()
+    if run_info["devmem"] is not None:
+        run_info["devmem"].stop()
+    _emit_run_end(step)
+    events_lib.close()
     guard.uninstall()
     final_train_metrics["step"] = step
     final_train_metrics.update(obs_metrics.counters("retry."))
@@ -798,7 +962,14 @@ def main(argv: list[str] | None = None) -> dict:
     p.add_argument("--log-file", default=None)
     p.add_argument("--trace-dir", default=None,
                    help="capture an XLA profiler trace of a few steps")
+    p.add_argument("--events-dir", default=None,
+                   help="write structured run events "
+                        "(events.<host>.jsonl; same as TPUFRAME_EVENTS_DIR)")
     args = p.parse_args(argv)
+    if args.events_dir:
+        # Via the env so every layer (ckpt, resilience, compile_cache,
+        # supervisor-relaunched children) sees the same switch.
+        os.environ[events_lib.ENV_DIR] = args.events_dir
 
     cfg = get_config(args.config)
     overrides = _parse_set(args.set)

@@ -97,11 +97,16 @@ def _install_listener() -> None:
 
     from tpuframe.obs import metrics
 
+    from tpuframe.obs import events as obs_events
+
     def _on_event(event: str, **kwargs) -> None:
         if event == _HIT_EVENT:
             metrics.bump("compile_cache.hits")
+            obs_events.emit("compile", cached=True, source="persistent_cache")
         elif event == _MISS_EVENT:
             metrics.bump("compile_cache.misses")
+            obs_events.emit("compile", cached=False,
+                            source="persistent_cache")
 
     jax.monitoring.register_event_listener(_on_event)
     _LISTENER_INSTALLED = True
