@@ -82,6 +82,8 @@ def _emit(value: float, n_chips: int, **extra) -> None:
         line["chip"] = gen
     if n_chips:
         line["n_chips"] = n_chips
+    if _RESULT.get("remat_policy"):
+        line["policy"] = _RESULT["remat_policy"]
     line.update(extra)
     print(json.dumps(line), flush=True)
 
@@ -220,15 +222,24 @@ def run(batch_per_chip: int, warmup: int, measure: int) -> float:
 
     # TPUFRAME_BENCH_STEM=space_to_depth A/Bs the MXU-friendly stem
     # reformulation (models/resnet.py; exact-function-preserving).
-    # TPUFRAME_BENCH_REMAT=1 A/Bs per-block rematerialization (trades idle
-    # MXU flops for HBM bytes on the bandwidth-bound step).
+    # TPUFRAME_REMAT_POLICY=<tpuframe.mem name> A/Bs rematerialization
+    # policies (trades recompute flops for HBM bytes on the bandwidth-
+    # bound step); unset, the tuning DB's offline remat-sweep winner
+    # applies.  The legacy TPUFRAME_BENCH_REMAT=1 still maps to
+    # per_block (deprecated alias, mem.policy_from_env).
     # TPUFRAME_BENCH_BN=folded A/Bs the census-driven BN whose
     # activation-sized math stays bf16 (models/folded_bn.py; PERF.md §7).
+    from tpuframe import mem
+
     stem = os.environ.get("TPUFRAME_BENCH_STEM", "conv")
-    remat = os.environ.get("TPUFRAME_BENCH_REMAT", "0") == "1"
     bn = os.environ.get("TPUFRAME_BENCH_BN", "flax")
+    remat_policy, remat_source = mem.resolve(
+        program=f"train_resnet50_b{global_batch}", family="remat_resnet50")
+    if remat_policy != "none":
+        _log(f"remat policy: {remat_policy} (source: {remat_source})")
+    _RESULT["remat_policy"] = remat_policy
     model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem,
-                            remat=remat, bn=bn)
+                            bn=bn)
     rng = np.random.default_rng(0)
     # bf16 on the host: halves infeed bytes and skips the on-device cast.
     x = rng.normal(0.5, 0.25, size=(global_batch, IMAGE_SIZE, IMAGE_SIZE, 3)
@@ -270,8 +281,9 @@ def run(batch_per_chip: int, warmup: int, measure: int) -> float:
             _log(f"compiler_options from tuning DB: {xla_opts}")
     else:
         _log(f"compiler_options: {xla_opts}")
-    train_step = step_lib.make_train_step(loss_fn, tx, mesh, donate=True,
-                                          compiler_options=xla_opts)
+    train_step = step_lib.make_train_step(
+        loss_fn, tx, mesh, donate=True, compiler_options=xla_opts,
+        remat_policy=None if remat_policy == "none" else remat_policy)
 
     if mesh is not None:
         state = step_lib.replicate_state(state, mesh)

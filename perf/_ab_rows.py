@@ -7,13 +7,18 @@ regenerated rows are APPENDED to the jsonl with the same tag.  The rule,
 shared by ``summarize_results.py`` and ``exp_offline_ab.py show`` and
 pinned by tests/test_offline_ab_parser.py:
 
-  - the program key is the row's ``tag``; the LATEST line per tag wins
-    (a regeneration supersedes every earlier row with its tag, including
-    earlier ``compile_error`` rows — and a later compile_error likewise
+  - the program key is the row's ``(tag, policy)`` pair — ``policy`` is
+    the optional remat-policy column the tpuframe.mem A/Bs write; rows
+    without one key as ``(tag, None)``, so the pre-remat corpus parses
+    exactly as before.  The LATEST line per key wins (a regeneration
+    supersedes every earlier row with its key, including earlier
+    ``compile_error`` rows — and a later compile_error likewise
     supersedes an earlier success: the latest compiler verdict is the
     verdict);
   - suffixed tags (``_r5``, ``_v4_221``, ...) are DISTINCT keys — a v4
-    regeneration never hides the v5e row.
+    regeneration never hides the v5e row — and so are different remat
+    policies under one tag: the ``none`` baseline row survives next to
+    every searched-policy row.
 
 Deliberately side-effect-free (no jax, no env scrub, no AOT lock):
 tests and the summarizer import this without touching
@@ -41,11 +46,11 @@ def parse_rows(lines) -> list:
             continue
         if not isinstance(rec, dict):
             continue
-        tag = rec.get("tag", "?")
-        if tag not in latest:
-            order.append(tag)
-        latest[tag] = rec
-    return [latest[t] for t in order]
+        key = (rec.get("tag", "?"), rec.get("policy"))
+        if key not in latest:
+            order.append(key)
+        latest[key] = rec
+    return [latest[k] for k in order]
 
 
 def load_rows(path: str) -> list:

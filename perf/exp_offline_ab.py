@@ -18,8 +18,13 @@ flops, temp memory, collective payloads) with the relay out of the loop:
              CPU-mesh HLO and the scaling projection's traffic input.
   bert_b256— BERT-base classification step at b=256 s=128: the
              queue-4 on-chip A/B's byte/temp picture, offline.
+  remat    — the donated ResNet-50 b=512 train step under tpuframe.mem
+             remat policies (REMAT_POLICIES=comma,list overrides the
+             default none,dots,per_block set).  Rows carry a ``policy``
+             column; the _ab_rows key is (tag, policy), so every policy
+             row survives next to the ``none`` baseline.
 
-Usage:  python perf/exp_offline_ab.py [lm_xent|lm_8k|dp32|bert_b256|all]
+Usage:  python perf/exp_offline_ab.py [lm_xent|lm_8k|dp32|bert_b256|remat|all]
 Appends JSON lines to perf/results/offline_ab.jsonl.
 """
 
@@ -265,6 +270,27 @@ def dp32():
         "grad_tree_f32_mb": 102.4}))
 
 
+def remat_ab():
+    """Donated ResNet-50 b=512 train step per tpuframe.mem remat policy —
+    the same program tune's ``remat_sweep`` scores, as A/B rows (one
+    ``policy`` column per line; ~4 min compile each)."""
+    from tpuframe.tune import search as tune_search
+
+    topo = topologies.get_topology_desc("v5e:2x2", platform="tpu")
+    raw = os.environ.get("REMAT_POLICIES", "none,dots,per_block")
+    policies = tuple(p.strip() for p in raw.split(",") if p.strip())
+    for pol in policies:
+        log(f"compiling resnet50_remat_b512 policy={pol}...")
+        try:
+            compiled, _ = tune_search._remat_step_compile(
+                topo.devices, 512, pol)
+            record(_analyze(compiled, "resnet50_remat_b512",
+                            {"batch": 512, "policy": pol}))
+        except Exception as e:  # noqa: BLE001 — e.g. `full` OOMs the v5e
+            record({"tag": "resnet50_remat_b512", "batch": 512,
+                    "policy": pol, "compile_error": str(e)[:300]})
+
+
 def show():
     """Print the SURVIVING rows (supersession rule in _ab_rows: latest
     line per tag wins — §11 regenerations hide the round-4 rows)."""
@@ -280,7 +306,7 @@ def show():
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     steps = {"lm_xent": lm_xent, "lm_8k": lm_8k, "dp32": dp32,
-             "bert_b256": bert_b256}
+             "bert_b256": bert_b256, "remat": remat_ab}
     if which == "show":
         return show()
     if which == "all":

@@ -18,7 +18,11 @@ non-zero on any finding:
   5. obs self-check — ``python -m tpuframe.obs summarize --selfcheck``
      schema-validates the shipped sample event logs (docs/samples/), so
      an event-schema change that strands existing logs fails CI before
-     it ships.
+     it ships;
+  6. mem self-check — the remat policy registry must apply every preset,
+     ``save_named`` must parse (and reject unknown seams), and the
+     model/step files must pass the TF108 registry-seam lint
+     (``tpuframe.mem.check``).
 
 Strategies this interpreter cannot express (see
 :class:`~tpuframe.analysis.strategies.Unavailable`) print as SKIP and do
@@ -113,6 +117,16 @@ def _run_tune_check() -> int:
     return len(problems)
 
 
+def _run_mem_check() -> int:
+    from tpuframe import mem
+
+    problems = mem.check()
+    for p in problems:
+        print(f"MEM {p}")
+    print(f"[analysis] mem self-check: {len(problems)} problem(s)")
+    return len(problems)
+
+
 def _run_obs_check() -> int:
     # Through the real CLI entry point, not an import — the gate then
     # also catches a broken ``python -m tpuframe.obs`` invocation.
@@ -154,6 +168,7 @@ def main(argv=None) -> int:
             tuple(args.strategy) if args.strategy else None, args.devices)
         n_findings += _run_registry_checks()
         n_findings += _run_tune_check()
+        n_findings += _run_mem_check()
         n_findings += _run_obs_check()
 
     if n_findings:

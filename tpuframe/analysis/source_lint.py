@@ -41,6 +41,14 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          Also fires on ``print()`` inside *traced* code anywhere: a
          print under jit runs at trace time only, so it is not the
          instrumentation it looks like (use ``jax.debug.print``).
+  TF108  bare rematerialization in model/step code — a direct
+         ``jax.checkpoint``/``jax.remat``/``nn.remat`` call inside
+         ``models/`` or ``parallel/`` bypasses the ``tpuframe.mem``
+         policy registry (same registry-seam rule as TF105's GCS
+         check): the remat decision becomes invisible to the offline
+         policy search, the tuning DB and the run-event record.  Route
+         modules through ``mem.remat_module`` and loss functions
+         through ``mem.wrap`` / the step factories' ``remat_policy=``.
   TF106  compiler-env mutation that can run after jax backend init —
          ``os.environ["XLA_FLAGS"] = ...`` (or ``LIBTPU_INIT_ARGS``,
          via assignment/setdefault/update/putenv) is snapshotted by the
@@ -84,6 +92,8 @@ RULES = {
              "run after jax backend init",
     "TF107": "print()/time.time() step instrumentation in a hot path "
              "bypassing tpuframe.obs",
+    "TF108": "bare jax.checkpoint/jax.remat/nn.remat in model/step code "
+             "bypassing the tpuframe.mem policy registry",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -95,6 +105,16 @@ _CLOCK_CALLS = {"time.time", "time.perf_counter", "time.monotonic"}
 
 # TF106: env keys the backend snapshots at init — a later write is dead.
 _COMPILER_ENV_KEYS = {"XLA_FLAGS", "LIBTPU_INIT_ARGS"}
+
+# TF108: model/step code where every remat decision must route through
+# tpuframe.mem; the registry itself is the one sanctioned call site.
+_REMAT_SCOPE_PARTS = ("models/", "parallel/")
+_REMAT_EXEMPT_PARTS = ("mem/",)
+_BARE_REMAT_CALLEES = {
+    "jax.checkpoint", "jax.remat", "nn.remat", "flax.linen.remat",
+    "linen.remat", "jax.ad_checkpoint.checkpoint",
+    "ad_checkpoint.checkpoint",
+}
 
 # TF105a: google.cloud.storage blob/bucket methods — allowed only inside
 # the retry-wrapped data/gcs.py layer.
@@ -226,7 +246,11 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
     lines = src.splitlines()
     jitted = _jitted_names(tree)
     findings: list[LintFinding] = []
-    hot_path = path.replace("\\", "/").endswith(_HOT_PATH_SUFFIXES)
+    norm_path = path.replace("\\", "/")
+    hot_path = norm_path.endswith(_HOT_PATH_SUFFIXES)
+    remat_scope = (any(p in norm_path for p in _REMAT_SCOPE_PARTS)
+                   and not any(p in norm_path
+                               for p in _REMAT_EXEMPT_PARTS))
 
     # TF106: a module-level compiler-env write is safe only BEFORE the
     # module-level jax import (the conftest/bootstrap pattern).
@@ -360,6 +384,13 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
                 emit("TF104", node,
                      "pallas_call without interpret= — decide "
                      "Mosaic-vs-interpret explicitly (_auto_interpret())",
+                     fn)
+            if remat_scope and callee in _BARE_REMAT_CALLEES:
+                emit("TF108", node,
+                     f"{callee}() bare rematerialization in model/step "
+                     f"code bypasses the tpuframe.mem policy registry — "
+                     f"use mem.remat_module for modules, mem.wrap / the "
+                     f"step factories' remat_policy= for loss functions",
                      fn)
             if (isinstance(node.func, ast.Attribute)
                     and node.func.attr in _RAW_GCS_METHODS

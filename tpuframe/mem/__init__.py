@@ -1,0 +1,80 @@
+"""tpuframe.mem — structured rematerialization & HBM-traffic policy.
+
+The §6 byte attribution showed the ResNet-50 step's 143.5 GB is mostly
+backward-pass touch count; *what gets saved for the backward* is the
+lever.  This package turns that decision into a named, searchable policy:
+
+  - :mod:`tpuframe.mem.policy` — the policy registry (``none`` / ``full``
+    / ``per_block`` / ``dots`` presets / ``save_named(...)``), the model
+    seam annotations (``seam``/``remat_module``), and the
+    env-alias-DB resolution chain;
+  - :mod:`tpuframe.mem.audit` — the donation/aliasing audit over compiled
+    steps (``input_output_alias`` parsing);
+  - the offline search lives in ``tpuframe.tune`` (``python -m
+    tpuframe.tune sweep --remat``) and persists winners to the tuning DB.
+
+``check()`` is the analysis-gate hook: registry self-validation plus a
+TF108 self-lint of the model/step files that must route every remat
+through this package.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tpuframe.mem.audit import audit_step_donation, donation_report
+from tpuframe.mem.policy import (ENV_LEGACY, ENV_POLICY, SEAM_NAMES,
+                                 available_policies, parse_save_named,
+                                 policy_from_env, remat_module, resolve,
+                                 seam, validate_policy, wrap)
+
+__all__ = [
+    "ENV_LEGACY", "ENV_POLICY", "SEAM_NAMES", "audit_step_donation",
+    "available_policies", "check", "donation_report", "parse_save_named",
+    "policy_from_env", "remat_module", "resolve", "seam",
+    "validate_policy", "wrap",
+]
+
+# The files whose remat decisions must route through this registry —
+# TF108's scope, self-linted here so the analysis gate fails closed if a
+# bare jax.checkpoint/nn.remat sneaks back into model/step code.
+_TF108_SELF_LINT = (
+    os.path.join("models", "resnet.py"),
+    os.path.join("models", "transformer_lm.py"),
+    os.path.join("parallel", "step.py"),
+    os.path.join("parallel", "pp_lm.py"),
+)
+
+
+def check() -> list:
+    """Self-check for the ``python -m tpuframe.analysis`` CI gate.
+    Returns problem strings; [] means healthy."""
+    problems = []
+    # 1. every preset resolves to a policy and wraps a function
+    for name in available_policies():
+        try:
+            wrap(lambda x: x, name)
+        except Exception as e:  # noqa: BLE001 — report, don't crash CI
+            problems.append(f"policy {name!r} failed to apply: "
+                            f"{type(e).__name__}: {e}")
+    # 2. save_named parses and rejects unknown seams
+    try:
+        got = parse_save_named("save_named(block_out, stem_out)")
+        if got != ("block_out", "stem_out"):
+            problems.append(f"save_named parse drift: {got!r}")
+    except Exception as e:  # noqa: BLE001
+        problems.append(f"save_named parse failed: {e}")
+    try:
+        parse_save_named("save_named(not_a_seam)")
+        problems.append("save_named accepted an unknown seam name")
+    except ValueError:
+        pass
+    # 3. TF108 self-lint: model/step files keep using the registry
+    from tpuframe.analysis.source_lint import lint_paths
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.join(pkg_root, p) for p in _TF108_SELF_LINT]
+    for f in lint_paths([p for p in paths if os.path.exists(p)]):
+        if f.rule == "TF108":
+            problems.append(f"self-lint: {f}")
+    return problems

@@ -1,0 +1,95 @@
+"""Donation/aliasing audit — verify the compiled step actually donates.
+
+``make_train_step(donate=True)`` marks the TrainState argument donated,
+which is what keeps params + optimizer state single-buffered through the
+update (the difference between fitting and OOMing near the HBM limit,
+and an HBM-traffic term of its own: an un-aliased update writes fresh
+buffers).  But donation is a *request* — XLA drops it silently when
+dtypes/layouts mismatch or a result doesn't line up with an input, and
+jax only surfaces a warning buried in the log.  This audit parses the
+compiled module's ``input_output_alias`` table so tests and the tune
+sweep can assert the aliasing actually happened.
+
+HLO text carries the table in the module header::
+
+    HloModule jit__grad_step, input_output_alias={ {0}: (0, {0},
+        may-alias), {1}: (0, {1}, may-alias), ... }
+
+one ``{output index}: (param number, {param index}, kind)`` entry per
+aliased buffer.
+"""
+
+from __future__ import annotations
+
+import re
+
+# one alias entry: "{1,2}: (0, {3}, may-alias)"
+_ENTRY_RE = re.compile(
+    r"\{[\d,\s]*\}:\s*\((\d+),\s*\{[\d,\s]*\},\s*(may-alias|must-alias)\)")
+
+
+def _alias_block(hlo_text: str) -> str:
+    """The ``input_output_alias={...}`` block (brace-matched — entries
+    contain nested braces), or '' when the module has no aliases."""
+    key = "input_output_alias={"
+    start = hlo_text.find(key)
+    if start < 0:
+        return ""
+    i = start + len(key)
+    depth = 1
+    while i < len(hlo_text) and depth:
+        if hlo_text[i] == "{":
+            depth += 1
+        elif hlo_text[i] == "}":
+            depth -= 1
+        i += 1
+    return hlo_text[start + len(key):i - 1]
+
+
+def donation_report(compiled) -> dict:
+    """Parse a compiled executable's aliasing table.
+
+    Returns ``{"n_aliased", "aliased_params" (sorted arg numbers that
+    donate at least one buffer), "donated" (any alias at all)}``.
+    Accepts anything with ``as_text()`` (jax AOT compiled objects).
+    """
+    text = compiled.as_text() if hasattr(compiled, "as_text") else \
+        str(compiled)
+    entries = _ENTRY_RE.findall(_alias_block(text))
+    return {
+        "n_aliased": len(entries),
+        "aliased_params": sorted({int(argnum) for argnum, _ in entries}),
+        "donated": bool(entries),
+    }
+
+
+def audit_step_donation(compiled, state=None) -> list:
+    """Problem strings for a compiled *train step* (arg 0 = TrainState).
+
+    With ``state`` (the concrete/abstract TrainState) the check is
+    strict: every params + opt_state leaf must be covered by an alias —
+    the optimizer update buffers are exactly what donation exists for.
+    Without it, any empty table is flagged.
+    """
+    report = donation_report(compiled)
+    if not report["donated"]:
+        return ["no input_output_alias entries — the step's donate=True "
+                "request was dropped (or the step was built with "
+                "donate=False); params + optimizer state are "
+                "double-buffered through the update"]
+    problems = []
+    if 0 not in report["aliased_params"]:
+        problems.append(
+            f"aliases exist but none donate from arg 0 (the TrainState): "
+            f"aliased args {report['aliased_params']}")
+    if state is not None:
+        import jax
+
+        n_update_leaves = len(jax.tree.leaves(state.params)) + \
+            len(jax.tree.leaves(state.opt_state))
+        if report["n_aliased"] < n_update_leaves:
+            problems.append(
+                f"only {report['n_aliased']} buffers aliased but the "
+                f"update touches {n_update_leaves} params+opt_state "
+                f"leaves — donation partially dropped")
+    return problems

@@ -1,0 +1,213 @@
+"""The rematerialization policy registry — activation memory/traffic as a
+named, searchable dimension.
+
+PERF.md §2 proved the ResNet-50 step is bandwidth-bound (81% of the v5e
+HBM roofline, MXU ≤29% busy) and §6 attributed the bytes: ~105 of
+143.5 GB is backward-pass touch count — saved-activation re-reads plus dy
+double-reads.  What forward activations are *saved* for the backward is
+therefore a first-order performance lever, and until this module it lived
+in two ad-hoc places: the models' ``remat=`` flag and the
+``TPUFRAME_BENCH_REMAT`` bench knob.
+
+This module makes the decision a **named policy** applied uniformly at
+the loss-function seam (``parallel/step.py``/``parallel/pp_lm.py`` wrap
+the loss in ``jax.checkpoint`` with the policy's saveable predicate):
+
+  ============== ======================================================
+  ``none``       no checkpoint region at all — XLA saves whatever the
+                 autodiff residual rule produces (the historical
+                 default; §6's 143.5 GB at b512)
+  ``everything`` a checkpoint region that saves every intermediate —
+                 semantically ``none`` but through the remat machinery
+                 (the A/B control for the wrapper itself)
+  ``dots``       save only matmul/conv outputs
+                 (``jax.checkpoint_policies.checkpoint_dots``); the
+                 elementwise BN/relu chains — 74% of activation-sized
+                 f32 values in the §7 census — are recomputed, and they
+                 fuse into their consumers so the recompute adds no HBM
+                 traffic
+  ``dots_no_batch``  ``dots_with_no_batch_dims_saveable``: save only
+                 batch-free dot outputs; on a conv net everything
+                 carries batch dims, so this approaches ``full``
+  ``per_block``  save only the named block seams the models annotate
+                 (``save_only_these_names`` over ``SEAM_NAMES``);
+                 intra-block activations are recomputed from the seams
+  ``full``       save nothing (``nothing_saveable``) — maximum
+                 recompute, minimum residency
+  ``save_named(a,b,...)``  parametric: save exactly the listed seam
+                 names — the search's fine-grained axis
+  ============== ======================================================
+
+Models annotate their seams with :func:`seam` (a thin
+``jax.ad_checkpoint.checkpoint_name`` wrapper so every name is validated
+against ``SEAM_NAMES``) — a no-op identity unless a ``save_named``-class
+policy is active.  Model-level ``nn.remat`` goes through
+:func:`remat_module` so the TF108 lint can pin every remat decision to
+this registry.
+
+Which policy actually wins is an *empirical*, generation- and
+batch-dependent question — §7 measured naive per-block flax remat at
++18% bytes (recomputed intra-block convs land in HBM again), while
+``dots`` removes the fusable elementwise residuals for free.  That is
+exactly what ``python -m tpuframe.tune sweep --remat`` measures offline
+(AOT ``cost_analysis()`` bytes on a compile-only topology) and persists
+to the tuning DB; resolution precedence is the tuning subsystem's:
+
+    TPUFRAME_REMAT_POLICY  >  legacy TPUFRAME_BENCH_REMAT alias
+                           >  tuning DB (generation-gated)  >  default
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+SEAM_NAMES = ("stem_out", "embed_out", "block_out")
+
+ENV_POLICY = "TPUFRAME_REMAT_POLICY"
+# PR-2-style deprecated alias: the old bench knob. "1" maps to per_block
+# (what the knob toggled); anything else is ignored.
+ENV_LEGACY = "TPUFRAME_BENCH_REMAT"
+
+_PRESETS = ("none", "everything", "dots", "dots_no_batch", "per_block",
+            "full")
+
+_SAVE_NAMED_RE = re.compile(r"^save_named\(\s*([\w\s,]*?)\s*\)$")
+
+_warned_legacy = False
+
+
+def available_policies() -> tuple:
+    """The preset names (``save_named(...)`` is parametric on top)."""
+    return _PRESETS
+
+
+def parse_save_named(name: str) -> tuple | None:
+    """``save_named(a, b)`` → ``("a", "b")``; None when not that shape.
+    Raises on unknown seam names — a typo'd name silently saving nothing
+    would be the worst failure mode."""
+    m = _SAVE_NAMED_RE.match(name.strip())
+    if m is None:
+        return None
+    names = tuple(n for n in re.split(r"[,\s]+", m.group(1)) if n)
+    if not names:
+        raise ValueError("save_named() needs at least one seam name; "
+                         f"known seams: {SEAM_NAMES}")
+    unknown = [n for n in names if n not in SEAM_NAMES]
+    if unknown:
+        raise ValueError(f"save_named: unknown seam name(s) {unknown}; "
+                         f"models annotate {SEAM_NAMES}")
+    return names
+
+
+def validate_policy(name: str) -> str:
+    """Normalize + validate a policy name; raises ValueError on junk."""
+    name = (name or "none").strip()
+    if name in _PRESETS:
+        return name
+    if parse_save_named(name) is not None:
+        return name
+    raise ValueError(f"unknown remat policy {name!r}; presets: "
+                     f"{_PRESETS} or save_named(<seam,...>) over "
+                     f"{SEAM_NAMES}")
+
+
+def _jax_policy(name: str):
+    """The ``jax.checkpoint`` saveable predicate for ``name`` (None for
+    ``none`` — no checkpoint region is applied at all)."""
+    import jax
+
+    cp = jax.checkpoint_policies
+    if name == "none":
+        return None
+    if name == "everything":
+        return cp.everything_saveable
+    if name == "full":
+        return cp.nothing_saveable
+    if name == "dots":
+        return cp.checkpoint_dots
+    if name == "dots_no_batch":
+        return cp.dots_with_no_batch_dims_saveable
+    if name == "per_block":
+        return cp.save_only_these_names(*SEAM_NAMES)
+    names = parse_save_named(name)
+    if names is not None:
+        return cp.save_only_these_names(*names)
+    raise ValueError(f"unknown remat policy {name!r}")
+
+
+def wrap(fn, policy: str | None):
+    """Apply ``policy`` to a differentiated function (the loss) — the ONE
+    place a ``jax.checkpoint`` enters step construction (TF108 pins every
+    other call site to this module).  ``None``/``"none"`` returns ``fn``
+    unchanged: no checkpoint region, the historical behavior."""
+    import jax
+
+    name = validate_policy(policy) if policy else "none"
+    if name == "none":
+        return fn
+    return jax.checkpoint(fn, policy=_jax_policy(name))
+
+
+def seam(x, name: str):
+    """Annotate a block-boundary activation so ``per_block``/
+    ``save_named`` policies can elect to save it.  Identity (a ``name``
+    primitive) when no checkpoint region or policy references it."""
+    if name not in SEAM_NAMES:
+        raise ValueError(f"unknown seam name {name!r}; add it to "
+                         f"mem.policy.SEAM_NAMES first")
+    from jax.ad_checkpoint import checkpoint_name
+
+    return checkpoint_name(x, name)
+
+
+def remat_module(module_cls, **kwargs):
+    """``flax.linen.remat`` through the registry seam.  Model code calls
+    this instead of ``nn.remat`` directly so TF108 can lint that every
+    remat decision is visible to the policy layer (same seam rule as
+    TF105's GCS check)."""
+    import flax.linen as nn
+
+    return nn.remat(module_cls, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Resolution: env (incl. the deprecated alias) > tuning DB > default.
+# ---------------------------------------------------------------------------
+
+def policy_from_env(env=os.environ) -> str | None:
+    """The explicit env override, or None.  Folds the legacy
+    ``TPUFRAME_BENCH_REMAT=1`` knob in as a deprecated alias for
+    ``per_block`` (warn once — the faults.py legacy-knob pattern);
+    ``TPUFRAME_REMAT_POLICY`` wins when both are set."""
+    global _warned_legacy
+    explicit = env.get(ENV_POLICY, "").strip()
+    if explicit:
+        return validate_policy(explicit)
+    if env.get(ENV_LEGACY, "").strip() == "1":
+        if not _warned_legacy:
+            print(f"[tpuframe] {ENV_LEGACY} is deprecated — use "
+                  f"{ENV_POLICY}=per_block", flush=True)
+            _warned_legacy = True
+        return "per_block"
+    return None
+
+
+def resolve(program: str | None = None, family: str | None = None,
+            default: str = "none") -> tuple:
+    """``(policy, source)`` for a step program: env override (explicit or
+    legacy alias) > tuning-DB winner (generation-gated, fingerprint-free
+    family lookup like resolve_xla_opts) > ``default``.  ``source`` is
+    one of ``env``/``env_legacy``/``tune_db``/``default`` — emitted in
+    the run event so a run's policy provenance is always on record."""
+    env_val = policy_from_env()
+    if env_val is not None:
+        explicit = os.environ.get(ENV_POLICY, "").strip()
+        return env_val, ("env" if explicit else "env_legacy")
+    if program or family:
+        from tpuframe.tune import db as tune_db
+
+        db_val = tune_db.resolve_remat_policy(program or "", family=family)
+        if db_val is not None:
+            return validate_policy(db_val), "tune_db"
+    return validate_policy(default), "default"

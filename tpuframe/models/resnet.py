@@ -24,6 +24,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tpuframe import mem
+
 ModuleDef = Callable[..., nn.Module]
 
 
@@ -135,9 +137,10 @@ class ResNet(nn.Module):
     stem: str = "conv"
     # Rematerialize each residual block in the backward pass: only block
     # boundaries are saved forward; intra-block activations are recomputed.
-    # On a bandwidth-bound step (PERF.md §2: 81% of the HBM roofline, MXU
-    # ~29% busy) this trades idle MXU flops for HBM bytes — A/B'd on-chip
-    # via TPUFRAME_BENCH_REMAT.
+    # Module-level remat (mem.remat_module) — the pre-registry lever.
+    # New code should prefer the loss-seam policies (tpuframe.mem / the
+    # step factories' remat_policy=, searched via `python -m
+    # tpuframe.tune sweep --remat`), which leave the param tree alone.
     remat: bool = False
     # "flax" = nn.BatchNorm; "folded" = FoldedBatchNorm, whose
     # activation-sized normalize math runs in the compute dtype instead of
@@ -200,8 +203,12 @@ class ResNet(nn.Module):
         x = nn.relu(x)
         if not self.cifar_stem:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        # Named checkpoint seams: identity unless a per_block/save_named
+        # remat policy (tpuframe.mem) elects to save exactly these.
+        x = mem.seam(x, "stem_out")
 
-        block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
+        block_cls = mem.remat_module(self.block_cls) if self.remat \
+            else self.block_cls
         # Explicit names matching flax's auto-naming of the UNwrapped class:
         # nn.remat renames modules ("CheckpointBottleneck_0"), which would
         # silently re-key the param tree and orphan existing checkpoints
@@ -214,6 +221,7 @@ class ResNet(nn.Module):
                 x = block_cls(self.width * 2 ** i, strides, conv, norm,
                               name=f"{self.block_cls.__name__}_{block_idx}",
                               **kw)(x)
+                x = mem.seam(x, "block_out")
                 block_idx += 1
 
         x = jnp.mean(x, axis=(1, 2))  # global average pool

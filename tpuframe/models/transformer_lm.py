@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpuframe import mem
+
 
 @dataclass(frozen=True)
 class LMConfig:
@@ -215,7 +217,8 @@ class ScanBlockLM(nn.Module):
 
         def block_stack(x, n_layers):
             positions = jnp.arange(x.shape[1])
-            target = nn.remat(_ScanBlock) if c.remat else _ScanBlock
+            target = mem.remat_module(_ScanBlock) if c.remat \
+                else _ScanBlock
             Scanned = nn.scan(
                 target,
                 variable_axes={"params": 0},
@@ -267,6 +270,7 @@ class _ScanBlock(nn.Module):
     def __call__(self, carry, _):
         x, positions = carry
         y = Block(self.cfg, self.train, name="block")(x, positions)
+        y = mem.seam(y, "block_out")
         return (y, positions), None
 
 
@@ -295,10 +299,14 @@ class TransformerLM(nn.Module):
 
         x = nn.Embed(c.vocab_size, c.hidden_size, name="embed")(input_ids)
         x = x.astype(c.jnp_dtype)
-        block = nn.remat(Block) if c.remat else Block
+        # Named checkpoint seams: identity unless a per_block/save_named
+        # remat policy (tpuframe.mem) elects to save exactly these.
+        x = mem.seam(x, "embed_out")
+        block = mem.remat_module(Block) if c.remat else Block
         for i in range(c.num_layers):
             use_moe = c.moe_experts > 0 and (i + 1) % c.moe_every == 0
             x = block(c, train, use_moe, name=f"block_{i}")(x, positions)
+            x = mem.seam(x, "block_out")
         x = nn.LayerNorm(use_bias=False, name="final_ln")(x)
         if hidden_only:
             return x

@@ -4,7 +4,8 @@ Subcommands (all take a directory of ``events.<host>.jsonl`` files, or a
 single file):
 
   summarize  merged goodput breakdown (bucket seconds + % of wall),
-             step-time distribution, MFU, peak HBM, run_end counters.
+             step-time distribution, MFU + HBM-roofline utilization,
+             chosen remat policy, peak HBM, run_end counters.
              ``--selfcheck`` instead schema-validates shipped/sample
              event files (the analysis CI gate calls this).
   merge      one time-ordered multi-host stream to stdout or ``-o``.
@@ -111,9 +112,16 @@ def cmd_summarize(directory: str, generation: str | None) -> int:
               f"mean={mean:.2f} p50={_percentile(times, 0.5):.2f} "
               f"p90={_percentile(times, 0.9):.2f} max={times[-1]:.2f}")
 
-    for key in ("mfu_productive", "mfu_goodput"):
+    for key in ("mfu_productive", "mfu_goodput", "hbm_util_productive"):
         if summary.get(key) is not None:
             print(f"{key}: {summary[key]:.4%}")
+    remat = next((r for r in reversed(merged)
+                  if r.get("type") == "remat_policy"), None)
+    if remat is not None:
+        pred = remat.get("predicted_bytes_per_step")
+        pred_s = f", predicted {_fmt_bytes(int(pred))}/step" if pred else ""
+        print(f"remat policy: {remat.get('policy')} "
+              f"(source: {remat.get('source')}{pred_s})")
     if summary.get("peak_hbm_bytes") is not None:
         print(f"peak HBM per device: "
               f"{_fmt_bytes(summary['peak_hbm_bytes'])}")

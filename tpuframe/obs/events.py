@@ -33,6 +33,8 @@ Event types (see ``REQUIRED_FIELDS`` for the per-type contract):
   stall          heartbeat watchdog fired (last_step, idle_s)
   preempt        SIGTERM/SIGINT preemption observed (signal[, step])
   devmem         HBM telemetry sample (per-device memory_stats)
+  remat_policy   rematerialization policy chosen for the step program
+                 (policy name, resolution source, predicted bytes)
   run_end        final step, wall s, goodput buckets, MFU, counters,
                  peak HBM per device
   ============== ========================================================
@@ -75,6 +77,7 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "stall": ("last_step", "idle_s"),
     "preempt": ("signal",),
     "devmem": ("devices",),
+    "remat_policy": ("policy", "source"),
     "run_end": ("final_step", "wall_s", "goodput"),
 }
 

@@ -121,6 +121,23 @@ def mfu(flops_per_step: float, step_time_s: float, *,
     return flops_per_step / (step_time_s * hw.bf16_flops * n_devices)
 
 
+def hbm_util(bytes_per_step: float, step_time_s: float, *,
+             generation: str = DEFAULT_GENERATION,
+             n_devices: int = 1) -> float:
+    """HBM-roofline utilization ("bytes-MFU") of one step: the compiled
+    program's ``cost_analysis`` bytes accessed over what the slice's HBM
+    could stream in that time.  The bandwidth twin of :func:`mfu` — for
+    bandwidth-bound programs (the ResNet-50 step, PERF.md §2) THIS is the
+    number that says "fast as the hardware allows", and the remat policies
+    in :mod:`tpuframe.mem` move it directly.  Same §8 caveat as ``mfu``:
+    scan-containing programs undercount bytes, so this is a lower bound.
+    """
+    if step_time_s <= 0 or bytes_per_step <= 0 or n_devices <= 0:
+        return 0.0
+    hw = roofline.get_hardware(generation)
+    return bytes_per_step / (step_time_s * hw.hbm_bytes_per_s * n_devices)
+
+
 def flops_fallback(n_params: int, examples_per_step: int,
                    tokens_per_example: int = 1) -> float:
     """Analytic fwd+bwd flops estimate when the compiled program's
@@ -196,6 +213,7 @@ def from_events(events: list[dict], *,
     n_steps = 0
     mfu_productive = None
     mfu_goodput = None
+    hbm_util_productive = None
     peak_hbm = None
     for stream in attempts:
         end = next((r for r in stream if r.get("type") == "run_end"), None)
@@ -212,6 +230,8 @@ def from_events(events: list[dict], *,
                 mfu_productive = float(end["mfu_productive"])
             if end.get("mfu_goodput") is not None:
                 mfu_goodput = float(end["mfu_goodput"])
+            if end.get("hbm_util_productive") is not None:
+                hbm_util_productive = float(end["hbm_util_productive"])
             if end.get("peak_hbm_bytes") is not None:
                 peak_hbm = max(peak_hbm or 0,
                                int(end["peak_hbm_bytes"]))
@@ -255,22 +275,31 @@ def from_events(events: list[dict], *,
         out["mfu_productive"] = mfu_productive
     if mfu_goodput is not None:
         out["mfu_goodput"] = mfu_goodput
+    if hbm_util_productive is not None:
+        out["hbm_util_productive"] = hbm_util_productive
     if peak_hbm is not None:
         out["peak_hbm_bytes"] = peak_hbm
 
-    # Recompute MFU offline when the manifest recorded a flops model
-    # (run_start carries it) — lets ``summarize`` work on crashed logs.
-    if mfu_productive is None:
+    # Recompute MFU / HBM utilization offline when the manifest recorded
+    # the cost models (run_start carries flops_per_step/bytes_per_step) —
+    # lets ``summarize`` work on crashed logs that never wrote run_end.
+    if mfu_productive is None or hbm_util_productive is None:
         start = next((r for r in events if r.get("type") == "run_start"),
                      None)
         times = step_times_ms(events)
-        if start and times and start.get("flops_per_step"):
+        if start and times:
             gen = (generation or start.get("generation")
                    or DEFAULT_GENERATION)
             mean_s = sum(times) / len(times) / 1e3
-            out["mfu_productive"] = mfu(
-                float(start["flops_per_step"]), mean_s, generation=gen,
-                n_devices=int(start.get("devices", 1)))
+            n_dev = int(start.get("devices", 1))
+            if mfu_productive is None and start.get("flops_per_step"):
+                out["mfu_productive"] = mfu(
+                    float(start["flops_per_step"]), mean_s,
+                    generation=gen, n_devices=n_dev)
+            if hbm_util_productive is None and start.get("bytes_per_step"):
+                out["hbm_util_productive"] = hbm_util(
+                    float(start["bytes_per_step"]), mean_s,
+                    generation=gen, n_devices=n_dev)
     return out
 
 

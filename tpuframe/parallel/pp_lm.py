@@ -110,7 +110,8 @@ def _head_loss_acc(model, fused_xent: bool, params, x_last, labels):
 
 
 def make_pp_lm_step(model, tx: optax.GradientTransformation, mesh: Mesh, *,
-                    n_micro: int, fused_xent: bool = False):
+                    n_micro: int, fused_xent: bool = False,
+                    remat_policy: str | None = None):
     """Compiled train step: ScanBlockLM forward through the microbatch
     pipeline, CE loss, one optimizer update.  Returns ``(step_fn,
     place_state, place_batch)`` where the placers put a host-built
@@ -118,7 +119,12 @@ def make_pp_lm_step(model, tx: optax.GradientTransformation, mesh: Mesh, *,
 
     ``fused_xent``: compute the head + loss with the chunked fused
     softmax-xent (tpuframe.ops.fused_xent) — the [B,S,V] logits never
-    materialize; same loss/gradients as the dense path."""
+    materialize; same loss/gradients as the dense path.
+
+    ``remat_policy``: a :mod:`tpuframe.mem` policy name applied to the
+    per-shard loss before differentiation — same registry/seams as
+    ``make_train_step`` (the ScanBlockLM names its block seams, so
+    ``per_block``/``save_named`` work here too)."""
     n_stages = int(mesh.shape["pipe"])
     num_layers = model.cfg.num_layers
     if num_layers % n_stages:
@@ -146,6 +152,10 @@ def make_pp_lm_step(model, tx: optax.GradientTransformation, mesh: Mesh, *,
                                        batch["labels"])
             return lax.pmean(loss, data_axes), acc
 
+        if remat_policy:
+            from tpuframe.mem import policy as mem_policy
+
+            loss_fn = mem_policy.wrap(loss_fn, remat_policy)
         (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)

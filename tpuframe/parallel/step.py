@@ -265,6 +265,7 @@ def make_train_step(
     accum_steps: int = 1,
     grad_reduce: str = "mean",
     compiler_options: dict | None = None,
+    remat_policy: str | None = None,
 ):
     """Build the compiled train step.
 
@@ -306,7 +307,18 @@ def make_train_step(
     ``mesh=None`` → single-device jit (config 1, SURVEY.md §7 step 1): same
     body, no collectives — the property the reference gets from Horovod's
     size()==1 no-op mode.
+
+    ``remat_policy``: a :mod:`tpuframe.mem` policy name (``none`` /
+    ``full`` / ``per_block`` / ``dots`` / ``save_named(...)``) applied to
+    ``loss_fn`` before differentiation — selects which forward
+    activations are saved for the backward (the §6 HBM-traffic lever).
+    ``None``/``"none"`` leaves the loss unwrapped.  Resolution (env >
+    tuning DB > default) is the caller's job via ``mem.resolve``.
     """
+    if remat_policy:
+        from tpuframe.mem import policy as mem_policy
+
+        loss_fn = mem_policy.wrap(loss_fn, remat_policy)
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if grad_reduce not in ("mean", "adasum"):

@@ -131,6 +131,9 @@ class Harness:
     eval_loader: ShardedLoader
     manager: ckpt_lib.CheckpointManager | None
     start_step: int
+    # (policy name, resolution source) from tpuframe.mem.resolve —
+    # ("none", "default") when nothing elected a remat policy.
+    remat_policy: tuple = ("none", "default")
 
 
 def build_harness(cfg: TrainConfig) -> Harness:
@@ -209,6 +212,17 @@ def build_harness(cfg: TrainConfig) -> Harness:
     state = step_lib.TrainState.create(params, tx, model_state=model_state,
                                        rng=jax.random.key(cfg.seed + 1))
 
+    # Rematerialization policy: TPUFRAME_REMAT_POLICY env (or the legacy
+    # TPUFRAME_BENCH_REMAT alias) wins, else the tuning DB's offline remat
+    # sweep winner (generation-gated, like the XLA opts above), else none.
+    from tpuframe import mem
+
+    model_tag = cfg.model.replace("-", "_")
+    remat_policy, remat_source = mem.resolve(
+        program=f"train_{model_tag}_b{cfg.global_batch}",
+        family=f"remat_{model_tag}")
+    step_policy = None if remat_policy == "none" else remat_policy
+
     if use_pp:
         # Pipeline parallelism: ScanBlockLM blocks + opt state sharded over
         # the pipe axis, GPipe microbatching (tpuframe.parallel.pp_lm).
@@ -234,7 +248,7 @@ def build_harness(cfg: TrainConfig) -> Harness:
 
         factory, place_state, _ = pp_lm.make_pp_lm_step(
             model, tx, mesh, n_micro=cfg.pp_microbatches,
-            fused_xent=cfg.fused_xent)
+            fused_xent=cfg.fused_xent, remat_policy=step_policy)
         state = place_state(state)
         train_step = factory(state)
         eval_step = pp_lm.make_pp_lm_eval(
@@ -276,7 +290,8 @@ def build_harness(cfg: TrainConfig) -> Harness:
             fusion_threshold=tuning.step_threshold(),
             accum_steps=cfg.accum_steps,
             grad_reduce=cfg.grad_reduce,
-            compiler_options=xla_opts)
+            compiler_options=xla_opts,
+            remat_policy=step_policy)
         eval_step = step_lib.make_eval_step(
             make_metric_fn(cfg, model), mesh, batch_partition=step_part,
             reduce_axes=reduce_axes, state_shardings=state_shardings)
@@ -301,7 +316,8 @@ def build_harness(cfg: TrainConfig) -> Harness:
     return Harness(cfg=cfg, mesh=mesh, model=model, state=state,
                    train_step=train_step, eval_step=eval_step,
                    train_loader=train_loader, eval_loader=eval_loader,
-                   manager=manager, start_step=start_step)
+                   manager=manager, start_step=start_step,
+                   remat_policy=(remat_policy, remat_source))
 
 
 def _lm_reduce_axis(cfg: TrainConfig, *, for_grad: bool):
@@ -579,22 +595,25 @@ def _tune_db_fingerprint() -> str | None:
         return None
 
 
-def _step_flops(train_step, state, batch):
-    """Whole-program flops of one train step from the *lowered* module's
-    cost analysis — tracing only, no compile (Lowered.cost_analysis works
-    pre-compile on this jax).  Returns (flops, "cost_analysis") or
-    (None, None) when the path is unavailable (pp factory steps, older
-    jax) — callers fall back to the analytic 6·N·D estimate."""
+def _step_costs(train_step, state, batch):
+    """Whole-program (flops, bytes accessed) of one train step from the
+    *lowered* module's cost analysis — tracing only, no compile
+    (Lowered.cost_analysis works pre-compile on this jax).  Returns
+    (flops, bytes, "cost_analysis") or (None, None, None) when the path is
+    unavailable (pp factory steps, older jax) — callers fall back to the
+    analytic 6·N·D flops estimate (bytes has no analytic fallback: the
+    HBM-utilization row simply doesn't print without a cost model)."""
     try:
         ca = train_step.lower(state, batch).cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
         flops = float(ca.get("flops", 0.0) or 0.0)
+        nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
         if flops > 0:
-            return flops, "cost_analysis"
+            return flops, (nbytes if nbytes > 0 else None), "cost_analysis"
     except Exception:  # noqa: BLE001 — cost model optional by design
         pass
-    return None, None
+    return None, None, None
 
 
 def train(cfg: TrainConfig, *, trace_dir: str | None = None,
@@ -649,7 +668,7 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
 
     # Mutable run facts the event-emitting closures need (filled in once
     # the harness/flops model is known; read from the watchdog thread).
-    run_info: dict = {"flops": None, "flops_source": None,
+    run_info: dict = {"flops": None, "flops_source": None, "bytes": None,
                       "generation": goodput_lib.DEFAULT_GENERATION,
                       "devmem": None, "step": h.start_step}
 
@@ -673,6 +692,11 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
                     flops * prod_steps, summary["wall_s"],
                     generation=run_info["generation"],
                     n_devices=jax.device_count()), 6)
+        if run_info["bytes"] and prod_steps and prod_s > 0:
+            extra["hbm_util_productive"] = round(goodput_lib.hbm_util(
+                run_info["bytes"], prod_s / prod_steps,
+                generation=run_info["generation"],
+                n_devices=jax.device_count()), 6)
         if run_info["devmem"] is not None:
             extra.update(run_info["devmem"].peak_summary())
         events_lib.emit("run_end", final_step=final_step,
@@ -760,14 +784,15 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
                                   or goodput_lib.DEFAULT_GENERATION)
         if step < cfg.total_steps:
             first = next(data_iter)
-            flops, src = _step_flops(h.train_step, state, first)
+            flops, nbytes, src = _step_costs(h.train_step, state, first)
             data_iter = itertools.chain([first], data_iter)
         else:
-            flops, src = None, None
+            flops, nbytes, src = None, None, None
         if not flops:
             flops = goodput_lib.flops_fallback(n_params, examples_per_step)
             src = "analytic_6nd"
         run_info["flops"], run_info["flops_source"] = flops, src
+        run_info["bytes"] = nbytes
         events_lib.emit(
             "run_start", config=cfg.name,
             config_hash=hashlib.sha256(repr(cfg).encode()).hexdigest()[:16],
@@ -779,7 +804,14 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             start_step=h.start_step, total_steps=cfg.total_steps,
             global_batch=cfg.global_batch, n_params=n_params,
             generation=run_info["generation"],
-            flops_per_step=flops, flops_source=src)
+            flops_per_step=flops, flops_source=src,
+            bytes_per_step=nbytes)
+        # The chosen remat policy as its own typed record: joinable with
+        # the tuning DB (same policy names) and visible in summarize even
+        # when the run dies before run_end.
+        events_lib.emit("remat_policy", policy=h.remat_policy[0],
+                        source=h.remat_policy[1],
+                        predicted_bytes_per_step=nbytes)
         run_info["devmem"] = devmem_lib.DevmemSampler(
             interval_s=float(os.environ.get("TPUFRAME_DEVMEM_INTERVAL_S",
                                             "30"))).start()

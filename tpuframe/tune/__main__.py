@@ -1,6 +1,7 @@
 """CLI for the offline autotuner.
 
     python -m tpuframe.tune sweep --topology v5e:2x2   # the whole thing
+    python -m tpuframe.tune sweep --remat               # remat policy search
     python -m tpuframe.tune show                        # ranked DB contents
     python -m tpuframe.tune check                       # CI self-check
 
@@ -43,6 +44,13 @@ def _ensure_cpu_env() -> None:
 def _cmd_sweep(args) -> int:
     from tpuframe.tune import search
 
+    if args.remat:
+        search.remat_sweep(args.topology, db_path=args.db,
+                           report_path=args.report,
+                           batch=args.remat_batch,
+                           policies=tuple(args.remat_policies)
+                           if args.remat_policies else None)
+        return 0
     search.sweep(args.topology, db_path=args.db, report_path=args.report,
                  seq=args.seq, head_dim=args.head_dim,
                  blocks=tuple(args.blocks),
@@ -99,6 +107,13 @@ def main(argv=None) -> int:
     sw.add_argument("--blocks", type=int, nargs="+",
                     default=[128, 256, 512])
     sw.add_argument("--bench-batches", type=int, nargs="+", default=[256])
+    sw.add_argument("--remat", action="store_true",
+                    help="sweep tpuframe.mem remat policies over the "
+                         "donated ResNet-50 train step (bytes objective) "
+                         "instead of the fa/xla-opts grid")
+    sw.add_argument("--remat-batch", type=int, default=512)
+    sw.add_argument("--remat-policies", nargs="+", default=None,
+                    metavar="POLICY")
     sw.set_defaults(fn=_cmd_sweep)
 
     sh = sub.add_parser("show", help="print ranked DB contents")

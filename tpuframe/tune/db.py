@@ -138,6 +138,8 @@ class Record:
                 f"{k}={v}" for k, v in sorted(cfg["xla_opts"].items()))
         if "batch" in cfg:
             env["TPUFRAME_BENCH_BATCH"] = str(cfg["batch"])
+        if "remat_policy" in cfg:
+            env["TPUFRAME_REMAT_POLICY"] = str(cfg["remat_policy"])
         return env
 
     def _key(self):
@@ -343,3 +345,31 @@ def resolve_xla_opts(program: str, family: str | None = None) -> dict | None:
         return None
     opts = rec.config.get("xla_opts")
     return dict(opts) if opts else None
+
+
+def resolve_remat_policy(program: str,
+                         family: str | None = None) -> str | None:
+    """Rematerialization policy for ``program``: None unless the DB has a
+    swept winner for the target generation.  Callers apply
+    ``TPUFRAME_REMAT_POLICY`` (and the legacy ``TPUFRAME_BENCH_REMAT``
+    alias) themselves FIRST via :func:`tpuframe.mem.policy_from_env` —
+    when either env var is set this returns None so the override is
+    unambiguous."""
+    if os.environ.get("TPUFRAME_REMAT_POLICY", "").strip():
+        return None
+    if os.environ.get("TPUFRAME_BENCH_REMAT", "").strip():
+        return None
+    gen = target_generation()
+    if gen is None:
+        return None
+    db = _open_for_resolution()
+    if db is None:
+        return None
+    rec = db.best(program=program, generation=gen)
+    if (rec is None or "remat_policy" not in rec.config) \
+            and family is not None:
+        rec = db.best(family=family, generation=gen)
+    if rec is None:
+        return None
+    pol = rec.config.get("remat_policy")
+    return str(pol) if pol else None

@@ -18,6 +18,9 @@ Candidate axes:
     they travel inside the compile request, so no XLA_FLAGS env mutation
     (which TF106 now lints) is ever needed.
   - batch shapes for the bench ResNet-50 step.
+  - rematerialization policies (``tpuframe.mem`` registry names) for the
+    donated ResNet-50 train step, ranked on ``cost_analysis`` bytes
+    accessed against the PERF.md §6 HBM touch model (``remat_sweep``).
 
 jax is imported lazily inside functions: the candidate enumeration + VMEM
 model are pure and feed the fast test tier.
@@ -146,6 +149,19 @@ def xla_opts_candidate_sets() -> list:
     ]
 
 
+def remat_policy_candidates() -> tuple:
+    """The remat policies the offline sweep scores.  Every entry is a
+    :mod:`tpuframe.mem` registry name, so a sweep winner written to the DB
+    is directly consumable by ``TPUFRAME_REMAT_POLICY``/``mem.resolve``.
+
+    ``everything`` is omitted: under ``jax.checkpoint`` it saves every
+    residual the un-wrapped program saves, so its compiled step is
+    byte-identical to ``none`` and would only double the (4-minute) compile
+    bill for a guaranteed tie."""
+    return ("none", "dots", "dots_no_batch", "per_block",
+            "save_named(block_out)", "full")
+
+
 # ---------------------------------------------------------------------------
 # AOT lock (same lockfile as perf/_common.hold_aot_lock — libtpu ABORTS when
 # two compile-only processes initialize concurrently, so the tuner and the
@@ -265,6 +281,161 @@ def _bench_step_compile(topo_devices, batch_per_chip, xla_opts):
     desc = {"program": f"bench_resnet50_b{batch_per_chip}",
             "n_chips": n, "global_batch": global_batch}
     return compiled, desc
+
+
+def _remat_step_compile(topo_devices, batch, remat_policy):
+    """AOT-compile the DONATED ResNet-50 train step on ONE compile-only
+    device under one remat policy.  Single-chip + global batch so the
+    bytes-accessed totals line up with the PERF.md §2 anchor (1.435e11 B at
+    b=512) and the §6 touch model; donation matches what train.py/bench.py
+    actually run, unlike the bench sweep's donate=False A/B rig."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpuframe import models
+    from tpuframe.models import losses
+    from tpuframe.parallel import mesh as mesh_lib
+    from tpuframe.parallel import step as step_lib
+
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=1),
+                              devices=list(topo_devices[:1]))
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, mesh_lib.batch_spec())
+
+    model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+
+    def loss_fn(params, model_state, batch, step_rng):
+        logits, mutated = model.apply(
+            {"params": params, **model_state}, batch["image"], train=True,
+            mutable=["batch_stats"])
+        loss = losses.softmax_cross_entropy(logits, batch["label"],
+                                            label_smoothing=0.1)
+        return loss, (dict(mutated), {})
+
+    variables = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((2, 224, 224, 3), jnp.bfloat16)),
+        jax.random.key(0))
+    state = jax.eval_shape(
+        lambda v: step_lib.TrainState.create(
+            v["params"], tx,
+            model_state={"batch_stats": v["batch_stats"]}), variables)
+
+    def _repl(tree):
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl),
+            tree)
+
+    state = _repl(state)
+    batch_structs = {
+        "image": jax.ShapeDtypeStruct((batch, 224, 224, 3), jnp.bfloat16,
+                                      sharding=data),
+        "label": jax.ShapeDtypeStruct((batch,), jnp.int32, sharding=data)}
+
+    step = step_lib.make_train_step(
+        loss_fn, tx, mesh, donate=True,
+        remat_policy=None if remat_policy == "none" else remat_policy)
+    compiled = step.lower(state, batch_structs).compile()
+    desc = {"program": f"train_resnet50_b{batch}", "n_chips": 1,
+            "global_batch": batch, "donate": True,
+            "remat_policy": remat_policy}
+    return compiled, desc
+
+
+def remat_sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
+                report_path: str | None = None, batch: int = 512,
+                policies=None, log=None) -> dict:
+    """Offline remat-policy search: AOT-compile the donated ResNet-50
+    train step once per :mod:`tpuframe.mem` policy, rank on
+    ``cost_analysis`` bytes accessed (the §6 HBM-traffic objective — this
+    program is bandwidth-bound, so bytes IS the step-time lever), persist
+    every candidate to the tuning DB, and write a report with each
+    policy's bytes delta vs ``none``."""
+    import jax  # noqa: F401 — fail fast before holding the lock
+    from jax.experimental import topologies
+
+    from tpuframe import mem
+
+    policies = tuple(policies or remat_policy_candidates())
+    for pol in policies:
+        mem.validate_policy(pol)  # typo'd candidate fails before the lock
+
+    hold_aot_lock()
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    gen = roofline.generation_from_topology(topology)
+    topo = topologies.get_topology_desc(topology, platform="tpu")
+    _log(f"remat sweep on {topology}: {len(policies)} policies, "
+         f"ResNet-50 b={batch} donated train step", log)
+
+    db_path = db_path or tune_db.default_db_path()
+    db = tune_db.TuningDB.open(db_path) if os.path.exists(db_path) \
+        else tune_db.TuningDB(db_path)
+    program = f"train_resnet50_b{batch}"
+    report = {"topology": topology, "generation": gen, "batch": batch,
+              "objective": "bytes_accessed",
+              "remat": {"rows": [], "compile_errors": []}}
+
+    baseline_bytes = None
+    for pol in policies:
+        try:
+            compiled, desc = _remat_step_compile(topo.devices, batch, pol)
+        except Exception as e:  # noqa: BLE001 — record, keep sweeping
+            row = {"policy": pol,
+                   "error": f"{type(e).__name__}: {e}"[:300]}
+            report["remat"]["compile_errors"].append(row)
+            _log(f"  remat {pol}: COMPILE ERROR {row['error'][:80]}", log)
+            continue
+        pred = roofline.score_compiled(compiled, gen)
+        pred["source"] = "compiled"
+        temp_gb = None
+        try:
+            temp_gb = round(
+                compiled.memory_analysis().temp_size_in_bytes / 1e9, 2)
+        except Exception:  # noqa: BLE001 — best-effort, like score_compiled
+            pass
+        if pol == "none":
+            baseline_bytes = pred["bytes"]
+        drop = None
+        if baseline_bytes:
+            drop = round(100.0 * (1.0 - pred["bytes"] / baseline_bytes), 1)
+        pred["bytes_drop_vs_none_pct"] = drop
+        db.add({"program": program, "family": "remat_resnet50",
+                "fingerprint": tune_db.fingerprint(desc),
+                "topology": topology, "generation": gen,
+                "config": {"remat_policy": pol, "batch": batch},
+                "predicted": pred})
+        row = {"policy": pol, "gb": round(pred["bytes"] / 1e9, 2),
+               "tflops": round(pred["flops"] / 1e12, 2),
+               "predicted_ms": pred["predicted_ms"], "bound": pred["bound"],
+               "temp_gb": temp_gb, "drop_vs_none_pct": drop}
+        report["remat"]["rows"].append(row)
+        _log(f"  remat {pol}: {row['gb']} GB accessed "
+             f"({row['predicted_ms']} ms {row['bound']}-bound, "
+             f"temp {temp_gb} GB, drop {drop}%)", log)
+
+    # Rank on the sweep objective.  ``none`` compiles first, so every row
+    # has its drop; re-derive drops if the caller reordered policies.
+    rows = report["remat"]["rows"]
+    if baseline_bytes:
+        for row in rows:
+            row["drop_vs_none_pct"] = round(
+                100.0 * (1.0 - row["gb"] * 1e9 / baseline_bytes), 1)
+    rows.sort(key=lambda r: r["gb"])
+    report["winner"] = rows[0] if rows else None
+    db.save()
+    _log(f"tuning DB: {db.path} ({len(db.data['records'])} records)", log)
+    if report_path is None:
+        tag = topology.replace(":", "_").replace("x", "")
+        report_path = os.path.join(tune_db.repo_root(), "perf", "results",
+                                   f"remat_report_{tag}.json")
+    os.makedirs(os.path.dirname(report_path), exist_ok=True)
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    _log(f"report: {report_path}", log)
+    return report
 
 
 def sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
