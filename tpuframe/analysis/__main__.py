@@ -127,6 +127,16 @@ def _run_mem_check() -> int:
     return len(problems)
 
 
+def _run_serve_check() -> int:
+    from tpuframe import serve
+
+    problems = serve.check()
+    for p in problems:
+        print(f"SERVE {p}")
+    print(f"[analysis] serve self-check: {len(problems)} problem(s)")
+    return len(problems)
+
+
 def _run_obs_check() -> int:
     # Through the real CLI entry point, not an import — the gate then
     # also catches a broken ``python -m tpuframe.obs`` invocation.
@@ -169,6 +179,7 @@ def main(argv=None) -> int:
         n_findings += _run_registry_checks()
         n_findings += _run_tune_check()
         n_findings += _run_mem_check()
+        n_findings += _run_serve_check()
         n_findings += _run_obs_check()
 
     if n_findings:

@@ -108,6 +108,24 @@ def dp_budget(param_bytes: int, name: str = "dp") -> CommBudget:
     )
 
 
+def serve_decode_budget(param_bytes: int = 0,
+                        name: str = "serve-dp-decode") -> CommBudget:
+    """Plain-DP serving decode: params replicated, KV slots sharded over
+    data — NO collective has any business in the step.  Unlike training
+    DP there is no gradient to sync; every byte of cross-replica traffic
+    is the partitioner inventing communication a per-token latency
+    budget cannot afford, so the allowed set is empty (``param_bytes``
+    accepted for the uniform ``strategy_budget`` call shape; a
+    zero-collective ceiling does not scale with it)."""
+    del param_bytes
+    return CommBudget(
+        name=name,
+        allowed={},
+        notes="serving decode is replica-local by construction; any "
+              "collective above the scalar floor is a partitioning bug",
+    )
+
+
 def fsdp_budget(param_bytes: int, name: str = "resnet-fsdp") -> CommBudget:
     """ZeRO/FSDP over data x fsdp: params all-gathered before use (fwd +
     bwd re-gather ⇒ ~2x param bytes), grads reduce-scattered (~1x) and
@@ -230,6 +248,7 @@ def strategy_budget(strategy: str, **sizes) -> CommBudget:
     """Budget for a MULTICHIP strategy name from program-derived sizes."""
     builders = {
         "dp": dp_budget,
+        "serve-dp-decode": serve_decode_budget,
         "resnet-fsdp": fsdp_budget,
         "lm-seq-parallel": ring_sp_budget,
         "lm-seq-ulysses": ulysses_sp_budget,

@@ -49,6 +49,13 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          policy search, the tuning DB and the run-event record.  Route
          modules through ``mem.remat_module`` and loss functions
          through ``mem.wrap`` / the step factories' ``remat_policy=``.
+  TF109  un-bucketed compile in the serving path — a ``jax.jit``/
+         ``pjit``/``pmap`` call or a raw ``model.apply`` anywhere in
+         ``serve/`` except ``serve/engine.py`` (the one sanctioned
+         compile seam).  The scheduler/loadgen layers run per request;
+         a novel shape reaching the compiler there is a silent
+         multi-second stall mid-serving — every serving program must
+         come from the engine's bucketed AOT table.
   TF106  compiler-env mutation that can run after jax backend init —
          ``os.environ["XLA_FLAGS"] = ...`` (or ``LIBTPU_INIT_ARGS``,
          via assignment/setdefault/update/putenv) is snapshotted by the
@@ -94,6 +101,8 @@ RULES = {
              "bypassing tpuframe.obs",
     "TF108": "bare jax.checkpoint/jax.remat/nn.remat in model/step code "
              "bypassing the tpuframe.mem policy registry",
+    "TF109": "jit/apply in the serving path outside the engine's "
+             "bucketed AOT table (serve/engine.py)",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -115,6 +124,13 @@ _BARE_REMAT_CALLEES = {
     "linen.remat", "jax.ad_checkpoint.checkpoint",
     "ad_checkpoint.checkpoint",
 }
+
+# TF109: the serving path above the compile seam — request-rate code
+# where an unplanned compile is a user-visible stall.  engine.py owns
+# the bucketed AOT table and is the one sanctioned call site.
+_SERVE_SCOPE_PART = "serve/"
+_SERVE_EXEMPT_SUFFIX = "serve/engine.py"
+_SERVE_COMPILE_TAILS = {"jit", "pjit", "pmap"}
 
 # TF105a: google.cloud.storage blob/bucket methods — allowed only inside
 # the retry-wrapped data/gcs.py layer.
@@ -251,6 +267,8 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
     remat_scope = (any(p in norm_path for p in _REMAT_SCOPE_PARTS)
                    and not any(p in norm_path
                                for p in _REMAT_EXEMPT_PARTS))
+    serve_scope = (_SERVE_SCOPE_PART in norm_path
+                   and not norm_path.endswith(_SERVE_EXEMPT_SUFFIX))
 
     # TF106: a module-level compiler-env write is safe only BEFORE the
     # module-level jax import (the conftest/bootstrap pattern).
@@ -385,6 +403,18 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
                      "pallas_call without interpret= — decide "
                      "Mosaic-vs-interpret explicitly (_auto_interpret())",
                      fn)
+            if serve_scope and (
+                    tail in _SERVE_COMPILE_TAILS
+                    or (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "apply")):
+                what = (f"{callee}()" if tail in _SERVE_COMPILE_TAILS
+                        else f".apply()")
+                emit("TF109", node,
+                     f"{what} in the serving path above the compile seam "
+                     f"— every serving program must come from "
+                     f"serve/engine.py's bucketed AOT table (an "
+                     f"un-bucketed shape compiling mid-serving is a "
+                     f"multi-second stall)", fn)
             if remat_scope and callee in _BARE_REMAT_CALLEES:
                 emit("TF108", node,
                      f"{callee}() bare rematerialization in model/step "

@@ -253,6 +253,47 @@ def _build_ep(n_devices: int):
             budgets_lib.ep_budget(pb, ab), pb)
 
 
+def _build_serve_decode(n_devices: int):
+    """Plain-DP serving decode: KV slots sharded over ``data``, params
+    replicated, ONE decode step (query length 1) — the exact program
+    serve/engine.py compiles, audited for a zero-collective HLO."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpuframe.models.transformer_lm import LMConfig, TransformerLM
+    from tpuframe.parallel import mesh as mesh_lib
+    from tpuframe.serve import engine as engine_lib
+    from tpuframe.serve import kv_cache as kv
+
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=n_devices))
+    cfg = LMConfig.tiny(vocab_size=64)
+    spec = kv.spec_for_model(cfg, slots=n_devices, capacity=64)
+    model = TransformerLM(cfg)
+    decode_fn = engine_lib.make_decode_fn(model)
+
+    variables = jax.eval_shape(model.init, jax.random.key(0),
+                               jax.ShapeDtypeStruct((1, 8), jnp.int32))
+    pb = _tree_bytes(variables["params"])
+
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P("data"))
+    sds = jax.ShapeDtypeStruct
+    p_sds = jax.tree.map(lambda a: sds(a.shape, a.dtype, sharding=rep),
+                         variables["params"])
+    dtype = jnp.dtype(spec.dtype)
+    cache_sds = tuple(
+        (sds(spec.layer_shape(), dtype, sharding=row),
+         sds(spec.layer_shape(), dtype, sharding=row))
+        for _ in range(cfg.num_layers))
+    example = (p_sds,
+               sds((spec.slots, 1), jnp.int32, sharding=row),
+               sds((spec.slots,), jnp.int32, sharding=row),
+               cache_sds)
+    return (jax.jit(decode_fn), example,
+            budgets_lib.serve_decode_budget(pb), pb)
+
+
 def _build_adasum(n_devices: int):
     from tpuframe.parallel import mesh as mesh_lib, step as step_lib
 
@@ -273,6 +314,7 @@ STRATEGIES = {
     "pipeline-parallel": _build_pp,
     "expert-parallel": _build_ep,
     "dp-adasum": _build_adasum,
+    "serve-dp-decode": _build_serve_decode,
 }
 
 

@@ -74,12 +74,16 @@ def _seq_axis_bound(name: str) -> bool:
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary position embedding. x: [B, S, N, D]; positions: [S] global."""
+    """Rotary position embedding. x: [B, S, N, D]; positions: [S] global,
+    or [B, S] per-sequence (the decode path: each batch slot sits at its
+    own absolute position in its own sequence)."""
     d = x.shape[-1]
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)  # [D/2]
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, D/2]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., S, D/2]
+    if angles.ndim == 2:
+        angles = angles[None]  # shared positions -> broadcast batch dim
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., 0::2], x[..., 1::2]
     xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
     out = jnp.stack([xf1 * cos - xf2 * sin, xf1 * sin + xf2 * cos], axis=-1)
@@ -90,7 +94,8 @@ class CausalSelfAttention(nn.Module):
     cfg: LMConfig
 
     @nn.compact
-    def __call__(self, x, positions, *, train: bool):
+    def __call__(self, x, positions, *, train: bool, kv_cache=None,
+                 cache_length=None, decode: bool = False):
         from tpuframe.ops import attention as attn_ops
         from tpuframe.ops import seq_parallel
 
@@ -101,6 +106,49 @@ class CausalSelfAttention(nn.Module):
         q = rope(dense("query")(x), positions, c.rope_theta)
         k = rope(dense("key")(x), positions, c.rope_theta)
         v = dense("value")(x)
+
+        if kv_cache is not None:
+            # Serving path (tpuframe.serve): the cache stores post-RoPE
+            # keys, so a wrapped ring slot keeps its original absolute
+            # position and wraparound degrades to sliding-window
+            # attention rather than silent position corruption.
+            k_cache, v_cache = kv_cache
+            cap = k_cache.shape[1]
+            if decode:
+                # Ring write: one new token per sequence at its own
+                # write index (modulo capacity), then query-length-1
+                # attention over the valid prefix.
+                idx = (cache_length % cap).astype(jnp.int32)
+
+                def _write(cache, vec, i):
+                    return lax.dynamic_update_slice(cache, vec, (i, 0, 0))
+
+                k_cache = jax.vmap(_write)(k_cache, k, idx)
+                v_cache = jax.vmap(_write)(v_cache, v, idx)
+                valid = jnp.minimum(cache_length + 1, cap)
+                y = attn_ops.decode_attention(q, k_cache, v_cache,
+                                              lengths=valid,
+                                              impl=c.attn_impl)
+            else:
+                # Prefill: identical math to the training forward
+                # (causal attention over the left-aligned prompt) plus
+                # the cache write at [0:S] — golden-logits parity with
+                # the training path is by construction, not by test
+                # luck (the test still checks it).
+                s = x.shape[1]
+                if s > cap:
+                    raise ValueError(f"prompt bucket {s} exceeds "
+                                     f"KV-cache capacity {cap}")
+                k_cache = lax.dynamic_update_slice(
+                    k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+                v_cache = lax.dynamic_update_slice(
+                    v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+                y = attn_ops.multihead_attention(q, k, v, causal=True,
+                                                 impl=c.attn_impl)
+            out = nn.DenseGeneral(c.hidden_size, axis=(-2, -1),
+                                  use_bias=False, dtype=c.jnp_dtype,
+                                  name="out")(y)
+            return out, (k_cache, v_cache)
 
         mode = c.seq_mode
         if mode != "none" and not _seq_axis_bound(c.seq_axis):
@@ -161,11 +209,19 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, *, kv_cache=None, cache_length=None,
+                 decode: bool = False):
         c = self.cfg
         train = self.train
         h = nn.LayerNorm(use_bias=False, name="attn_ln")(x)
-        h = CausalSelfAttention(c, name="attn")(h, positions, train=train)
+        new_cache = None
+        if kv_cache is not None:
+            h, new_cache = CausalSelfAttention(c, name="attn")(
+                h, positions, train=train, kv_cache=kv_cache,
+                cache_length=cache_length, decode=decode)
+        else:
+            h = CausalSelfAttention(c, name="attn")(h, positions,
+                                                    train=train)
         h = nn.Dropout(c.dropout, deterministic=not train)(h)
         x = x + h
         h = nn.LayerNorm(use_bias=False, name="mlp_ln")(x)
@@ -178,7 +234,10 @@ class Block(nn.Module):
             h = nn.Dense(c.hidden_size, use_bias=False, dtype=c.jnp_dtype,
                          name="down")(h)
         h = nn.Dropout(c.dropout, deterministic=not train)(h)
-        return x + h
+        x = x + h
+        if kv_cache is not None:
+            return x, new_cache
+        return x
 
 
 class ScanBlockLM(nn.Module):
@@ -281,15 +340,55 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, *, train: bool = False,
-                 hidden_only: bool = False):
+                 hidden_only: bool = False, kv_cache=None,
+                 cache_length=None, decode: bool = False):
         """``hidden_only=True`` returns the post-final-LayerNorm hidden
         states ``[B, S, H]`` instead of logits — the input the chunked
         fused cross-entropy (tpuframe.ops.fused_xent) consumes together
         with the ``lm_head`` kernel, so the ``[B, S, V]`` logits never
         materialize in HBM.  init() must run with the default full path so
-        the lm_head parameters exist."""
+        the lm_head parameters exist.
+
+        Serving path (tpuframe.serve): ``kv_cache`` is a per-layer tuple
+        of ``(k, v)`` pairs, each ``[B, capacity, N, D]``; ``cache_length``
+        ``[B]`` counts tokens already cached.  ``decode=False`` prefills a
+        left-aligned (padded) prompt — same math as the training forward —
+        writing every layer's K/V; ``decode=True`` runs ONE new token per
+        sequence through the query-length-1 attention entry
+        (ops.attention.decode_attention) at its own ring write index.
+        Returns ``(logits, new_kv_cache)``.  Sequence parallelism and MoE
+        do not compose with the cache path (serving shards over batch)."""
         c = self.cfg
         s_local = input_ids.shape[-1]
+        if kv_cache is not None:
+            if c.seq_mode != "none" or c.moe_experts > 0:
+                raise ValueError("the KV-cache path serves dense batch-"
+                                 "parallel configs only; seq_mode must be"
+                                 " 'none' and moe off")
+            if len(kv_cache) != c.num_layers:
+                raise ValueError(f"kv_cache has {len(kv_cache)} layers; "
+                                 f"model has {c.num_layers}")
+            if decode:
+                if s_local != 1:
+                    raise ValueError(f"decode wants one token per "
+                                     f"sequence, got S={s_local}")
+                positions = cache_length[:, None]  # [B, 1] absolute
+            else:
+                positions = jnp.arange(s_local)
+            x = nn.Embed(c.vocab_size, c.hidden_size,
+                         name="embed")(input_ids)
+            x = x.astype(c.jnp_dtype)
+            new_caches = []
+            for i in range(c.num_layers):
+                x, layer_cache = Block(c, False, False,
+                                       name=f"block_{i}")(
+                    x, positions, kv_cache=kv_cache[i],
+                    cache_length=cache_length, decode=decode)
+                new_caches.append(layer_cache)
+            x = nn.LayerNorm(use_bias=False, name="final_ln")(x)
+            logits = nn.Dense(c.vocab_size, use_bias=False,
+                              name="lm_head")(x)
+            return logits.astype(jnp.float32), tuple(new_caches)
         # Global positions: offset by this device's chunk index when the
         # sequence dimension is sharded over the seq axis.
         start = 0

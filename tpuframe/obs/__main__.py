@@ -58,10 +58,22 @@ def _load(directory: str) -> list[dict]:
 def _sample_paths() -> list[str]:
     """The repo-shipped sample event files (docs/samples/) — the
     selfcheck's default target, so a schema change that strands old logs
-    fails CI before it ships."""
+    fails CI before it ships.  One run per directory: subdirectories
+    hold separate runs (e.g. ``samples/serve/``) that must validate but
+    must NOT merge into the training run's attempt timeline."""
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    return events_lib.event_files(os.path.join(root, "docs", "samples"))
+    base = os.path.join(root, "docs", "samples")
+    paths = events_lib.event_files(base)
+    try:
+        subdirs = sorted(os.listdir(base))
+    except (FileNotFoundError, NotADirectoryError):
+        subdirs = []
+    for name in subdirs:
+        sub = os.path.join(base, name)
+        if os.path.isdir(sub):
+            paths.extend(events_lib.event_files(sub))
+    return paths
 
 
 def cmd_selfcheck(directory: str | None) -> int:
@@ -132,6 +144,21 @@ def cmd_summarize(directory: str, generation: str | None) -> int:
         print("counters at run_end:")
         for k, v in sorted(end["counters"].items()):
             print(f"  {k} = {v}")
+
+    serve = goodput_lib.serve_stats(merged)
+    if serve is not None:
+        print(f"serving: {serve['requests']} request(s), "
+              f"{serve['steps']} step(s), "
+              f"{serve['output_tokens']} output token(s)")
+        for key, label in (("ttft_ms", "TTFT"), ("tpot_ms", "TPOT")):
+            pcts = serve[key]
+            if pcts:
+                print(f"  {label} (ms): " + " ".join(
+                    f"{q}={pcts[q]:.2f}" for q in ("p50", "p90", "p99")))
+        if serve["tokens_per_s"] is not None:
+            print(f"  tokens/s: {serve['tokens_per_s']:.2f} "
+                  f"({serve['tokens_per_s_per_chip']:.2f} per chip, "
+                  f"{serve['n_devices']} device(s))")
     return 0
 
 

@@ -37,6 +37,11 @@ Event types (see ``REQUIRED_FIELDS`` for the per-type contract):
                  (policy name, resolution source, predicted bytes)
   run_end        final step, wall s, goodput buckets, MFU, counters,
                  peak HBM per device
+  serve_step     one continuous-batching scheduler step (active slots,
+                 admissions, tokens produced, queue depth)
+  serve_request  a served request retired (prompt/output token counts,
+                 TTFT/TPOT ms)
+  serve_summary  end-of-loadgen rollup (requests, tokens/sec, devices)
   ============== ========================================================
 
 Emission is *best-effort everywhere*: ``emit()`` is a no-op until
@@ -79,6 +84,9 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "devmem": ("devices",),
     "remat_policy": ("policy", "source"),
     "run_end": ("final_step", "wall_s", "goodput"),
+    "serve_step": ("step", "wall_ms", "active"),
+    "serve_request": ("id", "prompt_tokens", "output_tokens", "ttft_ms"),
+    "serve_summary": ("requests", "tokens_per_s"),
 }
 
 _ENVELOPE = ("schema", "type", "t", "host", "proc", "attempt")

@@ -394,3 +394,65 @@ def find_anomalies(events: list[dict], *, slow_factor: float = 3.0,
                 "detail": f"attempt {att} never wrote run_end (died or "
                           f"still running); last seen step: {last}"})
     return findings
+
+
+# ---------------------------------------------------------------------------
+# Serving latency accounting (tpuframe.serve's serve_* events).
+# ---------------------------------------------------------------------------
+
+def _pct(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return float(sorted_vals[idx])
+
+
+def serve_stats(events: list) -> dict | None:
+    """TTFT/TPOT percentiles and token throughput from ``serve_*``
+    events; None when the log carries no serving traffic (so training
+    summaries stay serving-free).  TTFT = arrival to first token (the
+    prefill + queueing number); TPOT = per-token decode cadence after
+    the first.  tokens/sec/chip divides by the ``serve_summary`` device
+    count — the serving analogue of MFU's per-chip normalization."""
+    reqs = [r for r in events if r.get("type") == "serve_request"]
+    steps = [r for r in events if r.get("type") == "serve_step"]
+    summary = next((r for r in reversed(events)
+                    if r.get("type") == "serve_summary"), None)
+    if not (reqs or steps or summary is not None):
+        return None
+
+    ttft = sorted(float(r["ttft_ms"]) for r in reqs
+                  if r.get("ttft_ms") is not None)
+    tpot = sorted(float(r["tpot_ms"]) for r in reqs
+                  if r.get("tpot_ms") is not None)
+
+    tokens_per_s = None
+    n_devices = 1
+    if summary is not None:
+        n_devices = max(1, int(summary.get("n_devices") or 1))
+        if summary.get("tokens_per_s") is not None:
+            tokens_per_s = float(summary["tokens_per_s"])
+    if tokens_per_s is None and steps:
+        # No summary (run died mid-serve): reconstruct from the steps.
+        toks = sum(int(r.get("produced") or 0) + int(r.get("admitted") or 0)
+                   for r in steps)
+        wall_s = sum(float(r.get("wall_ms") or 0.0) for r in steps) / 1e3
+        tokens_per_s = toks / wall_s if wall_s > 0 else None
+
+    return {
+        "requests": len(reqs),
+        "steps": len(steps),
+        "output_tokens": sum(int(r.get("output_tokens") or 0)
+                             for r in reqs),
+        "ttft_ms": {q: round(_pct(ttft, v), 3) for q, v in
+                    (("p50", 0.5), ("p90", 0.9), ("p99", 0.99))}
+        if ttft else None,
+        "tpot_ms": {q: round(_pct(tpot, v), 3) for q, v in
+                    (("p50", 0.5), ("p90", 0.9), ("p99", 0.99))}
+        if tpot else None,
+        "tokens_per_s": round(tokens_per_s, 2)
+        if tokens_per_s is not None else None,
+        "tokens_per_s_per_chip": round(tokens_per_s / n_devices, 2)
+        if tokens_per_s is not None else None,
+        "n_devices": n_devices,
+    }

@@ -56,6 +56,38 @@ def multihead_attention(
                           dropout_rng=dropout_rng)
 
 
+def decode_attention(
+    q: jax.Array,        # [B, 1, N, D] — the query-length-1 decode entry
+    k_cache: jax.Array,  # [B, S_kv, N, D] — KV-cache keys (post-RoPE)
+    v_cache: jax.Array,  # [B, S_kv, N, D]
+    *,
+    lengths: jax.Array,  # [B] int32 — valid cache entries per sequence
+    impl: str | None = None,
+) -> jax.Array:
+    """Decode-mode attention: one new query token against the KV-cache.
+
+    The serving counterpart of :func:`multihead_attention`
+    (tpuframe.serve).  Causality is a *length mask*, not a triangle: the
+    cache holds exactly the tokens the new position may attend, padded to
+    the cache's bucketed capacity, so the mask is ``arange(S_kv) <
+    lengths`` per sequence.  The flash kernel's advantage — keeping the
+    S×S score matrix out of HBM — is moot at query length 1 (scores are
+    [B, N, 1, S_kv], KV-cache-row-sized); the einsum formulation IS the
+    memory-optimal decode program, and every HBM byte the step moves is
+    cache+params, which the serve roofline (tune/roofline.decode_score)
+    models directly.  ``impl`` is accepted for parity with the training
+    entry: pallas falls back to xla here because ``flash_attention
+    .supported`` rejects query length 1 (sublane-unaligned), by design.
+    """
+    if q.ndim != 4 or q.shape[1] != 1:
+        raise ValueError(f"decode_attention wants q [B, 1, N, D]; "
+                         f"got {q.shape}")
+    s_kv = k_cache.shape[1]
+    mask = (jnp.arange(s_kv)[None, :] < lengths[:, None]).astype(jnp.int32)
+    return multihead_attention(q, k_cache, v_cache, mask=mask,
+                               causal=False, impl=impl)
+
+
 def _xla_attention(q, k, v, *, mask, dropout_rate, dropout_rng):
     depth = q.shape[-1]
     scale = 1.0 / jnp.sqrt(depth).astype(q.dtype)

@@ -1,0 +1,79 @@
+"""tpuframe.serve — AOT-compiled inference with KV-cache + continuous batching.
+
+The serving counterpart of the training stack: a paged/ring KV-cache
+(``kv_cache``), an explicit prefill/decode split compiled ahead-of-time
+against a closed set of bucketed shapes (``engine``), continuous
+batching over fixed decode slots (``scheduler``), and an open-loop
+load generator (``loadgen``).  Decode block sizes and bucket sets
+resolve env > tune-DB > default, same precedence as every other tuned
+knob (PR 3/5).
+
+Imports stay lazy — ``check()`` runs inside the analysis gate where jax
+may be pinned to CPU, and nothing here should drag in flax at import
+time.
+"""
+
+from __future__ import annotations
+
+__all__ = ["check"]
+
+
+def check() -> list:
+    """Self-check for the analysis gate (``python -m tpuframe.analysis``).
+
+    Pure-host checks only (no model compiles — the gate stays fast):
+    resolved bucket/block invariants, the TF109 lint over the serve
+    package itself, and a sanity pass on the decode roofline.  Returns
+    problem strings; [] means healthy.
+    """
+    import pathlib
+
+    problems: list = []
+
+    # 1. Resolved shape-bucket invariants.
+    from tpuframe.serve import kv_cache as kv
+
+    try:
+        block = kv.resolve_decode_block()
+        buckets = kv.resolve_buckets()
+        capacity = kv.capacity_for(max(buckets), block)
+        problems += [f"serve buckets: {p}"
+                     for p in kv.check_buckets(buckets, capacity)]
+        if block < 8 or block % 8:
+            problems.append(f"decode block {block} not a multiple of 8")
+    except Exception as exc:  # noqa: BLE001 — resolution itself broke
+        problems.append(f"serve bucket resolution failed: {exc!r}")
+
+    # 2. TF109 over our own files: no un-bucketed jit/apply above the
+    #    engine seam.
+    from tpuframe.analysis import source_lint
+
+    pkg = pathlib.Path(__file__).parent
+    try:
+        findings = source_lint.lint_paths([pkg])
+    except Exception as exc:  # noqa: BLE001
+        problems.append(f"serve lint crashed: {exc!r}")
+        findings = []
+    problems += [f"serve lint: {f}" for f in findings
+                 if f.rule == "TF109"]
+
+    # 3. Decode roofline is monotone in the cached-context size (more KV
+    #    traffic can only slow a memory-bound decode down).
+    from tpuframe.tune import roofline
+
+    try:
+        short = roofline.decode_score(
+            param_bytes=int(50e6), kv_bytes_per_token=4096,
+            slots=8, context=256)
+        long_ = roofline.decode_score(
+            param_bytes=int(50e6), kv_bytes_per_token=4096,
+            slots=8, context=4096)
+        if not short.tokens_per_s_per_chip > long_.tokens_per_s_per_chip:
+            problems.append(
+                "decode roofline not monotone in context length: "
+                f"{short.tokens_per_s_per_chip} <= "
+                f"{long_.tokens_per_s_per_chip}")
+    except Exception as exc:  # noqa: BLE001
+        problems.append(f"decode roofline sanity failed: {exc!r}")
+
+    return problems

@@ -1,0 +1,159 @@
+"""Continuous batching over the engine's fixed decode slots.
+
+The decode executable always runs all ``slots`` sequences (its shape is
+compiled once); *continuous batching* means requests are admitted into
+and retired from those slots at step boundaries, so a long generation
+never blocks a short one behind it — the batching lesson of the TPU-pod
+scaling papers (arXiv:1909.09756 / 2011.03641) applied to a decode loop:
+keep the chip-filling shape constant and move the *work* in and out.
+
+Per step, in order:
+
+  1. admit   — for every free slot, pop the oldest pending request,
+               prefill it (its bucket's executable), insert into the
+               slot.  TTFT is measured here: arrival -> first token.
+  2. decode  — ONE decode step over all slots (active or not; inactive
+               lanes compute garbage, which costs less than a recompile
+               or a per-slot branch).
+  3. retire  — requests that hit ``max_new_tokens`` or the EOS id leave
+               their slot free for the next admit.
+
+Observability rides obs v2: a typed ``serve_step`` event per step and a
+``serve_request`` event per retirement (TTFT/TPOT, token counts) — the
+offline analyzer (``python -m tpuframe.obs summarize``) computes the
+percentiles and tokens/sec/chip from these, beside the training MFU.
+
+This file is above the compile seam: it calls only the engine's AOT
+executables (lint TF109 keeps ``jit``/``.apply`` out of here).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from tpuframe.obs import events as obs_events
+
+
+@dataclass
+class Request:
+    """One generation request and its lifecycle record."""
+
+    rid: int
+    prompt: list
+    max_new_tokens: int = 16
+    arrival_t: float = 0.0            # scheduler clock, seconds
+    # -- filled in by the scheduler --
+    first_token_t: float | None = None
+    done_t: float | None = None
+    tokens: list = field(default_factory=list)   # generated tokens
+
+    @property
+    def done(self) -> bool:
+        return self.done_t is not None
+
+    def ttft_ms(self) -> float | None:
+        if self.first_token_t is None:
+            return None
+        return 1e3 * (self.first_token_t - self.arrival_t)
+
+    def tpot_ms(self) -> float | None:
+        """Time per output token AFTER the first (the decode cadence)."""
+        if self.done_t is None or self.first_token_t is None \
+                or len(self.tokens) < 2:
+            return None
+        return 1e3 * (self.done_t - self.first_token_t) \
+            / (len(self.tokens) - 1)
+
+
+class Scheduler:
+    """Continuous-batching request loop over one :class:`LMEngine`.
+
+    ``clock`` is injectable (fake-clock tests, the GoodputMeter idiom);
+    the default is the host monotonic clock.
+    """
+
+    def __init__(self, engine, *, clock=time.perf_counter):
+        self.engine = engine
+        self._clock = clock
+        self.pending: list = []                 # FIFO of Request
+        self.active: list = [None] * engine.slots
+        self.completed: list = []
+        self.step_count = 0
+        self.tokens_generated = 0
+
+    def submit(self, request: Request) -> None:
+        if len(request.prompt) > max(self.engine.prompt_buckets):
+            # Admission control: reject ahead of any shape decision —
+            # never invent a new compile shape for an oversized prompt.
+            raise ValueError(
+                f"request {request.rid}: prompt {len(request.prompt)} "
+                f"exceeds largest bucket "
+                f"{max(self.engine.prompt_buckets)}")
+        self.pending.append(request)
+
+    def has_work(self) -> bool:
+        return bool(self.pending) or any(r is not None
+                                         for r in self.active)
+
+    def step(self) -> int:
+        """One scheduler step (admit + decode + retire).  Returns the
+        number of live tokens produced this step."""
+        t0 = self._clock()
+        admitted = 0
+        for slot in range(self.engine.slots):
+            if self.active[slot] is not None or not self.pending:
+                continue
+            req = self.pending.pop(0)
+            first_tok, pcache, length = self.engine.prefill(req.prompt)
+            self.engine.insert(slot, pcache, length, first_tok)
+            req.first_token_t = self._clock()
+            req.tokens.append(first_tok)
+            self.active[slot] = req
+            admitted += 1
+            if self._finished(req, first_tok):
+                self._retire(slot)
+
+        produced = 0
+        if any(r is not None for r in self.active):
+            toks = self.engine.decode_step()
+            now = self._clock()
+            for slot, req in enumerate(self.active):
+                if req is None:
+                    continue
+                tok = int(toks[slot])
+                req.tokens.append(tok)
+                produced += 1
+                if self._finished(req, tok):
+                    req.done_t = now
+                    self._retire(slot)
+        self.step_count += 1
+        self.tokens_generated += produced + admitted
+        obs_events.emit(
+            "serve_step", step=self.step_count,
+            wall_ms=round(1e3 * (self._clock() - t0), 3),
+            active=sum(r is not None for r in self.active),
+            admitted=admitted, produced=produced,
+            queued=len(self.pending))
+        return produced + admitted
+
+    # -- internals ----------------------------------------------------------
+
+    def _finished(self, req: Request, tok: int) -> bool:
+        return (len(req.tokens) >= req.max_new_tokens
+                or (self.engine.eos_id is not None
+                    and tok == self.engine.eos_id))
+
+    def _retire(self, slot: int) -> None:
+        req = self.active[slot]
+        self.active[slot] = None
+        if req.done_t is None:
+            req.done_t = self._clock()
+        self.completed.append(req)
+        obs_events.emit(
+            "serve_request", id=req.rid,
+            prompt_tokens=len(req.prompt),
+            output_tokens=len(req.tokens),
+            ttft_ms=round(req.ttft_ms() or 0.0, 3),
+            tpot_ms=round(req.tpot_ms(), 3)
+            if req.tpot_ms() is not None else None)

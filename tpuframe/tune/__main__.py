@@ -2,6 +2,7 @@
 
     python -m tpuframe.tune sweep --topology v5e:2x2   # the whole thing
     python -m tpuframe.tune sweep --remat               # remat policy search
+    python -m tpuframe.tune sweep --serve               # serving decode grid
     python -m tpuframe.tune show                        # ranked DB contents
     python -m tpuframe.tune check                       # CI self-check
 
@@ -44,6 +45,12 @@ def _ensure_cpu_env() -> None:
 def _cmd_sweep(args) -> int:
     from tpuframe.tune import search
 
+    if args.serve:
+        search.serve_sweep(args.topology, db_path=args.db,
+                           report_path=args.report,
+                           blocks=tuple(args.serve_blocks),
+                           slots_grid=tuple(args.serve_slots))
+        return 0
     if args.remat:
         search.remat_sweep(args.topology, db_path=args.db,
                            report_path=args.report,
@@ -107,6 +114,14 @@ def main(argv=None) -> int:
     sw.add_argument("--blocks", type=int, nargs="+",
                     default=[128, 256, 512])
     sw.add_argument("--bench-batches", type=int, nargs="+", default=[256])
+    sw.add_argument("--serve", action="store_true",
+                    help="sweep serving decode block sizes x slot counts "
+                         "(serve_lm family) over the AOT decode step "
+                         "instead of the fa/xla-opts grid")
+    sw.add_argument("--serve-blocks", type=int, nargs="+",
+                    default=[64, 128, 256], metavar="BLOCK")
+    sw.add_argument("--serve-slots", type=int, nargs="+",
+                    default=[8, 16], metavar="SLOTS")
     sw.add_argument("--remat", action="store_true",
                     help="sweep tpuframe.mem remat policies over the "
                          "donated ResNet-50 train step (bytes objective) "

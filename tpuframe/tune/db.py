@@ -140,6 +140,11 @@ class Record:
             env["TPUFRAME_BENCH_BATCH"] = str(cfg["batch"])
         if "remat_policy" in cfg:
             env["TPUFRAME_REMAT_POLICY"] = str(cfg["remat_policy"])
+        if "decode_block" in cfg:
+            env["TPUFRAME_DECODE_BLOCK"] = str(cfg["decode_block"])
+        if cfg.get("prompt_buckets"):
+            env["TPUFRAME_SERVE_BUCKETS"] = ",".join(
+                str(b) for b in cfg["prompt_buckets"])
         return env
 
     def _key(self):
@@ -373,3 +378,40 @@ def resolve_remat_policy(program: str,
         return None
     pol = rec.config.get("remat_policy")
     return str(pol) if pol else None
+
+
+def resolve_decode_block(default: int = 128) -> int:
+    """Serving KV-capacity granularity: env (``TPUFRAME_DECODE_BLOCK``)
+    > tune-DB ``serve_lm`` winner > default.  Same generation gate as
+    every other knob — plain CPU runs see the hard default."""
+    block = default
+    gen = target_generation()
+    if gen is not None:
+        db = _open_for_resolution()
+        if db is not None:
+            rec = db.best(family="serve_lm", generation=gen)
+            if rec is not None and "decode_block" in rec.config:
+                block = int(rec.config["decode_block"])
+    env = os.environ.get("TPUFRAME_DECODE_BLOCK")
+    if env and env.strip():
+        block = int(env)
+    return block
+
+
+def resolve_serve_buckets(default: tuple) -> tuple:
+    """Serving prompt-length buckets: env (``TPUFRAME_SERVE_BUCKETS``,
+    comma-separated) > tune-DB ``serve_lm`` winner > default."""
+    buckets = tuple(default)
+    gen = target_generation()
+    if gen is not None:
+        db = _open_for_resolution()
+        if db is not None:
+            rec = db.best(family="serve_lm", generation=gen)
+            if rec is not None and rec.config.get("prompt_buckets"):
+                buckets = tuple(int(b)
+                                for b in rec.config["prompt_buckets"])
+    env = os.environ.get("TPUFRAME_SERVE_BUCKETS")
+    if env and env.strip():
+        from tpuframe.serve.kv_cache import parse_buckets
+        buckets = parse_buckets(env)
+    return buckets

@@ -99,6 +99,67 @@ def score(generation: str, *, flops: float, bytes_accessed: float,
     }
 
 
+@dataclasses.dataclass(frozen=True)
+class DecodeScore:
+    """Roofline upper bound on serving decode throughput for one chip."""
+
+    generation: str
+    bytes_params: float        # weights read once per step
+    bytes_kv: float            # KV cache read (+ the step's writes)
+    bytes_per_step: float
+    flops_per_step: float
+    t_step_ms: float           # lower bound on one decode step
+    bound: str                 # "hbm" | "mxu"
+    tokens_per_s: float        # slots / t_step — one chip, upper bound
+    tokens_per_s_per_chip: float
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def decode_score(*, param_bytes: float, kv_bytes_per_token: float,
+                 slots: int, context: int, generation: str = "v5e",
+                 param_dtype_bytes: int = 4) -> DecodeScore:
+    """Analytic tokens/sec UPPER bound for the batched decode step.
+
+    Decode at query length 1 is memory-bound on every current TPU: the
+    step must stream every weight byte once (batch amortizes it across
+    ``slots`` tokens but not below one full read) plus each slot's live
+    KV window (``context`` cached tokens at ``kv_bytes_per_token`` =
+    ``CacheSpec.bytes_per_token()``, all layers, K+V) and write this
+    step's new KV entry.  FLOPs are the weight matmuls (2 * params per
+    token); attention FLOPs at query length 1 are negligible beside
+    them, keeping the bound honest (lower t, higher tokens/sec).
+
+    One chip, replica-local (the ``serve-dp-decode`` audit proves plain
+    DP serving adds no collective time) — so the per-chip number IS the
+    chip number, and fleet throughput scales linearly until the
+    scheduler runs out of requests.
+    """
+    if slots < 1 or context < 0:
+        raise ValueError(f"need slots >= 1, context >= 0; "
+                         f"got {slots}, {context}")
+    hw = get_hardware(generation)
+    bytes_kv = float(slots * (context + 1) * kv_bytes_per_token)
+    bytes_per_step = float(param_bytes) + bytes_kv
+    flops = 2.0 * (float(param_bytes) / param_dtype_bytes) * slots
+    t_hbm_ms = bytes_per_step / hw.hbm_bytes_per_s * 1e3
+    t_mxu_ms = flops / hw.bf16_flops * 1e3
+    t_step_ms = max(t_hbm_ms, t_mxu_ms)
+    tokens_per_s = slots / (t_step_ms / 1e3) if t_step_ms > 0 else 0.0
+    return DecodeScore(
+        generation=hw.generation,
+        bytes_params=float(param_bytes),
+        bytes_kv=bytes_kv,
+        bytes_per_step=bytes_per_step,
+        flops_per_step=flops,
+        t_step_ms=round(t_step_ms, 4),
+        bound="hbm" if t_hbm_ms >= t_mxu_ms else "mxu",
+        tokens_per_s=round(tokens_per_s, 2),
+        tokens_per_s_per_chip=round(tokens_per_s, 2),
+    )
+
+
 def contains_scan(hlo_text: str) -> bool:
     """§8 detector: a lowered-to-TPU ``lax.scan`` shows up as an HLO while
     loop.  (Interpret-mode pallas also lowers as a while loop — one more

@@ -572,3 +572,214 @@ def sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
         f.write("\n")
     _log(f"report: {report_path}", log)
     return report
+
+
+# ---------------------------------------------------------------------------
+# Serving sweep: decode block sizes x slot counts for the serve_lm family.
+# ---------------------------------------------------------------------------
+
+def serve_bucket_sets(block: int, *, context_blocks: int = 4) -> tuple:
+    """Prompt buckets derived from one decode block: powers of two up to
+    the capacity (``context_blocks * block``) — the closed shape set the
+    engine compiles for this block choice."""
+    capacity = context_blocks * block
+    buckets, b = [], block
+    while b <= capacity:
+        buckets.append(b)
+        b *= 2
+    return tuple(buckets), capacity
+
+
+def _serve_decode_compile(topo_devices, cfg, slots: int, capacity: int):
+    """AOT-compile the serving decode step (query length 1, donated KV)
+    on ONE compile-only device — the exact program serve/engine.py
+    builds, so the scored bytes are the served bytes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpuframe.models.transformer_lm import TransformerLM
+    from tpuframe.parallel import mesh as mesh_lib
+    from tpuframe.serve import engine as engine_lib
+    from tpuframe.serve import kv_cache as kv
+
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=1),
+                              devices=list(topo_devices[:1]))
+    repl = NamedSharding(mesh, P())
+    model = TransformerLM(cfg)
+    spec = kv.spec_for_model(cfg, slots=slots, capacity=capacity)
+    decode_fn = engine_lib.make_decode_fn(model)
+
+    variables = jax.eval_shape(model.init, jax.random.key(0),
+                               jax.ShapeDtypeStruct((1, 8), jnp.int32))
+
+    def _sds(s):
+        return jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl)
+
+    p_sds = jax.tree.map(_sds, variables["params"])
+    param_bytes = sum(
+        int(_prod(s.shape)) * jnp.dtype(s.dtype).itemsize
+        for s in jax.tree_util.tree_leaves(variables["params"]))
+    dtype = jnp.dtype(spec.dtype)
+
+    def sds(shape, dt):
+        return jax.ShapeDtypeStruct(shape, dt, sharding=repl)
+
+    cache_sds = tuple((sds(spec.layer_shape(), dtype),
+                       sds(spec.layer_shape(), dtype))
+                      for _ in range(cfg.num_layers))
+    compiled = jax.jit(decode_fn, donate_argnums=(1, 2, 3)).lower(
+        p_sds, sds((slots, 1), jnp.int32), sds((slots,), jnp.int32),
+        cache_sds).compile()
+    desc = {"program": f"serve_decode_h{cfg.hidden_size}_"
+                       f"l{cfg.num_layers}",
+            "slots": slots, "capacity": capacity, "n_chips": 1,
+            "dtype": cfg.dtype, "donate": True}
+    return compiled, desc, param_bytes, spec
+
+
+def _prod(shape) -> int:
+    out = 1
+    for d in shape:
+        out *= int(d)
+    return out
+
+
+def serve_sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
+                report_path: str | None = None,
+                blocks=(64, 128, 256), slots_grid=(8, 16),
+                context_blocks: int = 4, log=None) -> dict:
+    """Offline serving sweep: decode block sizes x slot counts for the
+    ``serve_lm`` family, on a mid-size decoder (the smallest config
+    where the params-vs-KV traffic split is representative).
+
+    Objective is predicted ms PER TOKEN (step roofline / slots) — lower
+    is better and ranks identically to tokens/sec/chip, but fits the
+    DB's ``predicted_ms``-ascending ``_rank()`` contract directly.  Each
+    row carries both the compiled ``cost_analysis`` roofline (when this
+    jax can AOT-compile for the topology) and the analytic decode model
+    (``roofline.decode_score``); compile failures degrade to the
+    analytic row tagged ``source="analytic"`` — same SKIP-not-lie
+    contract as the flash-attention grid above.
+    """
+    import jax  # noqa: F401 — fail fast before holding the lock
+    from jax.experimental import topologies
+
+    from tpuframe.models.transformer_lm import LMConfig
+    from tpuframe.serve import kv_cache as kv_lib
+
+    hold_aot_lock()
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    gen = roofline.generation_from_topology(topology)
+    topo = topologies.get_topology_desc(topology, platform="tpu")
+    _log(f"serve sweep on {topology}: blocks {tuple(blocks)} x slots "
+         f"{tuple(slots_grid)}", log)
+
+    cfg = LMConfig(vocab_size=8192, hidden_size=512, num_layers=4,
+                   num_heads=8, intermediate_size=2048,
+                   max_seq=context_blocks * max(blocks),
+                   dtype="bfloat16", attn_impl="xla")
+    program = f"serve_decode_h{cfg.hidden_size}_l{cfg.num_layers}"
+
+    db_path = db_path or tune_db.default_db_path()
+    db = tune_db.TuningDB.open(db_path) if os.path.exists(db_path) \
+        else tune_db.TuningDB(db_path)
+    report = {"topology": topology, "generation": gen, "program": program,
+              "objective": "predicted_ms_per_token",
+              "model": {"hidden": cfg.hidden_size,
+                        "layers": cfg.num_layers, "heads": cfg.num_heads,
+                        "dtype": cfg.dtype},
+              "serve": {"rows": [], "compile_errors": []}}
+
+    for block in blocks:
+        buckets, capacity = serve_bucket_sets(
+            block, context_blocks=context_blocks)
+        for slots in slots_grid:
+            spec = kv_lib.spec_for_model(cfg, slots=slots,
+                                         capacity=capacity)
+            analytic = roofline.decode_score(
+                param_bytes=_model_param_bytes(cfg),
+                kv_bytes_per_token=spec.bytes_per_token(),
+                slots=slots, context=capacity, generation=gen,
+                param_dtype_bytes=2)
+            pred = None
+            try:
+                compiled, desc, pb, _ = _serve_decode_compile(
+                    topo.devices, cfg, slots, capacity)
+                pred = roofline.score_compiled(compiled, gen)
+                pred["source"] = "compiled"
+            except Exception as e:  # noqa: BLE001 — record, keep sweeping
+                err = f"{type(e).__name__}: {e}"[:300]
+                report["serve"]["compile_errors"].append(
+                    {"decode_block": block, "slots": slots, "error": err})
+                _log(f"  serve block={block} slots={slots}: COMPILE "
+                     f"FALLBACK {err[:80]}", log)
+                desc = {"program": program, "slots": slots,
+                        "capacity": capacity, "dtype": cfg.dtype}
+                pred = roofline.score(
+                    gen, flops=analytic.flops_per_step,
+                    bytes_accessed=analytic.bytes_per_step)
+                pred["source"] = "analytic"
+            # Per-token objective + the throughput bound the report and
+            # obs comparisons use.
+            pred["predicted_ms"] = round(pred["predicted_ms"]
+                                         / max(slots, 1), 4)
+            pred["tokens_per_s_per_chip"] = round(
+                slots / (pred["predicted_ms"] * 1e-3 * slots), 2) \
+                if pred["predicted_ms"] > 0 else None
+            pred["analytic_tokens_per_s_per_chip"] = \
+                analytic.tokens_per_s_per_chip
+            config = {"decode_block": int(block),
+                      "prompt_buckets": [int(b) for b in buckets],
+                      "slots": int(slots)}
+            db.add({"program": program, "family": "serve_lm",
+                    "fingerprint": tune_db.fingerprint(desc),
+                    "topology": topology, "generation": gen,
+                    "config": config, "predicted": pred})
+            row = dict(config)
+            row.update(capacity=capacity, source=pred["source"],
+                       predicted_ms_per_token=pred["predicted_ms"],
+                       bound=pred["bound"],
+                       tokens_per_s_per_chip=pred["tokens_per_s_per_chip"],
+                       analytic_tokens_per_s_per_chip=(
+                           analytic.tokens_per_s_per_chip))
+            report["serve"]["rows"].append(row)
+            _log(f"  serve block={block} slots={slots}: "
+                 f"{pred['predicted_ms']} ms/token "
+                 f"({pred['bound']}-bound, "
+                 f"{pred['tokens_per_s_per_chip']} tok/s/chip, "
+                 f"{pred['source']})", log)
+
+    report["serve"]["rows"].sort(key=lambda r: r["predicted_ms_per_token"])
+    report["winner"] = (report["serve"]["rows"][0]
+                        if report["serve"]["rows"] else None)
+    report["ranked"] = [
+        {"config": r.config,
+         "predicted_ms_per_token": r.predicted.get("predicted_ms"),
+         "source": r.predicted.get("source")}
+        for r in db.top_k(5, family="serve_lm", generation=gen)]
+    db.save()
+    _log(f"tuning DB: {db.path} ({len(db.data['records'])} records)", log)
+    if report_path is None:
+        tag = topology.replace(":", "_").replace("x", "")
+        report_path = os.path.join(tune_db.repo_root(), "perf", "results",
+                                   f"serve_report_{tag}.json")
+    os.makedirs(os.path.dirname(report_path), exist_ok=True)
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    _log(f"report: {report_path}", log)
+    return report
+
+
+def _model_param_bytes(cfg) -> int:
+    """Parameter bytes of a TransformerLM without building arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpuframe.models.transformer_lm import TransformerLM
+
+    variables = jax.eval_shape(TransformerLM(cfg).init, jax.random.key(0),
+                               jax.ShapeDtypeStruct((1, 8), jnp.int32))
+    return sum(int(_prod(s.shape)) * jnp.dtype(s.dtype).itemsize
+               for s in jax.tree_util.tree_leaves(variables["params"]))

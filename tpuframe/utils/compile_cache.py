@@ -46,6 +46,41 @@ def safe_for_key_outputs() -> bool:
     return hasattr(jax, "typeof")
 
 
+def outputs_cache_safe(out_avals) -> bool:
+    """Whether a program with these output avals (a pytree from
+    ``jax.eval_shape``) is persistent-cache safe on THIS jax.  On
+    jax>=0.6 everything is; on older jax only programs whose outputs
+    carry no extended dtype (typed PRNG keys) are — exactly the check
+    the serving engine runs on its decode step, whose donated KV buffers
+    make an executable-deserialization abort extra expensive."""
+    if safe_for_key_outputs():
+        return True
+    import jax
+
+    extended = getattr(jax.dtypes, "extended", None)
+    for leaf in jax.tree_util.tree_leaves(out_avals):
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None:
+            continue
+        if extended is not None and jax.numpy.issubdtype(dtype, extended):
+            return False
+    return True
+
+
+def reset_cache() -> bool:
+    """Drop jax's latched in-process view of the persistent cache so the
+    next compile re-initializes against the currently-configured dir.
+    Needed by anything that re-points the cache mid-process (the serve
+    loadgen cache-hit test, tune's AOT harness).  Returns False when the
+    private hook is unavailable (then only early-set dirs engage)."""
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+        return True
+    except Exception:  # noqa: BLE001 — private API
+        return False
+
+
 def default_cache_dir() -> str:
     return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), ".xla_cache")
@@ -80,11 +115,7 @@ def enable(cache_dir: str | None = None, *,
     # If anything compiled before enable(), jax has already latched its
     # cache singleton as "no cache" and ignores the dir we just set —
     # reset so the next compile re-initializes against it.
-    try:
-        from jax._src import compilation_cache as _cc
-        _cc.reset_cache()
-    except Exception:  # noqa: BLE001 — private API; worst case is the
-        pass           # old behavior (cache engages only if set early)
+    reset_cache()
     _install_listener()
     return cache_dir
 
