@@ -84,6 +84,8 @@ def _emit(value: float, n_chips: int, **extra) -> None:
         line["n_chips"] = n_chips
     if _RESULT.get("remat_policy"):
         line["policy"] = _RESULT["remat_policy"]
+    if _RESULT.get("weight_update", "replicated") != "replicated":
+        line["weight_update"] = _RESULT["weight_update"]
     line.update(extra)
     print(json.dumps(line), flush=True)
 
@@ -238,6 +240,17 @@ def run(batch_per_chip: int, warmup: int, measure: int) -> float:
     if remat_policy != "none":
         _log(f"remat policy: {remat_policy} (source: {remat_source})")
     _RESULT["remat_policy"] = remat_policy
+    # TPUFRAME_WEIGHT_UPDATE=zero1 A/Bs ZeRO-1 weight-update sharding
+    # (reduce-scatter → sharded update → all-gather); unset, the tuning
+    # DB's offline weight_update_* sweep winner applies.
+    from tpuframe.parallel import zero1 as zero1_lib
+
+    weight_update, wu_source = zero1_lib.resolve(
+        program=f"train_resnet50_b{global_batch}",
+        family="weight_update_resnet50")
+    if weight_update == "zero1":
+        _log(f"weight update: {weight_update} (source: {wu_source})")
+    _RESULT["weight_update"] = weight_update
     model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem,
                             bn=bn)
     rng = np.random.default_rng(0)
@@ -281,12 +294,25 @@ def run(batch_per_chip: int, warmup: int, measure: int) -> float:
             _log(f"compiler_options from tuning DB: {xla_opts}")
     else:
         _log(f"compiler_options: {xla_opts}")
+    if weight_update == "zero1" and mesh is None:
+        # single-chip run: nothing to shard the update over — honor the
+        # resolution idiom (a DB row must never break a run) unless the
+        # user asked by env, in which case make_train_step's error is due.
+        if wu_source != "env":
+            weight_update = "replicated"
+            _RESULT["weight_update"] = weight_update
     train_step = step_lib.make_train_step(
         loss_fn, tx, mesh, donate=True, compiler_options=xla_opts,
-        remat_policy=None if remat_policy == "none" else remat_policy)
+        remat_policy=None if remat_policy == "none" else remat_policy,
+        weight_update=weight_update)
 
     if mesh is not None:
-        state = step_lib.replicate_state(state, mesh)
+        if weight_update == "zero1":
+            state = zero1_lib.make_state(
+                variables["params"], tx, mesh,
+                model_state={"batch_stats": variables["batch_stats"]})
+        else:
+            state = step_lib.replicate_state(state, mesh)
         put = lambda a: jax.device_put(a, mesh_lib.batch_sharding(mesh))  # noqa: E731
     else:
         put = jax.device_put

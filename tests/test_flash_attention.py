@@ -189,3 +189,72 @@ def test_precision_argument_plumbs_through(monkeypatch):
     for a, b in zip(g_base, g_hi):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Generation-conditional lse/delta layout (PERF.md §12.2): lane-major
+# residuals for every generation newer than v4; sublane-major for v4 and
+# unknown targets (the layout every generation can compile).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("gen,lane", [
+    (None, False),   # unknown target (CPU tier-1 runs) -> conservative
+    ("v4", False),   # tpu.dynamic_gather unsupported -> sublane-major
+    ("v5e", True),
+    ("v5p", True),
+    ("v6e", True),
+])
+def test_lse_layout_pinned_per_generation(monkeypatch, gen, lane):
+    for var in ("TPUFRAME_TUNE_GEN", "PALLAS_AXON_TPU_GEN"):
+        monkeypatch.delenv(var, raising=False)
+    if gen is not None:
+        monkeypatch.setenv("TPUFRAME_TUNE_GEN", gen)
+    assert fa._lse_lane_major() is lane
+
+
+@pytest.mark.parametrize("gen", [None, "v5e"])
+def test_lse_layout_residual_shape(monkeypatch, gen):
+    # the layout decision is visible in the residual the fwd pass saves:
+    # [bn, s] either way at the jax level, but built from a lane-major
+    # [bn, 1, s] or sublane-major [bn, s, 1] HBM array.
+    for var in ("TPUFRAME_TUNE_GEN", "PALLAS_AXON_TPU_GEN"):
+        monkeypatch.delenv(var, raising=False)
+    if gen is not None:
+        monkeypatch.setenv("TPUFRAME_TUNE_GEN", gen)
+    q, k, v = _qkv(b=1, s=128, n=2, d=64)
+    qf = q.reshape(2, 128, 64)
+    out, lse = fa._flash_fwd(qf, k.reshape(2, 128, 64),
+                             v.reshape(2, 128, 64), None, scale=64 ** -0.5,
+                             causal=False, block_q=64, block_k=64,
+                             interpret=True)
+    assert lse.shape == (2, 128)
+    assert out.shape == qf.shape
+
+
+def test_lse_layouts_numerically_equivalent(monkeypatch):
+    # the relayout is a pure storage decision: fwd outputs, the saved
+    # lse, and all three input grads must be identical under both
+    # layouts (same blocks, same accumulation order).
+    rng = np.random.default_rng(7)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.normal(0, 0.5, size=(4, 128, 64)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+
+    def run(gen):
+        for var in ("TPUFRAME_TUNE_GEN", "PALLAS_AXON_TPU_GEN"):
+            monkeypatch.delenv(var, raising=False)
+        if gen is not None:
+            monkeypatch.setenv("TPUFRAME_TUNE_GEN", gen)
+        out, lse = fa._flash_fwd(q, k, v, None, scale=64 ** -0.5,
+                                 causal=True, block_q=64, block_k=64,
+                                 interpret=True)
+        dq, dk, dv = fa._flash_bwd(q, k, v, None, out, lse, 2 * out,
+                                   scale=64 ** -0.5, causal=True,
+                                   block_q=64, block_k=64, interpret=True)
+        return out, lse, dq, dk, dv
+
+    sub = run(None)      # sublane-major
+    lan = run("v5e")     # lane-major
+    for a, b, name in zip(sub, lan, ("out", "lse", "dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5, err_msg=name)

@@ -288,6 +288,36 @@ class TestResolution:
         monkeypatch.setenv("TPUFRAME_TUNE_GEN", "v5e")
         assert tune_db.resolve_fa_blocks(128, 128) == (128, 128)
 
+    def test_weight_update_unknown_family_falls_back(self, tmp_path,
+                                                     monkeypatch,
+                                                     recwarn):
+        # A fresh DB that has never seen a ``weight_update_*`` sweep (or
+        # one from an older schema missing the family entirely) must
+        # resolve to None — and through zero1.resolve to the replicated
+        # default — without a single warning.
+        from tpuframe.parallel import zero1
+
+        path = str(tmp_path / "tune_db.json")
+        tune_db.TuningDB(path).save()
+        monkeypatch.setenv("TPUFRAME_TUNE_DB", path)
+        monkeypatch.setenv("TPUFRAME_TUNE_GEN", "v5e")
+        monkeypatch.delenv("TPUFRAME_WEIGHT_UPDATE", raising=False)
+        assert tune_db.resolve_weight_update(
+            "train_resnet50_b512",
+            family="weight_update_resnet50") is None
+        assert zero1.resolve(program="train_resnet50_b512",
+                             family="weight_update_resnet50") == \
+            ("replicated", "default")
+        assert len(recwarn) == 0
+
+    def test_weight_update_env_set_means_db_abstains(self, seeded_db,
+                                                     monkeypatch):
+        monkeypatch.setenv("TPUFRAME_TUNE_GEN", "v5e")
+        monkeypatch.setenv("TPUFRAME_WEIGHT_UPDATE", "replicated")
+        # env ownership is unambiguous: the DB layer returns None so
+        # the caller's env parse is the only authority
+        assert tune_db.resolve_weight_update("anything") is None
+
 
 class TestFingerprint:
     def test_opts_change_fingerprint(self):

@@ -137,6 +137,16 @@ def _run_serve_check() -> int:
     return len(problems)
 
 
+def _run_zero1_check() -> int:
+    from tpuframe.parallel import zero1
+
+    problems = zero1.check()
+    for p in problems:
+        print(f"ZERO1 {p}")
+    print(f"[analysis] zero1 self-check: {len(problems)} problem(s)")
+    return len(problems)
+
+
 def _run_obs_check() -> int:
     # Through the real CLI entry point, not an import — the gate then
     # also catches a broken ``python -m tpuframe.obs`` invocation.
@@ -180,6 +190,7 @@ def main(argv=None) -> int:
         n_findings += _run_tune_check()
         n_findings += _run_mem_check()
         n_findings += _run_serve_check()
+        n_findings += _run_zero1_check()
         n_findings += _run_obs_check()
 
     if n_findings:

@@ -108,6 +108,28 @@ def dp_budget(param_bytes: int, name: str = "dp") -> CommBudget:
     )
 
 
+def zero1_budget(padded_param_bytes: int, name: str = "dp-zero1") -> CommBudget:
+    """ZeRO-1 weight-update sharding (arXiv:2004.13336, the zero1 path):
+    the gradient all-reduce is REPLACED by reduce-scatter (grads in — the
+    operand is the full padded gradient, which is what crosses the wire)
+    plus tiled all-gather (updated params out).  Unlike the other
+    budgets' generous multipliers, the ceilings here are EXACT — the
+    audit is the proof the collective swap happened, so the declared
+    bytes are the pad-to-multiple layout's byte total and nothing more —
+    and the floor drops to 1 KiB so even tiny per-leaf collectives count
+    (scalar loss/metric/grad-norm reductions stay free).  Any all-reduce
+    above that floor is the defect class itself."""
+    return CommBudget(
+        name=name,
+        allowed={"reduce-scatter": int(padded_param_bytes),
+                 "all-gather": int(padded_param_bytes)},
+        ignore_below=1024,
+        notes="grad reduce-scatter in + param all-gather out, exact "
+              "pad-to-multiple bytes; all-reduce forbidden above the "
+              "1 KiB scalar floor (arXiv:2004.13336 wire pattern)",
+    )
+
+
 def serve_decode_budget(param_bytes: int = 0,
                         name: str = "serve-dp-decode") -> CommBudget:
     """Plain-DP serving decode: params replicated, KV slots sharded over
@@ -248,6 +270,7 @@ def strategy_budget(strategy: str, **sizes) -> CommBudget:
     """Budget for a MULTICHIP strategy name from program-derived sizes."""
     builders = {
         "dp": dp_budget,
+        "dp-zero1": zero1_budget,
         "serve-dp-decode": serve_decode_budget,
         "resnet-fsdp": fsdp_budget,
         "lm-seq-parallel": ring_sp_budget,

@@ -56,6 +56,16 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          a novel shape reaching the compiler there is a silent
          multi-second stall mid-serving — every serving program must
          come from the engine's bucketed AOT table.
+  TF110  optimizer update outside the weight-update seam — a
+         ``tx.update(...)``/``optax.apply_updates(...)`` call in
+         ``parallel/`` or ``train.py`` outside ``parallel/step.py`` /
+         ``parallel/zero1.py`` (the seam ``TPUFRAME_WEIGHT_UPDATE``
+         switches) silently bypasses ZeRO-1 weight-update sharding:
+         the stray site updates replicated params against sharded
+         optimizer state, or re-materializes the full state the zero1
+         layout exists to avoid.  ``parallel/hvd.py`` is seam-adjacent
+         (it *composes* an ``optax.GradientTransformation``; step.py
+         still applies it) and exempt.
   TF106  compiler-env mutation that can run after jax backend init —
          ``os.environ["XLA_FLAGS"] = ...`` (or ``LIBTPU_INIT_ARGS``,
          via assignment/setdefault/update/putenv) is snapshotted by the
@@ -103,6 +113,8 @@ RULES = {
              "bypassing the tpuframe.mem policy registry",
     "TF109": "jit/apply in the serving path outside the engine's "
              "bucketed AOT table (serve/engine.py)",
+    "TF110": "optimizer update (tx.update/optax.apply_updates) outside "
+             "the weight-update seam (parallel/step.py, parallel/zero1.py)",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -131,6 +143,18 @@ _BARE_REMAT_CALLEES = {
 _SERVE_SCOPE_PART = "serve/"
 _SERVE_EXEMPT_SUFFIX = "serve/engine.py"
 _SERVE_COMPILE_TAILS = {"jit", "pjit", "pmap"}
+
+# TF110: the weight-update seam.  Optimizer math in parallel/ or
+# train.py must go through step.py's _reduce_and_apply (which dispatches
+# on TPUFRAME_WEIGHT_UPDATE) or zero1.py's sharded_update; hvd.py only
+# composes a GradientTransformation (step.py applies it) and is exempt.
+_WU_SCOPE_PART = "parallel/"
+_WU_SCOPE_SUFFIX = "train.py"
+_WU_EXEMPT_SUFFIXES = ("parallel/step.py", "parallel/zero1.py",
+                       "parallel/hvd.py")
+# Receivers whose ``.update(grads, state, ...)`` is optimizer math rather
+# than a dict/metric update — the optax transformation naming convention.
+_WU_OPTIMIZER_RECEIVERS = {"tx", "optimizer", "opt", "inner_tx"}
 
 # TF105a: google.cloud.storage blob/bucket methods — allowed only inside
 # the retry-wrapped data/gcs.py layer.
@@ -269,6 +293,9 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
                                for p in _REMAT_EXEMPT_PARTS))
     serve_scope = (_SERVE_SCOPE_PART in norm_path
                    and not norm_path.endswith(_SERVE_EXEMPT_SUFFIX))
+    wu_scope = ((_WU_SCOPE_PART in norm_path
+                 or norm_path.endswith(_WU_SCOPE_SUFFIX))
+                and not norm_path.endswith(_WU_EXEMPT_SUFFIXES))
 
     # TF106: a module-level compiler-env write is safe only BEFORE the
     # module-level jax import (the conftest/bootstrap pattern).
@@ -415,6 +442,20 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
                      f"serve/engine.py's bucketed AOT table (an "
                      f"un-bucketed shape compiling mid-serving is a "
                      f"multi-second stall)", fn)
+            if wu_scope and (
+                    callee in ("optax.apply_updates", "apply_updates")
+                    or (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "update"
+                        and _dotted(node.func.value).rsplit(".", 1)[-1]
+                        in _WU_OPTIMIZER_RECEIVERS
+                        and len(node.args) >= 2)):
+                emit("TF110", node,
+                     f"{callee}() optimizer update outside the "
+                     f"weight-update seam — route it through "
+                     f"parallel/step.py's _reduce_and_apply (or "
+                     f"parallel/zero1.py's sharded_update) so "
+                     f"TPUFRAME_WEIGHT_UPDATE=zero1 still shards the "
+                     f"update and optimizer state", fn)
             if remat_scope and callee in _BARE_REMAT_CALLEES:
                 emit("TF108", node,
                      f"{callee}() bare rematerialization in model/step "

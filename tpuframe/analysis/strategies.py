@@ -125,6 +125,31 @@ def _build_dp(n_devices: int):
     return step, example, budgets_lib.dp_budget(pb), pb
 
 
+def _build_zero1(n_devices: int):
+    """Plain DP with the ZeRO-1 weight-update transform: the identical
+    tiny-LM step, but the optimizer state in zero1's flat sharded layout
+    and ``weight_update="zero1"`` — the audit proves the collective swap
+    (no all-reduce above the scalar floor; reduce-scatter + all-gather at
+    exactly the pad-to-multiple byte total)."""
+    import dataclasses
+
+    import jax
+
+    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
+    from tpuframe.parallel import zero1 as zero1_lib
+
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=n_devices))
+    _, loss_fn, tx, (state, batch), pb, _ = _lm_pieces()
+    n = zero1_lib.world_size(mesh)
+    opt = jax.eval_shape(
+        lambda p: zero1_lib.init_opt_state(tx, p, n), state.params)
+    state = dataclasses.replace(state, opt_state=opt)
+    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
+                                    weight_update="zero1")
+    padded = zero1_lib.padded_bytes(state.params, n)
+    return step, (state, batch), budgets_lib.zero1_budget(padded), pb
+
+
 def _build_fsdp(n_devices: int):
     from tpuframe.parallel import fsdp as fsdp_lib
     from tpuframe.parallel import mesh as mesh_lib, step as step_lib
@@ -307,6 +332,7 @@ def _build_adasum(n_devices: int):
 #: MULTICHIP_r05.json strategy name -> builder.
 STRATEGIES = {
     "dp": _build_dp,
+    "dp-zero1": _build_zero1,
     "resnet-fsdp": _build_fsdp,
     "lm-tensor-parallel": _build_tp,
     "lm-seq-parallel": _build_ring_sp,

@@ -35,6 +35,8 @@ Event types (see ``REQUIRED_FIELDS`` for the per-type contract):
   devmem         HBM telemetry sample (per-device memory_stats)
   remat_policy   rematerialization policy chosen for the step program
                  (policy name, resolution source, predicted bytes)
+  weight_update  weight-update sharding mode chosen for the step program
+                 (mode replicated|zero1, resolution source, shard count)
   run_end        final step, wall s, goodput buckets, MFU, counters,
                  peak HBM per device
   serve_step     one continuous-batching scheduler step (active slots,
@@ -83,6 +85,7 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "preempt": ("signal",),
     "devmem": ("devices",),
     "remat_policy": ("policy", "source"),
+    "weight_update": ("mode", "source"),
     "run_end": ("final_step", "wall_s", "goodput"),
     "serve_step": ("step", "wall_ms", "active"),
     "serve_request": ("id", "prompt_tokens", "output_tokens", "ttft_ms"),

@@ -43,6 +43,10 @@ NEG_INF = -1e30  # softmax mask fill; finite so (x - x) stays 0, not nan
 
 _LANES = 128  # VMEM lane width: per-row stats are stored lane-broadcast
 
+# jax < 0.5 names it TPUCompilerParams; same fields either way.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 
 def _auto_interpret() -> bool:
     # TPUFRAME_PALLAS_INTERPRET overrides the backend check: the offline
@@ -54,6 +58,24 @@ def _auto_interpret() -> bool:
     if env is not None:
         return env == "1"
     return jax.default_backend() != "tpu"
+
+
+def _lse_lane_major() -> bool:
+    """Generation-conditional lse/delta layout (PERF.md §12.2).
+
+    The per-row residuals (logsumexp, delta) are logically [rows] vectors;
+    as kernel operands they need a 2-D in-block shape.  Sublane-major
+    ([bq, 1]) matches the running stats' natural orientation but pads the
+    HBM array's trailing dim 1 → 128 lanes — a 128x residual blow-up that
+    pushed lm_long's dp1×sp8 capacity-edge mesh back over v5e's HBM.
+    Lane-major ([1, bq]) pads 1 → 8 sublanes instead (16x less), but the
+    in-kernel [bq, 1] ↔ [1, bq] relayout lowers through tpu.dynamic_gather
+    — "Sublane gather not supported by this TPU generation" on v4 (the
+    offline v4 audit, PERF.md §12.1).  So: lane-major for every generation
+    newer than v4, sublane-major for v4 and for unknown targets (CPU test
+    runs keep the layout every generation can compile)."""
+    gen = _tune_db.target_generation()
+    return gen is not None and gen != "v4"
 
 
 def _causal_dispatch(causal, qi, kv, block_q, block_k, compute):
@@ -83,8 +105,11 @@ def _causal_dispatch(causal, qi, kv, block_q, block_k, compute):
 def _sds(like: jax.Array, shape, dtype) -> jax.ShapeDtypeStruct:
     """out_shape that inherits ``like``'s varying-mesh-axes, so the kernel
     works unchanged inside ``shard_map`` (where jax requires outputs to
-    declare their vma) and outside it (empty vma)."""
-    return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
+    declare their vma) and outside it (empty vma).  Legacy jax (< 0.5) has
+    no vma typing — check_rep=False shard_map needs no declaration there."""
+    if hasattr(jax, "typeof"):
+        return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def supported(q: jax.Array, k: jax.Array | None = None,
@@ -112,7 +137,7 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref,  # inputs
                 o_ref, lse_ref,                 # outputs
                 acc_ref, m_ref, l_ref,          # scratch
                 *, scale: float, causal: bool, block_q: int, block_k: int,
-                n_kv: int, precision=None):
+                n_kv: int, lane_lse: bool = False, precision=None):
     qi = pl.program_id(1)
     kv = pl.program_id(2)
 
@@ -167,14 +192,15 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref,  # inputs
         l = l_ref[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows → zeros
         o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
-        # logsumexp residual for the backward pass.  Stored SUBLANE-major
-        # ([bq, 1] rows, matching the m/l stats' natural orientation): a
-        # lane-major [1, 1, bq] store would need a sublane<->lane
-        # transpose, which Mosaic lowers as tpu.dynamic_gather —
-        # unsupported on v4 ("Sublane gather not supported by this TPU
-        # generation", found by the offline v4 audit, PERF.md §12).
+        # logsumexp residual for the backward pass.  Layout is generation-
+        # conditional (_lse_lane_major): lane-major [1, bq] where the
+        # sublane<->lane relayout compiles (v5e+ — 16x less HBM padding on
+        # the residual array), sublane-major [bq, 1] on v4/unknown, where
+        # Mosaic lowers the relayout as tpu.dynamic_gather — "Sublane
+        # gather not supported by this TPU generation" (the offline v4
+        # audit, PERF.md §12).
         lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
-        lse_ref[0] = lse
+        lse_ref[0] = lse.reshape(1, block_q) if lane_lse else lse
 
 
 def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret,
@@ -191,6 +217,7 @@ def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret,
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),          # v
     ]
     args = [q, k, v]
+    lane = _lse_lane_major()
     if mask is not None:
         n_heads = bn // mask.shape[0]
         in_specs.insert(0, pl.BlockSpec(
@@ -198,23 +225,28 @@ def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret,
         args.insert(0, mask[:, None, :])
         kernel = functools.partial(
             _fwd_kernel, scale=scale, causal=causal,
-            block_q=bq, block_k=bk, n_kv=n_kv, precision=precision)
+            block_q=bq, block_k=bk, n_kv=n_kv, lane_lse=lane,
+            precision=precision)
     else:
         kernel = functools.partial(
             _fwd_kernel, None, scale=scale, causal=causal,
-            block_q=bq, block_k=bk, n_kv=n_kv, precision=precision)
+            block_q=bq, block_k=bk, n_kv=n_kv, lane_lse=lane,
+            precision=precision)
 
+    lse_spec = (pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)) if lane
+                else pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)))
+    lse_shape = (bn, 1, s_q) if lane else (bn, s_q, 1)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            lse_spec,
         ],
         out_shape=[
             _sds(q, (bn, s_q, d), q.dtype),
-            _sds(q, (bn, s_q, 1), jnp.float32),
+            _sds(q, lse_shape, jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -224,11 +256,11 @@ def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret,
         # batch and q-block dims carry no cross-iteration state (the
         # acc/m/l scratch carry lives on the kv dim only): declaring them
         # parallel lets Mosaic schedule/pipeline them freely.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
-    return out, lse[:, :, 0]
+    return out, (lse[:, 0, :] if lane else lse[:, :, 0])
 
 
 # ---------------------------------------------------------------------------
@@ -237,7 +269,7 @@ def _flash_fwd(q, k, v, mask, *, scale, causal, block_q, block_k, interpret,
 
 
 def _recompute_p(q_ref, k_ref, lse_ref, mask_ref, *, scale, need_tri,
-                 qi, kv, block_q, block_k, precision=None):
+                 qi, kv, block_q, block_k, lane_lse=False, precision=None):
     """Rebuild the probability block from saved logsumexp (f32)."""
     s = jax.lax.dot_general(
         q_ref[0], k_ref[0], (((1,), (1,)), ((), ())), precision=precision,
@@ -250,7 +282,9 @@ def _recompute_p(q_ref, k_ref, lse_ref, mask_ref, *, scale, need_tri,
         cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         tri = qi * block_q + rows >= kv * block_k + cols
         keep = tri if keep is None else jnp.logical_and(keep, tri)
-    lse = lse_ref[0]                                        # [bq, 1]
+    lse = lse_ref[0]                           # [bq, 1] (or [1, bq] lane)
+    if lane_lse:
+        lse = lse.reshape(block_q, 1)
     p = jnp.exp(jnp.where(keep, s, NEG_INF) - lse) if keep is not None \
         else jnp.exp(s - lse)
     if keep is not None:
@@ -260,7 +294,7 @@ def _recompute_p(q_ref, k_ref, lse_ref, mask_ref, *, scale, need_tri,
 
 def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, *, scale, causal, block_q, block_k, n_kv,
-                   precision=None):
+                   lane_lse=False, precision=None):
     qi = pl.program_id(1)
     kv = pl.program_id(2)
 
@@ -272,11 +306,13 @@ def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = _recompute_p(q_ref, k_ref, lse_ref, mask_ref, scale=scale,
                          need_tri=need_tri, qi=qi, kv=kv,
                          block_q=block_q, block_k=block_k,
-                         precision=precision)
+                         lane_lse=lane_lse, precision=precision)
         dp = jax.lax.dot_general(                       # dO @ V^T  [bq, bk]
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             precision=precision, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])                    # [bq, bk]
+        delta = (delta_ref[0].reshape(block_q, 1) if lane_lse
+                 else delta_ref[0])
+        ds = p * (dp - delta)                           # [bq, bk]
         dq_acc[...] += scale * jax.lax.dot_general(     # ds @ K    [bq, d]
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             precision=precision, preferred_element_type=jnp.float32)
@@ -291,7 +327,7 @@ def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
                     *, scale, causal, block_q, block_k, n_q,
-                    precision=None):
+                    lane_lse=False, precision=None):
     kv = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -304,14 +340,16 @@ def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = _recompute_p(q_ref, k_ref, lse_ref, mask_ref, scale=scale,
                          need_tri=need_tri, qi=qi, kv=kv,
                          block_q=block_q, block_k=block_k,
-                         precision=precision)
+                         lane_lse=lane_lse, precision=precision)
         dv_acc[...] += jax.lax.dot_general(             # P^T @ dO  [bk, d]
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             precision=precision, preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             precision=precision, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])
+        delta = (delta_ref[0].reshape(block_q, 1) if lane_lse
+                 else delta_ref[0])
+        ds = p * (dp - delta)
         dk_acc[...] += scale * jax.lax.dot_general(     # ds^T @ Q  [bk, d]
             ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             precision=precision, preferred_element_type=jnp.float32)
@@ -331,21 +369,31 @@ def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
     bq, bk = min(block_q, s_q), min(block_k, s_kv)
     n_q, n_kv = s_q // bq, s_kv // bk
 
-    # delta_i = rowsum(dO_i * O_i) — tiny elementwise reduce; let XLA fuse it.
+    # delta_i = rowsum(dO_i * O_i) — tiny elementwise reduce; let XLA fuse
+    # it.  The residual arrays (delta, lse) take the generation-conditional
+    # layout (_lse_lane_major): lane-major [bn, 1, s] where the relayout
+    # compiles, sublane-major [bn, s, 1] on v4/unknown — same tradeoff as
+    # the forward's lse store.
+    lane = _lse_lane_major()
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)[:, :, None]
+                    axis=-1)
     if dlse is not None:
         # lse-output cotangent (ring-attention stage merging): with
         # lse = logsumexp(s) an output, ∂lse/∂s_j = p_j adds dlse·p_j to
         # ds — i.e. ds = p·(dp - delta + dlse).  Folding it into delta
         # (delta_eff = delta - dlse) reuses both backward kernels
         # untouched.
-        delta = delta - dlse[:, :, None].astype(jnp.float32)
-    lse3 = lse[:, :, None]
+        delta = delta - dlse.astype(jnp.float32)
+    if lane:
+        delta, lse3 = delta[:, None, :], lse[:, None, :]
+    else:
+        delta, lse3 = delta[:, :, None], lse[:, :, None]
 
     q_spec_qmajor = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
     kv_spec_qmajor = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
-    row_spec_qmajor = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
+    row_spec_qmajor = (
+        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)) if lane
+        else pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)))
 
     common = [q, k, v, do, lse3, delta]
 
@@ -362,14 +410,14 @@ def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
     dq = pl.pallas_call(
         functools.partial(kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, n_kv=n_kv,
-                          precision=precision),
+                          lane_lse=lane, precision=precision),
         grid=(bn, n_q, n_kv),
         in_specs=mspec + [q_spec_qmajor, kv_spec_qmajor, kv_spec_qmajor,
                           q_spec_qmajor, row_spec_qmajor, row_spec_qmajor],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=_sds(q, q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(      # dq carry: kv dim only
+        compiler_params=_CompilerParams(      # dq carry: kv dim only
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*margs, *common)
@@ -377,13 +425,14 @@ def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
     # --- dk/dv: grid (bn, kv blocks, q blocks) ---
     q_spec = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
     kv_spec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
-    row_spec = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0))
+    row_spec = (pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)) if lane
+                else pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)))
     kernel, mspec, margs = with_mask(
         _bwd_dkv_kernel, lambda h, b, j, i: (b // h, 0, j))
     dk, dv = pl.pallas_call(
         functools.partial(kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, n_q=n_q,
-                          precision=precision),
+                          lane_lse=lane, precision=precision),
         grid=(bn, n_kv, n_q),
         in_specs=mspec + [q_spec, kv_spec, kv_spec, q_spec, row_spec,
                           row_spec],
@@ -393,7 +442,7 @@ def _flash_bwd(q, k, v, mask, out, lse, do, *, scale, causal,
                    _sds(q, v.shape, v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(      # dk/dv carry: q dim only
+        compiler_params=_CompilerParams(      # dk/dv carry: q dim only
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*margs, *common)

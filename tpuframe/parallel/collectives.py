@@ -35,6 +35,24 @@ AxisName = str | Sequence[str]
 PyTree = Any
 
 
+if not hasattr(lax, "axis_size"):
+    # jax < 0.4.38 never shipped ``lax.axis_size``.  ``psum`` of the literal
+    # ``1`` over an axis is the classic static-size idiom: it folds to a plain
+    # ``int`` at trace time and raises the same ``NameError`` on unbound names
+    # that the modern API does, so ``_bound_axes``'s probe keeps working.
+    # Installed on ``lax`` once so every caller in this package (fusion,
+    # seq_parallel, pp, zero1) resolves the same way on legacy jax.
+    def _legacy_axis_size(axis_name: AxisName) -> int:
+        if isinstance(axis_name, (tuple, list)):
+            n = 1
+            for a in axis_name:
+                n *= _legacy_axis_size(a)
+            return n
+        return lax.psum(1, axis_name)
+
+    lax.axis_size = _legacy_axis_size
+
+
 def _bound_axes(axis: AxisName) -> tuple[str, ...]:
     """The subset of ``axis`` names bound by an enclosing shard_map/pmap trace.
 
@@ -258,16 +276,28 @@ def ring_permute(x: jax.Array, axis: AxisName = "data", *, shift: int = 1) -> ja
 def reduce_scatter(x: jax.Array, axis: AxisName = "data", *, scatter_axis: int = 0,
                    average: bool = False) -> jax.Array:
     """psum_scatter — the building block of sharded-optimizer updates
-    (cross-replica weight-update sharding, PAPERS.md:5).
-    Unmapped: identity (reduce over a world of 1)."""
+    (cross-replica weight-update sharding, PAPERS.md:5; the zero1 path's
+    gradient reduction).  Unmapped: identity (reduce over a world of 1).
+
+    ``x.shape[scatter_axis]`` must divide evenly by the member count —
+    psum_scatter has no remainder path, and the shape error it raises
+    from deep inside lowering is unreadable; callers that need uneven
+    leaves pad first (``zero1``'s pad-to-multiple layout)."""
     bound = _bound_axes(axis)
     if not bound:
         return x
+    n = 1
+    for name in bound:
+        n *= lax.axis_size(name)
+    dim = x.shape[scatter_axis] if x.ndim else 0
+    if dim % n:
+        raise ValueError(
+            f"reduce_scatter: dim {scatter_axis} of shape {tuple(x.shape)} "
+            f"({dim}) is not divisible by the {n}-member axis {bound}; "
+            f"pad the leading dim to a multiple of {n} first (see "
+            f"tpuframe.parallel.zero1's pad-to-multiple layout)")
     out = lax.psum_scatter(x, bound, scatter_dimension=scatter_axis, tiled=True)
     if average:
-        n = 1
-        for name in bound:
-            n *= lax.axis_size(name)
         out = out / n
     return out
 

@@ -158,8 +158,11 @@ def make_pp_lm_step(model, tx: optax.GradientTransformation, mesh: Mesh, *,
             loss_fn = mem_policy.wrap(loss_fn, remat_policy)
         (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        # The PP step owns its update: params live stage-sharded here, so
+        # the dp-only zero1 seam does not apply (stage shards already split
+        # optimizer state pipe-ways).
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)  # tf-lint: ok[TF110]
+        params = optax.apply_updates(state.params, updates)  # tf-lint: ok[TF110]
         metrics = {"loss": loss, "accuracy": lax.pmean(acc, data_axes)}
         new_state = TrainState(step=state.step + 1, params=params,
                                opt_state=opt_state,

@@ -82,6 +82,7 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
                fusion_threshold: int | None,
                accum_steps: int,
                grad_reduce: str,
+               weight_update: str,
                state: TrainState, batch: PyTree):
     """Shared body for both modes. ``axes`` bound ⇒ explicit collectives."""
     step_rng = jax.random.fold_in(state.rng, state.step)
@@ -92,8 +93,8 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
 
     if accum_steps > 1:
         return _accum_grad_step(loss_fn, tx, axes, fusion_threshold,
-                                accum_steps, grad_reduce, state, batch,
-                                step_rng)
+                                accum_steps, grad_reduce, weight_update,
+                                state, batch, step_rng)
 
     # The reference's raison d'être: synchronous gradient averaging.
     # Horovod: per-tensor async NCCL ring-allreduce with fusion buffer.
@@ -117,36 +118,66 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     # pmean-of-loss transpose (which pre-averages) cannot be used.
     explicit = bool(axes) and (fusion_threshold is not None
                                or grad_reduce == "adasum")
+    # ZeRO-1 weight-update sharding consumes LOCAL grads too: the sharded
+    # update's reduce-scatter IS the step's gradient reduction, so the
+    # implicit pmean-of-loss transpose (which would all-reduce) must not
+    # run.  On new jax the params are pcast varying like the explicit
+    # path; on legacy shard_map local grads come free (below).
+    zero1 = bool(axes) and weight_update == "zero1"
     # Legacy shard_map (check_rep=False) has no psum-transpose rewrite:
     # differentiating the pmean-ed loss there yields LOCAL grads with no
     # implicit reduction, so the reduction must be explicit.
     legacy_local = bool(axes) and _LEGACY_SHARD_MAP and not explicit
     diff_params = state.params
-    if explicit:
+    if explicit or (zero1 and not _LEGACY_SHARD_MAP):
         diff_params = jax.tree.map(
             lambda p: lax.pcast(p, axes, to="varying"), state.params)
 
     def global_loss(params, model_state, batch, rng):
         loss, aux = loss_fn(params, model_state, batch, rng)
-        if axes and not explicit and not legacy_local:
+        if axes and not explicit and not legacy_local and not zero1:
             loss = lax.pmean(loss, axes)
         return loss, aux
 
     (loss, (model_state, metrics)), grads = jax.value_and_grad(
         global_loss, has_aux=True)(diff_params, state.model_state, batch, step_rng)
 
-    return _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, state,
+    return _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce,
+                             weight_update, state,
                              grads, loss, metrics, model_state,
-                             reduce_grads=explicit or legacy_local)
+                             reduce_grads=explicit or legacy_local or zero1)
 
 
-def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, state, grads,
+def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, weight_update,
+                      state, grads,
                       loss, metrics, model_state, *, reduce_grads: bool):
     """Shared step tail: cross-replica reductions + optimizer update.
 
     ``reduce_grads``: True when ``grads``/``loss`` are still per-replica
-    (explicit-fusion, adasum and accumulation paths); False when the
-    pmean-of-loss transpose already reduced them (the implicit default)."""
+    (explicit-fusion, adasum, zero1 and accumulation paths); False when
+    the pmean-of-loss transpose already reduced them (the implicit
+    default)."""
+    if weight_update == "zero1" and axes:
+        # ZeRO-1 tail: NO gradient all-reduce — the grads stay local and
+        # zero1.sharded_update's reduce-scatter performs the one and only
+        # gradient-sized reduction.  Scalars (loss/metrics) and BN stats
+        # still pmean (all under the audit's scalar floor).
+        from tpuframe.parallel import zero1 as zero1_lib
+
+        if reduce_grads:
+            loss = lax.pmean(loss, axes)
+        metrics = jax.tree.map(lambda m: lax.pmean(m, axes), metrics)
+        model_state = jax.tree.map(lambda s: lax.pmean(s, axes), model_state)
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads,
+                             state.params)
+        params, opt_state, grad_norm = zero1_lib.sharded_update(
+            tx, axes, state.params, state.opt_state, grads)
+        metrics = dict(metrics)
+        metrics["loss"] = loss
+        metrics["grad_norm"] = grad_norm
+        return TrainState(step=state.step + 1, params=params,
+                          opt_state=opt_state, model_state=model_state,
+                          rng=state.rng), metrics
     if reduce_grads and axes:
         if grad_reduce == "adasum":
             from tpuframe.parallel import collectives
@@ -182,7 +213,7 @@ def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, state, grads,
 
 
 def _accum_grad_step(loss_fn, tx, axes, fusion_threshold, accum_steps,
-                     grad_reduce, state, batch, step_rng):
+                     grad_reduce, weight_update, state, batch, step_rng):
     """Gradient accumulation — Horovod's ``backward_passes_per_step``
     (DistributedOptimizer option; the reference's recipe for batches that
     exceed device memory).  The local batch is split into ``accum_steps``
@@ -246,7 +277,8 @@ def _accum_grad_step(loss_fn, tx, axes, fusion_threshold, accum_steps,
     loss = loss / accum_steps
     metrics = jax.tree.map(lambda m: m / accum_steps, metrics)
 
-    return _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, state,
+    return _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce,
+                             weight_update, state,
                              grads, loss, metrics, model_state,
                              reduce_grads=True)
 
@@ -266,6 +298,7 @@ def make_train_step(
     grad_reduce: str = "mean",
     compiler_options: dict | None = None,
     remat_policy: str | None = None,
+    weight_update: str = "replicated",
 ):
     """Build the compiled train step.
 
@@ -314,7 +347,43 @@ def make_train_step(
     activations are saved for the backward (the §6 HBM-traffic lever).
     ``None``/``"none"`` leaves the loss unwrapped.  Resolution (env >
     tuning DB > default) is the caller's job via ``mem.resolve``.
+
+    ``weight_update``: ``"replicated"`` (default — every chip holds the
+    full optimizer state and applies the full update) or ``"zero1"``
+    (:mod:`tpuframe.parallel.zero1`, arXiv:2004.13336): the gradient
+    all-reduce is replaced by reduce-scatter → 1/n-shard optimizer
+    update → tiled all-gather, and the optimizer state lives sharded
+    (build it with ``zero1.make_state``; ``TrainState.create``'s
+    replicated layout is rejected at trace time).  shard_map mode with a
+    mesh only; element-wise optimizers only; does not compose with
+    ``fusion_threshold``/``adasum`` (both are all-gradient wire patterns
+    the sharded update replaces) or ``state_shardings`` (auto-SPMD ZeRO-3
+    already shards the update).  Resolution (env
+    ``TPUFRAME_WEIGHT_UPDATE`` > tuning DB > default) is the caller's job
+    via ``zero1.resolve``.
     """
+    weight_update = (weight_update or "replicated").strip().lower()
+    if weight_update not in ("replicated", "zero1"):
+        raise ValueError(f"unknown weight_update {weight_update!r}; "
+                         f"expected 'replicated' or 'zero1'")
+    if weight_update == "zero1":
+        if mesh is None:
+            raise ValueError("weight_update='zero1' needs a mesh — a world "
+                             "of 1 has nothing to shard the update over")
+        if state_shardings is not None:
+            raise ValueError("weight_update='zero1' is the shard_map DP "
+                             "path; state_shardings (auto-SPMD ZeRO-3) "
+                             "already shards the update")
+        if grad_reduce == "adasum":
+            raise ValueError("weight_update='zero1' does not compose with "
+                             "adasum — the butterfly needs full gradients "
+                             "on every replica")
+        if fusion_threshold is not None:
+            raise ValueError("weight_update='zero1' replaces the gradient "
+                             "all-reduce entirely — fusion buffers have "
+                             "nothing to pack")
+        if mode != "shard_map":
+            raise ValueError("weight_update='zero1' needs shard_map mode")
     if remat_policy:
         from tpuframe.mem import policy as mem_policy
 
@@ -331,7 +400,7 @@ def make_train_step(
     if mesh is None:
         # World of 1: adasum degrades to identity like every collective.
         body = functools.partial(_grad_step, loss_fn, tx, None, None,
-                                 accum_steps, "mean")
+                                 accum_steps, "mean", "replicated")
         return jax.jit(body, donate_argnums=(0,) if donate else (),
                        compiler_options=compiler_options)
 
@@ -359,7 +428,7 @@ def make_train_step(
                              "auto-SPMD has no per-replica grads to combine")
         # Auto-SPMD: annotate shardings, let the partitioner insert collectives.
         body = functools.partial(_grad_step, loss_fn, tx, None, None,
-                                 accum_steps, "mean")
+                                 accum_steps, "mean", "replicated")
         state_sh = repl if state_shardings is None else state_shardings
         return jax.jit(
             body,
@@ -373,7 +442,29 @@ def make_train_step(
         raise ValueError(f"unknown step mode {mode!r}")
 
     body = functools.partial(_grad_step, loss_fn, tx, axes, fusion_threshold,
-                             accum_steps, grad_reduce)
+                             accum_steps, grad_reduce, weight_update)
+    if weight_update == "zero1":
+        from tpuframe.parallel import zero1 as zero1_lib
+
+        n_shards = zero1_lib.world_size(mesh, axes)
+
+        def zero1_stepper(state, batch):
+            # The opt_state tree shape is the optimizer's business
+            # (tx.init), only known from the traced state — so the
+            # per-leaf spec tree (moment vectors sharded on dim 0,
+            # everything else replicated) is built here inside the jit
+            # trace.  shard_map composes under jit, and ``.lower()``
+            # still works for the AOT sweeps/audits.
+            zero1_lib.check_state_layout(state, n_shards)
+            specs = zero1_lib.state_partition_specs(state, axes)
+            mapped = _shard_map(body, mesh=mesh,
+                                in_specs=(specs, batch_part),
+                                out_specs=(specs, P()))
+            return mapped(state, batch)
+
+        return jax.jit(zero1_stepper,
+                       donate_argnums=(0,) if donate else (),
+                       compiler_options=compiler_options)
     mapped = _shard_map(
         body, mesh=mesh,
         in_specs=(P(), batch_part),

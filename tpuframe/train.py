@@ -134,6 +134,9 @@ class Harness:
     # (policy name, resolution source) from tpuframe.mem.resolve —
     # ("none", "default") when nothing elected a remat policy.
     remat_policy: tuple = ("none", "default")
+    # (mode, resolution source) from tpuframe.parallel.zero1.resolve —
+    # ("replicated", "default") when nothing elected weight-update sharding.
+    weight_update: tuple = ("replicated", "default")
 
 
 def build_harness(cfg: TrainConfig) -> Harness:
@@ -223,6 +226,23 @@ def build_harness(cfg: TrainConfig) -> Harness:
         family=f"remat_{model_tag}")
     step_policy = None if remat_policy == "none" else remat_policy
 
+    # Weight-update sharding (ZeRO-1): TPUFRAME_WEIGHT_UPDATE env wins,
+    # else the tuning DB's offline weight_update_* sweep winner
+    # (generation-gated), else replicated.  zero1 is the plain-DP
+    # shard_map path only — on configs it cannot serve (pp, auto-SPMD
+    # sharded state, no mesh, adasum) a DB-elected mode falls back
+    # silently (a stale DB row must never break a run) while an explicit
+    # env ask gets make_train_step's specific error.
+    from tpuframe.parallel import zero1 as zero1_lib
+
+    weight_update, wu_source = zero1_lib.resolve(
+        program=f"train_{model_tag}_b{cfg.global_batch}",
+        family=f"weight_update_{model_tag}")
+    if (weight_update == "zero1" and wu_source != "env"
+            and (use_pp or use_sharded_state or mesh is None
+                 or cfg.grad_reduce == "adasum")):
+        weight_update, wu_source = "replicated", "default"
+
     if use_pp:
         # Pipeline parallelism: ScanBlockLM blocks + opt state sharded over
         # the pipe axis, GPipe microbatching (tpuframe.parallel.pp_lm).
@@ -244,6 +264,10 @@ def build_harness(cfg: TrainConfig) -> Harness:
         if cfg.shard_seq:
             raise ValueError("pipe parallelism does not compose with "
                              "shard_seq sequence parallelism yet")
+        if weight_update == "zero1":
+            raise ValueError("TPUFRAME_WEIGHT_UPDATE=zero1 is the plain-DP "
+                             "shard_map path; the pipeline step owns its "
+                             "own stage-sharded update")
         from tpuframe.parallel import pp_lm
 
         factory, place_state, _ = pp_lm.make_pp_lm_step(
@@ -269,7 +293,14 @@ def build_harness(cfg: TrainConfig) -> Harness:
             state = jax.tree.map(mesh_lib.host_device_put, state,
                                  state_shardings)
         elif mesh is not None:
-            state = step_lib.replicate_state(state, mesh)
+            if weight_update == "zero1":
+                # Optimizer state born sharded in zero1's flat padded
+                # layout — never materialized replicated on any chip.
+                state = zero1_lib.make_state(
+                    params, tx, mesh, model_state=model_state,
+                    rng=jax.random.key(cfg.seed + 1))
+            else:
+                state = step_lib.replicate_state(state, mesh)
 
         loss_fn = make_loss_fn(cfg, model)
         from tpuframe.parallel import tuning
@@ -291,7 +322,8 @@ def build_harness(cfg: TrainConfig) -> Harness:
             accum_steps=cfg.accum_steps,
             grad_reduce=cfg.grad_reduce,
             compiler_options=xla_opts,
-            remat_policy=step_policy)
+            remat_policy=step_policy,
+            weight_update=weight_update)
         eval_step = step_lib.make_eval_step(
             make_metric_fn(cfg, model), mesh, batch_partition=step_part,
             reduce_axes=reduce_axes, state_shardings=state_shardings)
@@ -317,7 +349,8 @@ def build_harness(cfg: TrainConfig) -> Harness:
                    train_step=train_step, eval_step=eval_step,
                    train_loader=train_loader, eval_loader=eval_loader,
                    manager=manager, start_step=start_step,
-                   remat_policy=(remat_policy, remat_source))
+                   remat_policy=(remat_policy, remat_source),
+                   weight_update=(weight_update, wu_source))
 
 
 def _lm_reduce_axis(cfg: TrainConfig, *, for_grad: bool):
@@ -812,6 +845,17 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
         events_lib.emit("remat_policy", policy=h.remat_policy[0],
                         source=h.remat_policy[1],
                         predicted_bytes_per_step=nbytes)
+        # Weight-update sharding provenance, same contract: which mode the
+        # run actually compiled with and who elected it (env / tune_db /
+        # default) — the analyzer joins this with devmem's HBM samples to
+        # attribute optimizer-state residency deltas.
+        from tpuframe.parallel import zero1 as zero1_lib
+
+        events_lib.emit(
+            "weight_update", mode=h.weight_update[0],
+            source=h.weight_update[1],
+            n_shards=(zero1_lib.world_size(h.mesh)
+                      if h.mesh is not None else 1))
         run_info["devmem"] = devmem_lib.DevmemSampler(
             interval_s=float(os.environ.get("TPUFRAME_DEVMEM_INTERVAL_S",
                                             "30"))).start()

@@ -3,6 +3,7 @@
     python -m tpuframe.tune sweep --topology v5e:2x2   # the whole thing
     python -m tpuframe.tune sweep --remat               # remat policy search
     python -m tpuframe.tune sweep --serve               # serving decode grid
+    python -m tpuframe.tune sweep --zero1               # weight-update sharding
     python -m tpuframe.tune show                        # ranked DB contents
     python -m tpuframe.tune check                       # CI self-check
 
@@ -57,6 +58,11 @@ def _cmd_sweep(args) -> int:
                            batch=args.remat_batch,
                            policies=tuple(args.remat_policies)
                            if args.remat_policies else None)
+        return 0
+    if args.zero1:
+        search.zero1_sweep(args.topology, db_path=args.db,
+                           report_path=args.report,
+                           batch=args.zero1_batch)
         return 0
     search.sweep(args.topology, db_path=args.db, report_path=args.report,
                  seq=args.seq, head_dim=args.head_dim,
@@ -127,6 +133,11 @@ def main(argv=None) -> int:
                          "donated ResNet-50 train step (bytes objective) "
                          "instead of the fa/xla-opts grid")
     sw.add_argument("--remat-batch", type=int, default=512)
+    sw.add_argument("--zero1", action="store_true",
+                    help="sweep weight-update sharding (replicated vs "
+                         "ZeRO-1) over the donated ResNet-50 + BERT train "
+                         "steps (weight_update_* families)")
+    sw.add_argument("--zero1-batch", type=int, default=512)
     sw.add_argument("--remat-policies", nargs="+", default=None,
                     metavar="POLICY")
     sw.set_defaults(fn=_cmd_sweep)

@@ -140,6 +140,8 @@ class Record:
             env["TPUFRAME_BENCH_BATCH"] = str(cfg["batch"])
         if "remat_policy" in cfg:
             env["TPUFRAME_REMAT_POLICY"] = str(cfg["remat_policy"])
+        if "weight_update" in cfg:
+            env["TPUFRAME_WEIGHT_UPDATE"] = str(cfg["weight_update"])
         if "decode_block" in cfg:
             env["TPUFRAME_DECODE_BLOCK"] = str(cfg["decode_block"])
         if cfg.get("prompt_buckets"):
@@ -378,6 +380,31 @@ def resolve_remat_policy(program: str,
         return None
     pol = rec.config.get("remat_policy")
     return str(pol) if pol else None
+
+
+def resolve_weight_update(program: str,
+                          family: str | None = None) -> str | None:
+    """Weight-update sharding mode for ``program``: None unless the DB has
+    a swept ``weight_update_*`` winner for the target generation.  Callers
+    apply ``TPUFRAME_WEIGHT_UPDATE`` themselves FIRST via
+    :func:`tpuframe.parallel.zero1.resolve` — when the env var is set this
+    returns None so the override is unambiguous."""
+    if os.environ.get("TPUFRAME_WEIGHT_UPDATE", "").strip():
+        return None
+    gen = target_generation()
+    if gen is None:
+        return None
+    db = _open_for_resolution()
+    if db is None:
+        return None
+    rec = db.best(program=program, generation=gen)
+    if (rec is None or "weight_update" not in rec.config) \
+            and family is not None:
+        rec = db.best(family=family, generation=gen)
+    if rec is None:
+        return None
+    mode = rec.config.get("weight_update")
+    return str(mode) if mode else None
 
 
 def resolve_decode_block(default: int = 128) -> int:

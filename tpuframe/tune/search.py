@@ -438,6 +438,219 @@ def remat_sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
     return report
 
 
+def _zero1_step_compile(topo_devices, program: str, batch: int,
+                        weight_update: str):
+    """AOT-compile one donated train step over the FULL topology under one
+    weight-update mode.  Unlike the remat sweep's single-chip rig, the
+    collective swap is the whole point here — the reduce-scatter /
+    all-gather pair only exists with every chip in the mesh.  Returns
+    ``(compiled, desc, opt_state_bytes_per_chip, census)``."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpuframe import models
+    from tpuframe.models import losses
+    from tpuframe.parallel import mesh as mesh_lib
+    from tpuframe.parallel import step as step_lib
+    from tpuframe.parallel import zero1 as zero1_lib
+
+    n = len(topo_devices)
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=n),
+                              devices=list(topo_devices))
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, mesh_lib.batch_spec())
+
+    if program == "resnet50":
+        model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+
+        def loss_fn(params, model_state, batch, step_rng):
+            logits, mutated = model.apply(
+                {"params": params, **model_state}, batch["image"],
+                train=True, mutable=["batch_stats"])
+            loss = losses.softmax_cross_entropy(logits, batch["label"],
+                                                label_smoothing=0.1)
+            return loss, (dict(mutated), {})
+
+        variables = jax.eval_shape(
+            lambda k: model.init(
+                k, jnp.zeros((2, 224, 224, 3), jnp.bfloat16)),
+            jax.random.key(0))
+        model_state = {"batch_stats": variables["batch_stats"]}
+        batch_structs = {
+            "image": jax.ShapeDtypeStruct((batch, 224, 224, 3),
+                                          jnp.bfloat16, sharding=data),
+            "label": jax.ShapeDtypeStruct((batch,), jnp.int32,
+                                          sharding=data)}
+    elif program == "bert":
+        model = models.get_model("bert-base", num_classes=2)
+        tx = optax.adamw(2e-5)  # the GLUE fine-tune recipe — 2 moments
+
+        def loss_fn(params, model_state, batch, step_rng):
+            logits = model.apply(
+                {"params": params}, batch["input_ids"], train=True,
+                rngs={"dropout": step_rng})
+            loss = losses.softmax_cross_entropy(logits, batch["label"])
+            return loss, (model_state, {})
+
+        variables = jax.eval_shape(
+            lambda k: model.init(k, jnp.zeros((2, 128), jnp.int32)),
+            jax.random.key(0))
+        model_state = {}
+        batch_structs = {
+            "input_ids": jax.ShapeDtypeStruct((batch, 128), jnp.int32,
+                                              sharding=data),
+            "label": jax.ShapeDtypeStruct((batch,), jnp.int32,
+                                          sharding=data)}
+    else:
+        raise ValueError(f"unknown zero1 sweep program {program!r}")
+
+    params = variables["params"]
+    state = jax.eval_shape(
+        lambda v: step_lib.TrainState.create(v["params"], tx,
+                                             model_state=model_state),
+        variables)
+
+    census = zero1_lib.padding_census(params, n)
+    if weight_update == "zero1":
+        opt_state = jax.eval_shape(
+            lambda p: zero1_lib.init_opt_state(tx, p, n), params)
+        state = dataclasses.replace(state, opt_state=opt_state)
+        shardings = zero1_lib.state_shardings(state, mesh)
+        state = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh),
+            state, shardings)
+        opt_bytes = sum(
+            s.size * s.dtype.itemsize
+            for s in jax.tree.leaves(opt_state)) // n
+    else:
+        state = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                           sharding=repl), state)
+        opt_bytes = sum(s.size * s.dtype.itemsize
+                        for s in jax.tree.leaves(state.opt_state))
+
+    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=True,
+                                    weight_update=weight_update)
+    compiled = step.lower(state, batch_structs).compile()
+    desc = {"program": f"train_{program}_b{batch}", "n_chips": n,
+            "global_batch": batch, "donate": True,
+            "weight_update": weight_update}
+    return compiled, desc, opt_bytes, census
+
+
+def zero1_sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
+                report_path: str | None = None, batch: int = 512,
+                bert_batch: int = 256, log=None) -> dict:
+    """Offline weight-update sharding search: AOT-compile the donated
+    ResNet-50 and BERT train steps once per ``tpuframe.parallel.zero1``
+    mode over the full topology, rank on ``cost_analysis`` bytes accessed
+    plus per-chip optimizer-state HBM residency, and persist every
+    candidate to the ``weight_update_*`` DB families.  ZeRO-1
+    (arXiv:2004.13336) trades the all-reduce for a reduce-scatter +
+    all-gather at equal wire bytes; the win it is searched for here is the
+    (n-1)/n cut in optimizer-state residency and the update-math HBM
+    traffic that goes with it."""
+    import jax  # noqa: F401 — fail fast before holding the lock
+    from jax.experimental import topologies
+
+    hold_aot_lock()
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    gen = roofline.generation_from_topology(topology)
+    topo = topologies.get_topology_desc(topology, platform="tpu")
+    n = len(topo.devices)
+    programs = (("resnet50", batch), ("bert", bert_batch))
+    _log(f"zero1 sweep on {topology} ({n} chips): "
+         f"{[p for p, _ in programs]} x ('replicated', 'zero1')", log)
+
+    db_path = db_path or tune_db.default_db_path()
+    db = tune_db.TuningDB.open(db_path) if os.path.exists(db_path) \
+        else tune_db.TuningDB(db_path)
+    report = {"topology": topology, "generation": gen, "n_chips": n,
+              "objective": "bytes_accessed + opt_state_residency",
+              "weight_update": {"rows": [], "compile_errors": [],
+                                "padding_census": {}}}
+
+    for program, b in programs:
+        baseline = {}
+        for mode in ("replicated", "zero1"):
+            try:
+                compiled, desc, opt_bytes, census = _zero1_step_compile(
+                    topo.devices, program, b, mode)
+            except Exception as e:  # noqa: BLE001 — record, keep sweeping
+                row = {"program": program, "weight_update": mode,
+                       "error": f"{type(e).__name__}: {e}"[:300]}
+                report["weight_update"]["compile_errors"].append(row)
+                _log(f"  {program}/{mode}: COMPILE ERROR "
+                     f"{row['error'][:80]}", log)
+                continue
+            pred = roofline.score_compiled(compiled, gen)
+            pred["source"] = "compiled"
+            temp_gb = None
+            try:
+                temp_gb = round(
+                    compiled.memory_analysis().temp_size_in_bytes / 1e9, 2)
+            except Exception:  # noqa: BLE001 — best-effort
+                pass
+            if mode == "replicated":
+                baseline = {"bytes": pred["bytes"], "opt": opt_bytes}
+                report["weight_update"]["padding_census"][program] = {
+                    "total_param_bytes": census["total_bytes"],
+                    "padded_bytes": census["padded_bytes"],
+                    "waste_frac": census["waste_frac"],
+                    "n_shards": n}
+            row = {"program": program, "weight_update": mode,
+                   "global_batch": b,
+                   "gb": round(pred["bytes"] / 1e9, 3),
+                   "predicted_ms": pred["predicted_ms"],
+                   "bound": pred["bound"], "temp_gb": temp_gb,
+                   "opt_state_resident_mb": round(opt_bytes / 1e6, 2)}
+            if baseline.get("opt"):
+                row["opt_residency_drop_pct"] = round(
+                    100.0 * (1.0 - opt_bytes / baseline["opt"]), 1)
+            if baseline.get("bytes"):
+                row["bytes_drop_vs_replicated_pct"] = round(
+                    100.0 * (1.0 - pred["bytes"] / baseline["bytes"]), 1)
+            pred["opt_state_resident_bytes"] = int(opt_bytes)
+            db.add({"program": desc["program"],
+                    "family": f"weight_update_{program}",
+                    "fingerprint": tune_db.fingerprint(desc),
+                    "topology": topology, "generation": gen,
+                    "config": {"weight_update": mode, "batch": b},
+                    "predicted": pred})
+            report["weight_update"]["rows"].append(row)
+            _log(f"  {program}/{mode}: {row['gb']} GB accessed "
+                 f"({row['predicted_ms']} ms {row['bound']}-bound), "
+                 f"opt state {row['opt_state_resident_mb']} MB/chip", log)
+
+    rows = report["weight_update"]["rows"]
+    winners = {}
+    for program, _ in programs:
+        prog_rows = [r for r in rows if r["program"] == program]
+        prog_rows.sort(key=lambda r: (r["predicted_ms"] or float("inf"),
+                                      r["opt_state_resident_mb"]))
+        if prog_rows:
+            winners[program] = prog_rows[0]
+    report["winners"] = winners
+    db.save()
+    _log(f"tuning DB: {db.path} ({len(db.data['records'])} records)", log)
+    if report_path is None:
+        tag = topology.replace(":", "_").replace("x", "")
+        report_path = os.path.join(tune_db.repo_root(), "perf", "results",
+                                   f"zero1_report_{tag}.json")
+    os.makedirs(os.path.dirname(report_path), exist_ok=True)
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    _log(f"report: {report_path}", log)
+    return report
+
+
 def sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
           report_path: str | None = None, seq: int = 2048,
           head_dim: int = 64, heads: int = 8, fa_batch: int = 4,
