@@ -538,6 +538,47 @@ def test_tf107_suppression():
     assert source_lint.lint_source(src, "tpuframe/data/pipeline.py") == []
 
 
+def test_tf111_thread_outside_sanctioned_modules():
+    # A stray thread calling into collectives deadlocks a pod, so thread
+    # creation is reviewable policy: only the background-work homes may
+    # construct one (docs/DESIGN.md "Async checkpointing").
+    src = textwrap.dedent("""
+        import threading
+
+        def uploader(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+    """)
+    findings = source_lint.lint_source(src, "tpuframe/train.py")
+    assert [f.rule for f in findings] == ["TF111"]
+    for sanctioned in ("tpuframe/ckpt/checkpoint.py",
+                       "tpuframe/data/pipeline.py",
+                       "tpuframe/obs/heartbeat.py",
+                       "tpuframe/launch/launcher.py"):
+        assert source_lint.lint_source(src, sanctioned) == [], sanctioned
+
+
+def test_tf111_bare_thread_import_and_module_level():
+    src = textwrap.dedent("""
+        from threading import Thread
+
+        worker = Thread(target=print)
+    """)
+    findings = source_lint.lint_source(src, "tpuframe/parallel/step.py")
+    assert [f.rule for f in findings] == ["TF111"]
+
+
+def test_tf111_suppression():
+    src = textwrap.dedent("""
+        import threading
+
+        def sampler():
+            t = threading.Thread(target=print)  # tf-lint: ok[TF111]
+            t.start()
+    """)
+    assert source_lint.lint_source(src, "tpuframe/obs/devmem.py") == []
+
+
 def test_shipped_tree_self_lints_clean():
     import tpuframe
 

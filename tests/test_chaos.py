@@ -1,0 +1,355 @@
+"""Chaos harness: the CPU-mesh train loop under scheduled fault
+sequences (docs/DESIGN.md "Async checkpointing & the flush contract").
+
+Three properties of the async checkpoint pipeline, each proven under a
+deterministic injected fault instead of asserted from code reading:
+
+  * slow storage moves OFF the step path — ``goodput.productive`` of a
+    slow-GCS run matches the no-fault run and the save's ``block_ms``
+    stays tiny while its full span ``ms`` absorbs the injected delay
+    (sync saves eat the same delay ON the step path, for contrast);
+  * exact-continuation resume — SIGTERM with an upload in flight exits
+    rc 14 only after ``flush()`` commits, and the resumed run's final
+    loss equals an uninterrupted run's;
+  * no acknowledged-but-unwritten checkpoint — a worker crash mid-upload
+    leaves an uncommitted dir and NO ``ckpt_save`` event; stitched
+    across attempts, every ``ckpt_save`` event maps to a
+    committed-or-quarantined directory.
+
+Fault schedules are seeded through ``TPUFRAME_FAULTS`` (times=/delay_s=
+budgets, no wall-clock races), so every run here is reproducible.  The
+process-killing faults (crash, double-SIGTERM) run under a subprocess
+supervisor; the goodput comparison runs in-process on the shared
+8-device CPU mesh.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import optax
+import pytest
+
+import jax.numpy as jnp
+
+from tpuframe import ckpt
+from tpuframe import train as train_mod
+from tpuframe.ckpt.checkpoint import in_flight_step, latest_step
+from tpuframe.launch import launcher as launcher_mod
+from tpuframe.obs import events, goodput
+from tpuframe.obs import metrics
+from tpuframe.parallel import step as step_lib
+from tpuframe.resilience import RC_PREEMPTED, faults
+from tpuframe.utils import get_config
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_state(monkeypatch):
+    monkeypatch.delenv("TPUFRAME_FAULTS", raising=False)
+    monkeypatch.delenv("TPUFRAME_ASYNC_CKPT", raising=False)
+    monkeypatch.delenv(events.ENV_DIR, raising=False)
+    monkeypatch.delenv(events.ENV_ATTEMPT, raising=False)
+    faults.reset_from_env()
+    metrics.reset_counters("retry.")
+    events.close()
+    yield
+    faults.reset_from_env({})
+    metrics.reset_counters("retry.")
+    events.close()
+
+
+def _smoke_cfg(tmp_path, **over):
+    over.setdefault("distributed", False)
+    over.setdefault("log_every", 1000)
+    over.setdefault("eval_every", 1000)
+    over.setdefault("global_batch", 16)
+    over.setdefault("ckpt_dir", str(tmp_path / "ck"))
+    return get_config("smoke").with_overrides(**over)
+
+
+def _run_train(workdir, *, steps, ckpt_every, attempt=0, extra_env=None):
+    """One supervised training attempt in a subprocess (4 CPU devices),
+    with its event log and checkpoint dir under ``workdir`` so relaunch
+    attempts stitch into one stream."""
+    env = dict(os.environ)
+    env.pop("TPUFRAME_FAULTS", None)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": env.get("XLA_FLAGS", "") +
+        " --xla_force_host_platform_device_count=4",
+        events.ENV_DIR: str(workdir / "events"),
+        events.ENV_ATTEMPT: str(attempt),
+    })
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "tpuframe.train", "--config", "smoke",
+         "--set", f"total_steps={steps}", "--set", f"ckpt_every={ckpt_every}",
+         "--set", "log_every=2", "--set", "eval_every=1000",
+         "--set", "global_batch=8", "--set", "distributed=False",
+         "--ckpt-dir", str(workdir / "ck")],
+        env=env, capture_output=True, text=True, timeout=240)
+
+
+def _final_loss(proc, step):
+    line = next(l for l in proc.stdout.splitlines() if f"[train {step}]" in l)
+    return float(line.split("loss=")[1].split()[0])
+
+
+def _assert_commit_or_quarantine(ck_dir, merged):
+    """The cross-attempt stitcher invariant: every acknowledged save
+    (a ``ckpt_save`` event) corresponds to a committed-or-quarantined
+    directory — never an acknowledged-but-unwritten checkpoint."""
+    saves = [r for r in merged if r.get("type") == "ckpt_save"]
+    assert saves, "no ckpt_save events to check"
+    for r in saves:
+        name = f"step_{int(r['step']):08d}"
+        committed = (ck_dir / name / "COMMIT").exists()
+        quarantined = (ck_dir / f"{name}.corrupt").is_dir()
+        assert committed or quarantined, (
+            f"ckpt_save event for step {r['step']} but {name} is neither "
+            f"committed nor quarantined")
+
+
+# ---------------------------------------------------------------------------
+# Goodput proof: slow GCS off the step path (summarize comparison).
+# ---------------------------------------------------------------------------
+
+
+class TestSlowGcsGoodput:
+    # 4 delayed writes x 0.3s land on the step-10 save; 30 post-save
+    # steps (~2.5s of compute) give the async worker room to overlap.
+    _FAULT = "slow_gcs:delay_s=0.3:times=4"
+    _STEPS, _EVERY = 40, 10
+
+    def _run(self, tmp_path, monkeypatch, tag, *, fault, ckpt_async):
+        evdir = str(tmp_path / f"ev_{tag}")
+        monkeypatch.setenv(events.ENV_DIR, evdir)
+        if fault:
+            monkeypatch.setenv("TPUFRAME_FAULTS", fault)
+        else:
+            monkeypatch.delenv("TPUFRAME_FAULTS", raising=False)
+        out = train_mod.train(_smoke_cfg(
+            tmp_path / tag, total_steps=self._STEPS,
+            ckpt_every=self._EVERY, ckpt_async=ckpt_async))
+        assert out["step"] == self._STEPS
+        return events.merge(evdir)
+
+    def test_async_moves_ckpt_wall_off_step_path(self, tmp_path,
+                                                 monkeypatch):
+        base = self._run(tmp_path, monkeypatch, "base",
+                         fault=None, ckpt_async=True)
+        slow_async = self._run(tmp_path, monkeypatch, "slow_async",
+                               fault=self._FAULT, ckpt_async=True)
+        slow_sync = self._run(tmp_path, monkeypatch, "slow_sync",
+                              fault=self._FAULT, ckpt_async=False)
+
+        g_base = goodput.from_events(base)
+        g_async = goodput.from_events(slow_async)
+        g_sync = goodput.from_events(slow_sync)
+
+        # The injected 1.2s hits the sync run's step path...
+        assert g_sync["buckets"]["ckpt"] > 1.0, g_sync["buckets"]
+        # ...and stays off the async run's (snapshot blocking only).
+        assert g_async["buckets"]["ckpt"] < 0.8, g_async["buckets"]
+        # Productive time is storage-independent: the slow-GCS async run
+        # matches the no-fault run within CPU-timing noise.
+        p_base = g_base["buckets"]["productive"]
+        p_async = g_async["buckets"]["productive"]
+        assert abs(p_async - p_base) < max(1.0, 0.5 * p_base), (
+            p_base, p_async)
+
+        # Event-level evidence on the slowed save: the full span absorbs
+        # the delay, the step path never saw it.
+        slowed = next(r for r in slow_async
+                      if r.get("type") == "ckpt_save"
+                      and r["step"] == self._EVERY)
+        assert slowed["async_write"] is True
+        assert slowed["ms"] > 1000.0, slowed
+        assert slowed["block_ms"] < 500.0, slowed
+        assert slowed["ms"] > 3 * slowed["block_ms"]
+
+        # The blocked_ckpt detector agrees: the sync run is flagged, the
+        # async run is not — and the live meter's sums-to-wall invariant
+        # holds everywhere (no goodput_invariant findings).
+        kinds_sync = {f["kind"] for f in goodput.find_anomalies(slow_sync)}
+        kinds_async = {f["kind"] for f in goodput.find_anomalies(slow_async)}
+        assert "blocked_ckpt" in kinds_sync
+        assert "blocked_ckpt" not in kinds_async
+        assert "goodput_invariant" not in (kinds_sync | kinds_async)
+
+        # Both fault runs recorded the injections (fault_injected is
+        # emitted before the fault acts — even from the worker thread).
+        assert sum(1 for r in slow_async
+                   if r.get("type") == "fault_injected") == 4
+
+
+# ---------------------------------------------------------------------------
+# Crash mid-upload: no acknowledged-but-unwritten checkpoint.
+# ---------------------------------------------------------------------------
+
+
+def test_crash_during_upload_never_acknowledges(tmp_path):
+    work = tmp_path
+    crashed = _run_train(work, steps=6, ckpt_every=3, attempt=0,
+                         extra_env={"TPUFRAME_ASYNC_CKPT": "1",
+                                    "TPUFRAME_FAULTS":
+                                    "crash_during_upload:times=1"})
+    assert crashed.returncode == 42, crashed.stderr[-1500:]
+    assert "FAULT INJECTION" in crashed.stdout
+
+    ck = work / "ck"
+    # The step-3 save died after its shard files, before sidecar/COMMIT:
+    # visible to the supervisor's in-flight probe, invisible to resume.
+    assert (ck / "step_00000003").is_dir()
+    assert not (ck / "step_00000003" / "COMMIT").exists()
+    assert latest_step(str(ck)) is None
+    assert in_flight_step(str(ck)) == 3
+
+    # The ckpt_save event is emitted only after COMMIT, so the crashed
+    # attempt acknowledged nothing.
+    attempt0 = [r for r in events.merge(str(work / "events"))
+                if r["attempt"] == 0]
+    assert not any(r["type"] == "ckpt_save" for r in attempt0)
+    assert any(r["type"] == "fault_injected" for r in attempt0)
+
+    # Relaunch: nothing committed, so the attempt retrains from scratch
+    # and overwrites the torn step-3 leftovers on its way through.
+    resumed = _run_train(work, steps=6, ckpt_every=3, attempt=1,
+                         extra_env={"TPUFRAME_ASYNC_CKPT": "1"})
+    assert resumed.returncode == 0, resumed.stderr[-1500:]
+    assert latest_step(str(ck)) == 6
+
+    merged = events.merge(str(work / "events"))
+    assert {r["attempt"] for r in merged} == {0, 1}
+    _assert_commit_or_quarantine(ck, merged)
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM with a pending upload: rc 14 only after flush() commits, then
+# exact-continuation resume (golden-loss equality).
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_pending_upload_flushes_then_resumes_exactly(tmp_path):
+    straight = _run_train(tmp_path / "a", steps=6, ckpt_every=3,
+                          extra_env={"TPUFRAME_ASYNC_CKPT": "1"})
+    assert straight.returncode == 0, straight.stderr[-1500:]
+
+    work = tmp_path / "b"
+    # SIGTERM lands the instant the step-3 snapshot starts uploading;
+    # the slow_gcs budget guarantees the upload is genuinely in flight
+    # when the flag is checked at the step boundary.
+    preempted = _run_train(
+        work, steps=6, ckpt_every=3, attempt=0,
+        extra_env={"TPUFRAME_ASYNC_CKPT": "1",
+                   "TPUFRAME_FAULTS": "sigterm_pending_upload:times=1,"
+                                      "slow_gcs:delay_s=0.5:times=2"})
+    assert preempted.returncode == RC_PREEMPTED, preempted.stderr[-1500:]
+    assert "FAULT INJECTION: raising SIGTERM" in preempted.stdout
+    # rc 14 was only reached through flush(): the pending save is
+    # committed (not quarantined) and therefore acknowledged.
+    ck = work / "ck"
+    assert (ck / "step_00000003" / "COMMIT").exists()
+    assert not (ck / "step_00000003.corrupt").exists()
+    attempt0 = [r for r in events.merge(str(work / "events"))
+                if r["attempt"] == 0]
+    assert any(r["type"] == "ckpt_save" and r["step"] == 3
+               for r in attempt0)
+    assert any(r["type"] == "preempt" for r in attempt0)
+    assert any(r["type"] == "run_end" for r in attempt0)
+
+    resumed = _run_train(work, steps=6, ckpt_every=3, attempt=1,
+                         extra_env={"TPUFRAME_ASYNC_CKPT": "1"})
+    assert resumed.returncode == 0, resumed.stderr[-1500:]
+    assert "resumed from step 3" in resumed.stdout
+    np.testing.assert_allclose(_final_loss(resumed, 6),
+                               _final_loss(straight, 6), rtol=1e-4)
+
+    _assert_commit_or_quarantine(ck, events.merge(str(work / "events")))
+
+
+# ---------------------------------------------------------------------------
+# flush() unit contract: commit-or-quarantine at the deadline.
+# ---------------------------------------------------------------------------
+
+
+def _toy_state():
+    return step_lib.TrainState.create(
+        {"w": jnp.arange(12.0).reshape(3, 4), "b": jnp.ones(())},
+        optax.adam(1e-3))
+
+
+class TestFlush:
+    def test_flush_commits_and_returns_true(self, tmp_path):
+        mgr = ckpt.CheckpointManager(str(tmp_path), async_write=True)
+        state = _toy_state()
+        mgr.save(1, state)
+        assert mgr.flush(deadline_s=30.0) is True
+        assert (tmp_path / "step_00000001" / "COMMIT").exists()
+        assert mgr._pending == []
+        step, _ = mgr.restore_latest(target=state)
+        assert step == 1
+
+    def test_flush_sync_manager_is_trivial(self, tmp_path):
+        mgr = ckpt.CheckpointManager(str(tmp_path), async_write=False)
+        mgr.save(1, _toy_state())
+        assert mgr.flush(deadline_s=0.0) is True
+        assert (tmp_path / "step_00000001" / "COMMIT").exists()
+
+    def test_flush_deadline_quarantines_stranded_upload(self, tmp_path,
+                                                        monkeypatch,
+                                                        capsys):
+        # The worker wedges forever inside its first storage write (kind
+        # hang on the slow_gcs seam); flush must not wait on it past the
+        # deadline, and must leave nothing resume could mistake for a
+        # durable checkpoint.  The hung daemon thread never wakes again,
+        # so it cannot recreate the dir behind the test's back.
+        monkeypatch.setenv("TPUFRAME_FAULTS", "slow_gcs:kind=hang:times=1")
+        faults.reset_from_env()
+        mgr = ckpt.CheckpointManager(str(tmp_path), async_write=True)
+        mgr.save(1, _toy_state())
+        t0 = time.perf_counter()
+        assert mgr.flush(deadline_s=0.5) is False
+        assert time.perf_counter() - t0 < 5.0  # bounded, not a join()
+        assert (tmp_path / "step_00000001.corrupt").is_dir()
+        assert not (tmp_path / "step_00000001").exists()
+        assert latest_step(str(tmp_path)) is None
+        assert in_flight_step(str(tmp_path)) is None
+        assert mgr._pending == []
+        assert "quarantined" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# The supervisor's probe understands in-flight saves.
+# ---------------------------------------------------------------------------
+
+
+class TestInFlightProbe:
+    def test_in_flight_step_ignores_committed_and_corrupt(self, tmp_path):
+        os.makedirs(tmp_path / "step_00000004")
+        (tmp_path / "step_00000004" / "COMMIT").write_text("done")
+        os.makedirs(tmp_path / "step_00000007")  # upload in flight
+        os.makedirs(tmp_path / "step_00000005.corrupt")  # quarantined
+        assert latest_step(str(tmp_path)) == 4
+        assert in_flight_step(str(tmp_path)) == 7
+        assert in_flight_step(str(tmp_path / "absent")) is None
+
+    def test_progress_probe_counts_in_flight_saves(self, tmp_path):
+        probe = launcher_mod._progress_probe(
+            ["prog", "--ckpt-dir", str(tmp_path)])
+        assert probe() is None  # empty dir: no progress yet
+        os.makedirs(tmp_path / "step_00000010")
+        (tmp_path / "step_00000010" / "COMMIT").write_text("done")
+        assert probe() == 10
+        # A preempted-mid-upload step counts as progress: the relaunch
+        # either finishes the commit or retrains a few steps — it is not
+        # a crash loop, and the budget must not be charged as one.
+        os.makedirs(tmp_path / "step_00000020")
+        assert probe() == 20
+        # ...but a quarantined dir never does.
+        os.rename(tmp_path / "step_00000020",
+                  tmp_path / "step_00000020.corrupt")
+        assert probe() == 10

@@ -125,6 +125,49 @@ class TestShardedLoader:
         seen = [next(it) for _ in range(5)]  # 2 steps/epoch -> crosses twice
         assert len(seen) == 5
 
+    def test_from_step_exact_continuation_across_epoch_boundary(self):
+        """Resume positioning (SURVEY.md §5.4): a stream restarted at
+        step N must replay the exact remaining batch sequence of an
+        uninterrupted run — including the reshuffle at the epoch
+        boundary it crosses."""
+        train, _ = mnist(synthetic_size=64)
+        straight = ShardedLoader(train, 32, seed=5)  # 2 steps/epoch
+        it = iter(straight)
+        want = [next(it) for _ in range(6)][3:]  # steps 3..5: epochs 1-2
+        resumed = ShardedLoader(train, 32, seed=5)
+        got_it = resumed.from_step(3)
+        got = [next(got_it) for _ in range(3)]
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(np.asarray(w["label"]),
+                                          np.asarray(g["label"]))
+            np.testing.assert_array_equal(np.asarray(w["image"]),
+                                          np.asarray(g["image"]))
+
+    def test_prefetch_worker_exception_propagates(self):
+        """A crash inside the prefetch thread (decoder bug, bad shard)
+        must surface in the consumer as the original exception, after
+        the batches assembled before it — never a silent hang on an
+        empty queue."""
+        train, _ = mnist(synthetic_size=64)
+        calls = {"n": 0}
+
+        class _FlakyDataset:
+            def __len__(self):
+                return len(train)
+
+            def __getitem__(self, idx):
+                calls["n"] += 1
+                if calls["n"] >= 3:
+                    raise RuntimeError("decoder blew up")
+                return train[idx]
+
+        loader = ShardedLoader(_FlakyDataset(), 16, shuffle=False)
+        it = loader.epoch(0)
+        next(it), next(it)  # assembled before the fault: still delivered
+        with pytest.raises(RuntimeError, match="decoder blew up"):
+            for _ in it:
+                pass
+
     def test_cast_floats_halves_infeed_and_matches_device_cast(self):
         import jax.numpy as jnp
 

@@ -223,6 +223,9 @@ def test_event_merge_orders_across_hosts(tmp_path):
 def test_validate_record_catches_contract_breaks():
     good = _rec(1.0, "stall", last_step=4, idle_s=9.0)
     assert events.validate_record(good) == []
+    # Both shipped schema generations read; an unknown future one fails.
+    assert events.validate_record({**good, "schema": 2}) == []
+    assert events.SCHEMA_VERSION in events.ACCEPTED_SCHEMAS
     assert events.validate_record({**good, "schema": 99})
     assert events.validate_record(_rec(1.0, "no_such_type"))
     missing = _rec(1.0, "run_end")  # no final_step/wall_s/goodput
@@ -352,6 +355,93 @@ def test_anomaly_low_mfu_opt_in():
     assert [f["kind"] for f in found] == ["low_mfu"]
 
 
+def test_anomaly_blocked_input_and_blocked_ckpt():
+    stream = [
+        _rec(1.0, "step", step=1, wall_ms=400.0, input_wait_ms=2.0),
+        _rec(2.0, "step", step=2, wall_ms=400.0, input_wait_ms=1800.0),
+        # sync save: the whole write blocks the step path (v1: no
+        # block_ms, ms is the blocking time)
+        _rec(3.0, "ckpt_save", step=2, ms=2500.0),
+        # async save: huge span, tiny blocking slice — NOT flagged
+        _rec(4.0, "ckpt_save", step=4, ms=9000.0, block_ms=40.0,
+             async_write=True),
+        _rec(9.0, "run_end", final_step=4, wall_s=9.0, goodput={}),
+    ]
+    kinds = sorted(f["kind"] for f in goodput.find_anomalies(stream))
+    assert kinds == ["blocked_ckpt", "blocked_input"]
+    blocked = {f["kind"]: f for f in goodput.find_anomalies(stream)}
+    assert blocked["blocked_input"]["step"] == 2
+    assert blocked["blocked_ckpt"]["step"] == 2  # the sync one, not async
+    # The threshold is policy: raising it past both clears the findings.
+    assert goodput.find_anomalies(stream, blocked_ms=3000.0) == []
+
+
+def test_anomaly_goodput_invariant_sums_to_wall():
+    def run_end(buckets, wall):
+        return _rec(10.0, "run_end", final_step=2, wall_s=wall,
+                    goodput={"wall_s": wall, "buckets": buckets})
+
+    ok = {"init": 1.0, "compile": 2.0, "productive": 3.0, "input": 0.5,
+          "ckpt": 0.5, "eval": 0.0, "stall": 0.0, "other": 3.0}
+    assert goodput.find_anomalies([run_end(ok, 10.0)]) == []
+    # A lost slice (other dropped a second) violates the partition and
+    # is flagged, never silently renormalized.
+    bad = dict(ok, other=2.0)
+    found = goodput.find_anomalies([run_end(bad, 10.0)])
+    assert [f["kind"] for f in found] == ["goodput_invariant"]
+    assert found[0]["bucket_sum_s"] == pytest.approx(9.0)
+    # run_end with no buckets at all (crashed mid-write): not flagged
+    # here — no_run_end and the reconstruction path own that case.
+    assert goodput.find_anomalies(
+        [_rec(1.0, "run_end", final_step=0, wall_s=5.0, goodput={})]) == []
+
+
+def test_from_events_v2_input_and_async_block_reconstruction():
+    # Crashed attempt (no run_end), schema-2 records: input_wait_ms
+    # accumulates into the input bucket, and an async ckpt_save charges
+    # only its block_ms — the upload tail overlapped training and must
+    # not be billed to ckpt.
+    stream = [
+        _rec(0.0, "step", step=1, wall_ms=5000.0, input_wait_ms=1000.0),
+        _rec(10.0, "step", step=2, wall_ms=500.0, input_wait_ms=250.0),
+        _rec(11.0, "step", step=3, wall_ms=500.0, input_wait_ms=250.0),
+        _rec(12.0, "ckpt_save", step=3, ms=6000.0, block_ms=100.0,
+             async_write=True),
+        _rec(20.0, "step", step=4, wall_ms=500.0),  # v1 record: no wait
+    ]
+    b = goodput.from_events(stream)["buckets"]
+    assert b["input"] == pytest.approx(1.5)
+    assert b["ckpt"] == pytest.approx(0.1)
+    assert b["compile"] == pytest.approx(5.0)
+    assert b["productive"] == pytest.approx(1.5)
+    # v1 async save without block_ms: blocking unknown, charged as 0 —
+    # a v1 sync save still charges its full ms.
+    v1 = [_rec(0.0, "step", step=1, wall_ms=1000.0),
+          _rec(5.0, "ckpt_save", step=1, ms=2000.0, async_write=True),
+          _rec(9.0, "ckpt_save", step=1, ms=2000.0)]
+    assert goodput.from_events(v1)["buckets"]["ckpt"] == pytest.approx(2.0)
+
+
+def test_async_ckpt_sample_is_schema2_with_input_bucket():
+    # The shipped async-checkpoint sample run: schema 2 end to end,
+    # validating alongside the schema-1 main sample (ACCEPTED_SCHEMAS
+    # spans both), with the input bucket populated and the async save's
+    # block_ms << ms.
+    sample = str(pathlib.Path(_SAMPLES) / "async_ckpt")
+    files = events.event_files(sample)
+    assert files and events.validate_files(files) == []
+    merged = events.merge(sample)
+    assert all(r["schema"] == 2 for r in merged)
+    s = goodput.from_events(merged)
+    assert s["buckets"]["input"] > 0
+    assert sum(s["buckets"].values()) == pytest.approx(s["wall_s"],
+                                                       abs=0.05)
+    save = next(r for r in merged if r["type"] == "ckpt_save")
+    assert save["async_write"] and save["ms"] > 10 * save["block_ms"]
+    kinds = [f["kind"] for f in goodput.find_anomalies(merged)]
+    assert kinds == ["blocked_input"]  # the deliberately starved step 6
+
+
 # ---------------------------------------------------------------------------
 # obs v2: devmem telemetry (no-op on CPU), heartbeat events, counters.
 # ---------------------------------------------------------------------------
@@ -444,6 +534,13 @@ def test_obs_cli_selfcheck_and_anomalies(tmp_path, capsys):
     assert obs_main(["anomalies", _SAMPLES]) == 1
     out = capsys.readouterr().out
     assert "[stall]" in out and "[no_run_end]" in out
+    # --blocked-ms is plumbed through: past the async sample's starved
+    # step (1350 ms) the scan comes back clean.
+    async_sample = str(pathlib.Path(_SAMPLES) / "async_ckpt")
+    assert obs_main(["anomalies", async_sample]) == 1
+    assert "[blocked_input]" in capsys.readouterr().out
+    assert obs_main(["anomalies", async_sample, "--blocked-ms",
+                     "2000"]) == 0
     merged = tmp_path / "merged.jsonl"
     assert obs_main(["merge", _SAMPLES, "-o", str(merged)]) == 0
     lines = [json.loads(l) for l in merged.read_text().splitlines()]

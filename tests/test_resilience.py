@@ -337,6 +337,32 @@ class TestPreemption:
         finally:
             guard.uninstall()
 
+    def test_second_sigterm_escalates_to_kill(self, tmp_path):
+        """A second SIGTERM (the supervisor's kill-after-grace) must
+        actually terminate a wedged run — re-delivered with the guard
+        uninstalled, so the default action fires.  Subprocess: the
+        escalation kills the whole process by design."""
+        import subprocess
+        import sys
+        import textwrap
+
+        script = textwrap.dedent("""
+            import os, signal
+            from tpuframe.resilience.preempt import PreemptionGuard
+            g = PreemptionGuard().install()
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert g.requested and g.signal_name == "SIGTERM"
+            os.kill(os.getpid(), signal.SIGTERM)  # escalation: no return
+            print("SHIELDED")  # must be unreachable
+        """)
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+        out = subprocess.run([sys.executable, "-c", script], env=env,
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == -signal.SIGTERM, (out.returncode,
+                                                   out.stderr[-800:])
+        assert "SHIELDED" not in out.stdout
+
     def test_reassert_takes_signal_back(self):
         """jax.distributed's preemption notifier steals SIGTERM after the
         guard installs; reassert() must reclaim it (regression: preemption

@@ -66,6 +66,14 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          layout exists to avoid.  ``parallel/hvd.py`` is seam-adjacent
          (it *composes* an ``optax.GradientTransformation``; step.py
          still applies it) and exempt.
+  TF111  background thread outside the sanctioned modules — a
+         ``threading.Thread`` created anywhere but ``ckpt/``,
+         ``data/pipeline.py``, ``obs/heartbeat.py`` or ``launch/``.
+         Background threads issuing collectives is the ordering hazard
+         ``ckpt/checkpoint.py`` documents (a worker's collective
+         interleaving with the main loop's compiled steps); the
+         sanctioned modules are the ones audited to never do that.
+         Threads that provably never touch jax suppress with a reason.
   TF106  compiler-env mutation that can run after jax backend init —
          ``os.environ["XLA_FLAGS"] = ...`` (or ``LIBTPU_INIT_ARGS``,
          via assignment/setdefault/update/putenv) is snapshotted by the
@@ -115,6 +123,9 @@ RULES = {
              "bucketed AOT table (serve/engine.py)",
     "TF110": "optimizer update (tx.update/optax.apply_updates) outside "
              "the weight-update seam (parallel/step.py, parallel/zero1.py)",
+    "TF111": "threading.Thread created outside the sanctioned background-"
+             "work modules (ckpt/, data/pipeline.py, obs/heartbeat.py, "
+             "launch/)",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -155,6 +166,17 @@ _WU_EXEMPT_SUFFIXES = ("parallel/step.py", "parallel/zero1.py",
 # Receivers whose ``.update(grads, state, ...)`` is optimizer math rather
 # than a dict/metric update — the optax transformation naming convention.
 _WU_OPTIMIZER_RECEIVERS = {"tx", "optimizer", "opt", "inner_tx"}
+
+# TF111: modules sanctioned to spawn background threads.  Everywhere
+# else a thread is the collective-ordering hazard checkpoint.py
+# documents: a background thread issuing (or transitively triggering)
+# collectives interleaves with the main loop's compiled steps, and the
+# sanctioned modules are exactly the ones audited to never do that
+# (ckpt's worker polls sidecar files instead of a barrier; the prefetch
+# thread only device_puts; heartbeat only reads a counter; launch runs
+# before any backend exists).
+_THREAD_SANCTIONED_PARTS = ("ckpt/", "data/pipeline.py",
+                            "obs/heartbeat.py", "launch/")
 
 # TF105a: google.cloud.storage blob/bucket methods — allowed only inside
 # the retry-wrapped data/gcs.py layer.
@@ -296,6 +318,8 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
     wu_scope = ((_WU_SCOPE_PART in norm_path
                  or norm_path.endswith(_WU_SCOPE_SUFFIX))
                 and not norm_path.endswith(_WU_EXEMPT_SUFFIXES))
+    thread_scope = not any(p in norm_path
+                           for p in _THREAD_SANCTIONED_PARTS)
 
     # TF106: a module-level compiler-env write is safe only BEFORE the
     # module-level jax import (the conftest/bootstrap pattern).
@@ -456,6 +480,17 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
                      f"parallel/zero1.py's sharded_update) so "
                      f"TPUFRAME_WEIGHT_UPDATE=zero1 still shards the "
                      f"update and optimizer state", fn)
+            if (thread_scope
+                    and callee in ("threading.Thread", "Thread")):
+                emit("TF111", node,
+                     f"{callee}() outside the sanctioned background-work "
+                     f"modules (ckpt/, data/pipeline.py, "
+                     f"obs/heartbeat.py, launch/) — a background thread "
+                     f"that issues collectives interleaves with the main "
+                     f"loop's compiled steps (the ordering hazard "
+                     f"ckpt/checkpoint.py documents); if the thread "
+                     f"provably never touches jax, suppress with "
+                     f"tf-lint: ok[TF111] and a reason", fn)
             if remat_scope and callee in _BARE_REMAT_CALLEES:
                 emit("TF108", node,
                      f"{callee}() bare rematerialization in model/step "

@@ -180,6 +180,11 @@ def _write_sidecar(path: str, crc_local: dict) -> None:
 def _write_owned(path: str, owned_files) -> dict:
     """Files + sidecar in one call (the async worker's whole write)."""
     crc_local = _write_files(path, owned_files)
+    # Hard-kill seam between the shard files and the sidecar: the worst
+    # moment for an upload to die — bytes are on storage but nothing
+    # acknowledges them.  The chaos harness proves resume never trusts
+    # this state (no sidecar -> no COMMIT -> torn, invisible to resume).
+    faults.fire("crash_during_upload")
     _write_sidecar(path, crc_local)
     return crc_local
 
@@ -563,6 +568,27 @@ def latest_step(directory: str) -> int | None:
     return steps[-1] if steps else None
 
 
+def in_flight_step(directory: str) -> int | None:
+    """Highest ``step_N`` directory WITHOUT a COMMIT — evidence of an
+    async save still uploading (or killed mid-upload).  The supervisor's
+    progress probe treats this as progress past the last committed step:
+    a job preempted with a snapshot in flight did advance, and charging
+    its relaunch budget for the commit it never got to finish would turn
+    every slow-storage preemption into a spurious crash-loop verdict.
+    Quarantined ``.corrupt`` dirs don't match the pattern and never
+    count."""
+    steps = []
+    try:
+        names = gcs.listdir(directory)
+    except OSError:
+        return None
+    for name in names:
+        m = _STEP_RE.match(name)
+        if m and not gcs.exists(gcs.join(directory, name, _COMMIT)):
+            steps.append(int(m.group(1)))
+    return max(steps) if steps else None
+
+
 def quarantine_step(directory: str, step: int) -> str:
     """Rename ``step_N`` to ``step_N.corrupt`` so resume skips it forever
     while the evidence survives for post-mortem.  Process 0 only — a pod
@@ -595,7 +621,9 @@ class CheckpointManager:
         self.every_steps = every_steps
         self.keep = keep
         self.async_write = async_write
-        self._pending: list[threading.Thread] = []
+        # (worker, step, path) per in-flight async save — flush() needs
+        # the step/path to quarantine a deadline-stranded upload.
+        self._pending: list[tuple[threading.Thread, int, str]] = []
         self._errors: list[str] = []
         gcs.makedirs(directory)
 
@@ -607,8 +635,10 @@ class CheckpointManager:
             t0 = time.perf_counter()
             path = save(self.directory, step, tree)
             self._gc()
-            obs_events.emit("ckpt_save", step=step,
-                            ms=round((time.perf_counter() - t0) * 1e3, 3),
+            ms = round((time.perf_counter() - t0) * 1e3, 3)
+            # block_ms == ms for sync saves: the whole write sits on the
+            # step path (what the blocked_ckpt anomaly detector reads).
+            obs_events.emit("ckpt_save", step=step, ms=ms, block_ms=ms,
                             async_write=False)
             return path
         prep_t0 = time.time()
@@ -619,11 +649,15 @@ class CheckpointManager:
         # block briefly on the oldest instead of accumulating snapshots
         # until the host OOMs; and prune finished workers (only the newest
         # is needed for ordering).
-        self._pending = [t for t in self._pending if t.is_alive()]
+        self._pending = [p for p in self._pending if p[0].is_alive()]
         while len(self._pending) >= 2:
-            self._pending[0].join()
-            self._pending = [t for t in self._pending if t.is_alive()]
-        prev = self._pending[-1] if self._pending else None
+            self._pending[0][0].join()
+            self._pending = [p for p in self._pending if p[0].is_alive()]
+        prev = self._pending[-1][0] if self._pending else None
+        # What the step path actually waited for: the snapshot plus any
+        # backpressure join above.  Captured here so the worker can stamp
+        # it on the ckpt_save event next to the full span.
+        block_ms = round((time.time() - prep_t0) * 1e3, 3)
 
         def work():
             try:
@@ -634,9 +668,10 @@ class CheckpointManager:
                           min_mtime=prep_t0 - 60.0)
                 self._gc()
                 # ms spans snapshot through commit; the train loop only
-                # blocked for the snapshot slice (its goodput charge).
+                # blocked for block_ms (the snapshot slice).
                 obs_events.emit("ckpt_save", step=step,
                                 ms=round((time.time() - prep_t0) * 1e3, 3),
+                                block_ms=block_ms,
                                 async_write=True)
             except Exception as e:  # noqa: BLE001 — surfaced by wait_pending
                 self._errors.append(f"save step {step}: "
@@ -644,9 +679,12 @@ class CheckpointManager:
 
         t = threading.Thread(target=work, name=f"ckpt-save-{step}",
                              daemon=True)
-        self._pending.append(t)
+        self._pending.append((t, step, path))
         self._last_path = path
         t.start()
+        # Preemption-while-uploading seam: SIGTERM lands the instant a
+        # snapshot is in flight — the exact window flush() exists for.
+        faults.fire("sigterm_pending_upload")
         return path
 
     def save_best(self, step: int, tree: PyTree, metric: float,
@@ -720,7 +758,7 @@ class CheckpointManager:
         host additionally polls for it — after this returns, the newest
         checkpoint is durably visible to all hosts (or the timeout left it
         torn, which restore already tolerates)."""
-        for t in self._pending:
+        for t, _, _ in self._pending:
             t.join()
         self._pending.clear()
         if self._errors:
@@ -737,6 +775,50 @@ class CheckpointManager:
                       f"{commit_timeout_s}s", flush=True)
                 return
             time.sleep(0.2)
+
+    def flush(self, deadline_s: float = 60.0) -> bool:
+        """Deadline-bounded drain of pending async saves — the preemption
+        exit gate (train.py calls this before raising rc 14, inside the
+        SIGTERM grace window).
+
+        Commit-or-quarantine: every pending save either commits within
+        the deadline (returns True) or its uncommitted ``step_N`` dir is
+        quarantined to ``step_N.corrupt`` (returns False) — the directory
+        is never left in a state a later resume, GC pass, or progress
+        probe could mistake for durable.  Worker errors are printed, not
+        raised: the caller is exiting on a grace timer, and the
+        quarantine below already neutralizes whatever the failed save
+        left behind.  Sync managers have nothing in flight and return
+        True immediately."""
+        deadline = time.time() + deadline_s
+        for t, _, _ in self._pending:
+            t.join(max(0.0, deadline - time.time()))
+        pending, self._pending = self._pending, []
+        if self._errors:
+            print(f"[ckpt] flush: async save error(s): "
+                  f"{'; '.join(self._errors)}", flush=True)
+            self._errors = []
+        all_committed = True
+        for t, step, path in pending:
+            committed = gcs.exists(gcs.join(path, _COMMIT))
+            # Non-primary hosts: the COMMIT comes from process 0's
+            # finalizer, possibly after the local worker finished — poll
+            # out the remaining deadline for it.  (A still-alive local
+            # worker means this host's sidecar isn't written, so process 0
+            # cannot commit yet; no point polling.)
+            while (not committed and not t.is_alive()
+                   and jax.process_index() != 0
+                   and time.time() <= deadline):
+                time.sleep(0.1)
+                committed = gcs.exists(gcs.join(path, _COMMIT))
+            if committed:
+                continue
+            all_committed = False
+            quarantine_step(self.directory, step)  # process 0 renames
+            print(f"[ckpt] flush: step {step} uncommitted at deadline "
+                  f"({deadline_s:.1f}s) — quarantined, resume will use "
+                  f"the previous committed step", flush=True)
+        return all_committed
 
     def maybe_save(self, step: int, tree: PyTree) -> str | None:
         return self.save(step, tree) if self.should_save(step) else None

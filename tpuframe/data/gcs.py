@@ -79,6 +79,10 @@ def _read_bytes_once(path: str) -> bytes:
 
 
 def write_bytes(path: str, data: bytes) -> None:
+    # Degraded-storage seam, outside the retry wrapper: ``slow_gcs``
+    # models a slow-but-healthy backend, so the delay must not eat the
+    # attempt timeout or register as a retryable failure.
+    faults.fire("slow_gcs")
     _POLICY.call(_write_bytes_once, path, data, op="gcs_write")
 
 

@@ -239,10 +239,18 @@ def _progress_probe(cmd: list[str]):
         return None
 
     def probe():
-        from tpuframe.ckpt.checkpoint import latest_step
+        from tpuframe.ckpt.checkpoint import in_flight_step, latest_step
 
         try:
-            return latest_step(ckpt_dir)
+            # In-flight saves count: a job preempted mid-upload advanced
+            # past its last COMMIT, and the relaunch will either finish
+            # the commit or retrain those few steps — either way it is
+            # not a crash loop, and the budget must not be charged as
+            # one.
+            marks = [s for s in (latest_step(ckpt_dir),
+                                 in_flight_step(ckpt_dir))
+                     if s is not None]
+            return max(marks) if marks else None
         except Exception:  # noqa: BLE001 — a flaky probe must not kill the
             # supervisor; "unknown" just means no budget refresh this round.
             return None

@@ -10,7 +10,10 @@ single file):
              event files (the analysis CI gate calls this).
   merge      one time-ordered multi-host stream to stdout or ``-o``.
   anomalies  step-time regressions vs. a rolling median, heartbeat
-             stalls, retry storms, low MFU, attempts with no run_end.
+             stalls, retry storms, low MFU, attempts with no run_end,
+             steps blocked on the input pipeline or on a checkpoint
+             save beyond ``--blocked-ms``, and attempts whose goodput
+             buckets fail the sums-to-wall invariant.
              Exits 1 when anything is flagged (scriptable).
 
 Examples::
@@ -180,7 +183,8 @@ def cmd_anomalies(directory: str, args) -> int:
     merged = _load(directory)
     findings = goodput_lib.find_anomalies(
         merged, slow_factor=args.slow_factor, window=args.window,
-        retry_storm=args.retry_storm, mfu_min=args.mfu_min)
+        retry_storm=args.retry_storm, mfu_min=args.mfu_min,
+        blocked_ms=args.blocked_ms)
     for f in findings:
         print(f"ANOMALY [{f['kind']}] {f['detail']}")
     print(f"[obs] anomalies: {len(findings)} finding(s)")
@@ -216,6 +220,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="retries within 60s that count as a storm")
     ap.add_argument("--mfu-min", type=float, default=None,
                     help="flag MFU below this fraction (off by default)")
+    ap.add_argument("--blocked-ms", type=float, default=1000.0,
+                    help="flag steps blocked on input or checkpoint "
+                         "saves beyond this many ms (default 1000)")
 
     args = p.parse_args(argv)
     if args.cmd == "summarize":

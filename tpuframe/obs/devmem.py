@@ -85,7 +85,9 @@ class DevmemSampler:
         self.active = True
         self._record(first)
         self.emit_fn(devices=first)
-        self._thread = threading.Thread(target=self._watch, daemon=True,
+        # Reads device.memory_stats() only — a local PJRT query, never a
+        # collective — so the TF111 ordering hazard does not apply.
+        self._thread = threading.Thread(target=self._watch, daemon=True,  # tf-lint: ok[TF111]
                                         name="tpuframe-devmem")
         self._thread.start()
         return self

@@ -13,9 +13,15 @@ together by the ``attempt`` field the supervisor increments
 
 Record envelope (every line)::
 
-    {"schema": 1, "type": "<event type>", "t": <unix seconds>,
+    {"schema": 2, "type": "<event type>", "t": <unix seconds>,
      "host": "<hostname>", "proc": <process index>, "attempt": <int>,
      ...type-specific fields}
+
+Schema history: v2 added the ``input`` goodput bucket (``run_end``'s
+``goodput.buckets``) and the optional ``input_wait_ms``/``block_ms``
+fields on ``step``/``ckpt_save``.  v1 logs stay readable — the new
+fields are additive, so the validator accepts every version in
+``ACCEPTED_SCHEMAS`` and the analyzer treats the absent fields as zero.
 
 Event types (see ``REQUIRED_FIELDS`` for the per-type contract):
 
@@ -66,7 +72,12 @@ import socket
 import threading
 import time
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+
+# Every schema this reader still understands.  Bumping SCHEMA_VERSION
+# without keeping the predecessor here strands existing logs (and the
+# shipped docs/samples/, which the CI selfcheck validates on purpose).
+ACCEPTED_SCHEMAS = (1, 2)
 
 ENV_DIR = "TPUFRAME_EVENTS_DIR"
 ENV_ATTEMPT = "TPUFRAME_ATTEMPT"
@@ -250,9 +261,9 @@ def validate_record(rec: dict) -> list[str]:
     for key in _ENVELOPE:
         if key not in rec:
             problems.append(f"missing envelope key {key!r}")
-    if rec.get("schema") != SCHEMA_VERSION:
+    if rec.get("schema") not in ACCEPTED_SCHEMAS:
         problems.append(f"unknown schema version {rec.get('schema')!r} "
-                        f"(this reader knows {SCHEMA_VERSION})")
+                        f"(this reader knows {ACCEPTED_SCHEMAS})")
     etype = rec.get("type")
     if etype in REQUIRED_FIELDS:
         for key in REQUIRED_FIELDS[etype]:

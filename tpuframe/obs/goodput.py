@@ -16,6 +16,10 @@ Buckets (seconds; they partition attempt wall time):
               host-side the two are indistinguishable, and the compile
               dominates by orders of magnitude)
   productive  steps 2..N — the only bucket that moves the loss
+  input       time the train loop sat blocked on the data pipeline
+              (``next(data_iter)`` / the prefetch queue's ``q.get()``) —
+              the MLPerf-pod scaling work's "input stall" number
+              (arXiv:1909.09756), split out of step time in schema v2
   ckpt        blocking checkpoint time (async saves cost only their
               snapshot slice)
   eval        eval passes (incl. the eval program's first compile)
@@ -44,8 +48,8 @@ import time
 
 from tpuframe.tune import roofline
 
-BUCKETS = ("init", "compile", "productive", "ckpt", "eval", "stall",
-           "other")
+BUCKETS = ("init", "compile", "productive", "input", "ckpt", "eval",
+           "stall", "other")
 
 DEFAULT_GENERATION = "v5e"
 
@@ -251,9 +255,21 @@ def from_events(events: list[dict], *,
             local["compile"] += float(steps[0].get("wall_ms", 0.0)) / 1e3
             local["productive"] += sum(
                 float(r.get("wall_ms", 0.0)) for r in steps[1:]) / 1e3
+            # Schema v2: data-pipeline wait rides on each step record,
+            # already excluded from its wall_ms; absent (v1) means zero.
+            local["input"] += sum(
+                float(r.get("input_wait_ms", 0.0)) for r in steps) / 1e3
         for r in stream:
             if r.get("type") == "ckpt_save":
-                local["ckpt"] += float(r.get("ms", 0.0)) / 1e3
+                # ``block_ms`` (v2) is the slice the step path actually
+                # waited — for async saves, just the snapshot; ``ms``
+                # spans through commit, which for async runs mostly
+                # overlaps training and must not be charged to ckpt.
+                blocked = r.get("block_ms")
+                if blocked is None:
+                    blocked = 0.0 if r.get("async_write") \
+                        else r.get("ms", 0.0)
+                local["ckpt"] += float(blocked) / 1e3
             elif r.get("type") == "stall":
                 local["stall"] += float(r.get("idle_s", 0.0))
         for k, v in local.items():
@@ -310,7 +326,8 @@ def from_events(events: list[dict], *,
 def find_anomalies(events: list[dict], *, slow_factor: float = 3.0,
                    window: int = 16, retry_storm: int = 5,
                    retry_window_s: float = 60.0,
-                   mfu_min: float | None = None) -> list[dict]:
+                   mfu_min: float | None = None,
+                   blocked_ms: float = 1000.0) -> list[dict]:
     """Flag suspicious shapes in a merged event stream.
 
     Detectors (each finding: ``{"kind", "detail", ...anchors}``):
@@ -327,6 +344,17 @@ def find_anomalies(events: list[dict], *, slow_factor: float = 3.0,
         thresholds are workload policy, not a universal constant).
       no_run_end       — an attempt that never wrote ``run_end``: the
         run died (crash, preemption without commit, or still live).
+      blocked_input    — a step waited > ``blocked_ms`` on the data
+        pipeline (``input_wait_ms``): the loader can't keep up, the
+        exact stall class arXiv:1909.09756 warns erases pod efficiency.
+      blocked_ckpt     — a save blocked the step path > ``blocked_ms``
+        (``block_ms``; sync saves' full ``ms``): checkpointing is on
+        the step path — the async pipeline exists to make this ~0.
+      goodput_invariant — an attempt's ``run_end`` buckets do not sum
+        to its wall time.  Flagged loudly instead of renormalized: a
+        violated partition means a double-charged or lost slice, and
+        silently rescaling it would hide the accounting bug the
+        invariant exists to catch.
     """
     findings: list[dict] = []
 
@@ -384,6 +412,29 @@ def find_anomalies(events: list[dict], *, slow_factor: float = 3.0,
                 "detail": f"MFU {got:.2%} below threshold "
                           f"{mfu_min:.2%}"})
 
+    if blocked_ms is not None:
+        for r in events:
+            if (r.get("type") == "step"
+                    and float(r.get("input_wait_ms") or 0.0) > blocked_ms):
+                w = float(r["input_wait_ms"])
+                findings.append({
+                    "kind": "blocked_input", "step": r.get("step"),
+                    "input_wait_ms": round(w, 2), "threshold_ms": blocked_ms,
+                    "detail": f"step {r.get('step')} blocked {w:.0f} ms on "
+                              f"the input pipeline (> {blocked_ms:.0f} ms)"})
+            elif r.get("type") == "ckpt_save":
+                blk = r.get("block_ms")
+                if blk is None and not r.get("async_write"):
+                    blk = r.get("ms")  # schema v1 sync save: all blocking
+                if blk is not None and float(blk) > blocked_ms:
+                    findings.append({
+                        "kind": "blocked_ckpt", "step": r.get("step"),
+                        "block_ms": round(float(blk), 2),
+                        "threshold_ms": blocked_ms,
+                        "detail": f"save at step {r.get('step')} blocked "
+                                  f"the step path {float(blk):.0f} ms "
+                                  f"(> {blocked_ms:.0f} ms)"})
+
     for stream in _attempts(events):
         if not any(r.get("type") == "run_end" for r in stream):
             att = stream[0].get("attempt", 0) if stream else 0
@@ -393,6 +444,25 @@ def find_anomalies(events: list[dict], *, slow_factor: float = 3.0,
                 "kind": "no_run_end", "attempt": att, "last_step": last,
                 "detail": f"attempt {att} never wrote run_end (died or "
                           f"still running); last seen step: {last}"})
+            continue
+        for end in (r for r in stream if r.get("type") == "run_end"):
+            g = end.get("goodput", {})
+            wall = float(g.get("wall_s", end.get("wall_s", 0.0)))
+            total = sum(float(v) for v in g.get("buckets", {}).values())
+            # The meter's ``other`` bucket absorbs the remainder, so the
+            # partition is exact up to per-bucket rounding (3 decimals,
+            # ≤ 0.5 ms each) — anything past that slack is a real
+            # double-charge or lost slice, never noise.
+            tol = max(0.05, 0.001 * len(g.get("buckets", {})) + 0.01)
+            if g.get("buckets") and abs(total - wall) > tol:
+                att = end.get("attempt", 0)
+                findings.append({
+                    "kind": "goodput_invariant", "attempt": att,
+                    "wall_s": round(wall, 3), "bucket_sum_s": round(total, 3),
+                    "detail": f"attempt {att} goodput buckets sum to "
+                              f"{total:.3f}s but wall is {wall:.3f}s — "
+                              f"bucket accounting violated (delta "
+                              f"{total - wall:+.3f}s)"})
     return findings
 
 

@@ -19,6 +19,18 @@ Seams are named injection points the framework calls into:
   ckpt_shard    checkpoint shard serialization (``mangle`` on the bytes
                 actually written — kinds ``corrupt``/``torn``)
   host          the training loop, once per step (crash/signal kinds)
+  slow_gcs      ``data/gcs.py`` write_bytes, *before* the retry wrapper's
+                attempt (default kind ``slow``: models degraded storage
+                without consuming retry budget — the async-vs-sync
+                goodput comparison seam)
+  crash_during_upload
+                the async checkpoint worker, after shard files are
+                written but before the sidecar/COMMIT (default kind
+                ``crash`` — proves no acknowledged-but-unwritten ckpt)
+  sigterm_pending_upload
+                right after an async save is enqueued, while its upload
+                is in flight (default kind ``sigterm`` — drives the
+                flush-before-rc-14 path)
   ============  ======================================================
 
 Kinds: ``ioerror`` (raise a retryable :class:`InjectedFault`), ``slow``
@@ -50,7 +62,13 @@ from dataclasses import dataclass
 _KINDS = ("ioerror", "slow", "corrupt", "torn", "crash", "sigterm",
           "sigint", "hang")
 _SEAMS = ("gcs_read", "gcs_write", "gcs_list", "gcs_stat", "gcs_delete",
-          "ckpt_shard", "host")
+          "ckpt_shard", "host", "slow_gcs", "crash_during_upload",
+          "sigterm_pending_upload")
+# The checkpoint-pipeline seams read more naturally with their purpose as
+# the default kind — ``slow_gcs`` without ``:kind=`` means slow, not a
+# spelled-the-seam-name-but-raises-ioerror surprise.
+_SEAM_DEFAULT_KIND = {"slow_gcs": "slow", "crash_during_upload": "crash",
+                      "sigterm_pending_upload": "sigterm"}
 _CRASH_RC = 42
 
 
@@ -83,7 +101,7 @@ def parse(spec: str) -> list[Fault]:
         if seam not in _SEAMS:
             raise ValueError(f"unknown fault seam {seam!r} in {entry!r}; "
                              f"have {_SEAMS}")
-        f = Fault(seam=seam)
+        f = Fault(seam=seam, kind=_SEAM_DEFAULT_KIND.get(seam, "ioerror"))
         for opt in opts:
             key, sep, val = opt.partition("=")
             if not sep:

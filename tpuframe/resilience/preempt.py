@@ -13,8 +13,11 @@ of pod time per preemption.  The contract implemented here:
      rc 14 as "resume me" — it relaunches immediately without consuming
      the crash budget or backing off.
 
-A second SIGINT restores default handling, so an interactive ^C ^C
-still kills a wedged run the usual way.
+A second signal escalates past the flag: ^C ^C raises
+KeyboardInterrupt inline (an interactive user means it), and a second
+SIGTERM re-delivers the signal with the guard uninstalled — a
+supervisor's kill-after-grace must actually kill a wedged run, not be
+shielded into another ignored flag flip.
 
 No jax import; the guard must be installable before any backend.
 """
@@ -49,10 +52,17 @@ class PreemptionGuard:
         return self._requested
 
     def _handle(self, signum, frame) -> None:
-        if self._requested and signum == signal.SIGINT:
-            # Second ^C: the user means it — stop shielding.
+        if self._requested and signum in (signal.SIGINT, signal.SIGTERM):
+            # Second signal: the sender means it — stop shielding.  ^C ^C
+            # raises inline; a repeated SIGTERM (the supervisor's
+            # kill-after-grace) is re-delivered with the pre-guard
+            # handler restored, so the default action terminates the
+            # process instead of flipping the flag it already flipped.
             self.uninstall()
-            raise KeyboardInterrupt
+            if signum == signal.SIGINT:
+                raise KeyboardInterrupt
+            signal.raise_signal(signum)
+            return
         self._requested = True
         self.signal_name = signal.Signals(signum).name
         try:

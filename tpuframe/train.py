@@ -334,9 +334,15 @@ def build_harness(cfg: TrainConfig) -> Harness:
         raise ValueError("track_best=True needs ckpt_dir (the best/ "
                          "checkpoint lives under it)")
     if cfg.ckpt_dir is not None:
+        # TPUFRAME_ASYNC_CKPT overrides the config knob when set — the
+        # ops-side switch for flipping a fleet to async saves (or back)
+        # without touching run configs.
+        async_env = os.environ.get("TPUFRAME_ASYNC_CKPT", "")
+        ckpt_async = (async_env not in ("0", "false", "")
+                      if async_env else cfg.ckpt_async)
         manager = ckpt_lib.CheckpointManager(
             cfg.ckpt_dir, every_steps=cfg.ckpt_every, keep=cfg.ckpt_keep,
-            async_write=cfg.ckpt_async)
+            async_write=ckpt_async)
         if cfg.resume:
             resumed = manager.restore_latest(mesh=mesh, target=state)
             if resumed is not None:
@@ -873,14 +879,22 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
         if timeline is not None:
             with timeline.phase("data_wait", step=step):
                 batch = next(data_iter)
+            t_compute0 = time.perf_counter()
             with timeline.phase("train_step", step=step):
                 state, metrics = h.train_step(state, batch)
         else:
             batch = next(data_iter)
+            t_compute0 = time.perf_counter()
             state, metrics = h.train_step(state, batch)
         step += 1
-        step_s = time.perf_counter() - t_step0
+        t_end = time.perf_counter()
+        # Input wait is its own goodput bucket (arXiv:1909.09756's input
+        # stall), NOT part of step time: a loader that can't keep up must
+        # show as `input`, never masquerade as slow compute.
+        input_wait_s = t_compute0 - t_step0
+        step_s = t_end - t_compute0
         first_step = meter.first_step_s is None
+        meter.charge("input", input_wait_s)
         meter.step(step_s)
         run_info["step"] = step
         is_log_step = step % cfg.log_every == 0 or step == cfg.total_steps
@@ -897,6 +911,7 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
                     extra["loss"] = float(fetched["loss"])
             events_lib.emit("step", step=step,
                             wall_ms=round(step_s * 1e3, 3),
+                            input_wait_ms=round(input_wait_s * 1e3, 3),
                             examples=examples_per_step, **extra)
             if first_step:
                 events_lib.emit("compile", step=step,
@@ -970,7 +985,17 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
                 t_ckpt0 = time.perf_counter()
                 if not h.manager.should_save(step):  # else just saved above
                     h.manager.save(step, state)
-                h.manager.wait_pending()
+                # Deadline-bounded drain, not an open-ended join: the
+                # SIGTERM grace window is finite, and flush() guarantees
+                # every pending save is committed or quarantined before
+                # rc 14 tells the supervisor "resume me" — never
+                # acknowledged-but-unwritten.
+                flushed = h.manager.flush(deadline_s=float(os.environ.get(
+                    "TPUFRAME_FLUSH_DEADLINE_S", "60")))
+                if not flushed and bootstrap.is_primary():
+                    print("[tpuframe] flush deadline expired — in-flight "
+                          "save quarantined; resume uses the previous "
+                          "committed step", flush=True)
                 meter.charge("ckpt", time.perf_counter() - t_ckpt0)
             heartbeat.stop()
             if timeline is not None:
