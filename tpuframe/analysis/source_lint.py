@@ -126,6 +126,10 @@ RULES = {
     "TF111": "threading.Thread created outside the sanctioned background-"
              "work modules (ckpt/, data/pipeline.py, obs/heartbeat.py, "
              "launch/)",
+    "TF112": "events.emit() with an event type not registered in "
+             "obs/events.py's REQUIRED_FIELDS schema contract",
+    "TF113": "http.server used outside the sanctioned telemetry endpoint "
+             "(obs/exporter.py)",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -178,6 +182,20 @@ _WU_OPTIMIZER_RECEIVERS = {"tx", "optimizer", "opt", "inner_tx"}
 _THREAD_SANCTIONED_PARTS = ("ckpt/", "data/pipeline.py",
                             "obs/heartbeat.py", "launch/")
 
+# TF112: receivers whose ``.emit("type", ...)`` is the structured event
+# log — the in-tree import aliases for ``tpuframe.obs.events``.  A string
+# literal first argument must name a type registered in REQUIRED_FIELDS,
+# or the record fails schema validation at read time (the selfcheck
+# gate); this catches it at lint time instead.  Computed first arguments
+# are skipped (the registry can't resolve them statically).
+_EMIT_RECEIVERS = {"events", "events_lib", "obs_events"}
+
+# TF113: the one module allowed to stand up an HTTP endpoint.  Ad-hoc
+# http.server use anywhere else forks the telemetry plane: unauthenticated
+# sockets with no OpenMetrics contract, invisible to the exporter's
+# health/port knobs.
+_HTTP_EXEMPT_SUFFIX = "obs/exporter.py"
+
 # TF105a: google.cloud.storage blob/bucket methods — allowed only inside
 # the retry-wrapped data/gcs.py layer.
 _RAW_GCS_METHODS = {
@@ -202,6 +220,42 @@ _SYNC_MARKERS = {"block_until_ready", "device_get", "item", "tolist",
                  "asarray", "array", "float"}
 
 _SUPPRESS_RE = re.compile(r"#\s*tf-lint:\s*ok(?:\[([A-Z0-9, ]+)\])?")
+
+
+_EVENT_REGISTRY_CACHE: frozenset | None = None
+
+
+def _event_type_registry() -> frozenset:
+    """Event types registered in ``obs/events.py``'s REQUIRED_FIELDS,
+    extracted by AST parse — NOT by import: importing ``tpuframe.obs``
+    pulls jax, and ``--lint-only`` must stay importable-anywhere.  An
+    unreadable/refactored events.py yields an empty set, which makes
+    TF112 inert rather than noisy."""
+    global _EVENT_REGISTRY_CACHE
+    if _EVENT_REGISTRY_CACHE is not None:
+        return _EVENT_REGISTRY_CACHE
+    types: frozenset = frozenset()
+    try:
+        src = (Path(__file__).resolve().parent.parent / "obs"
+               / "events.py").read_text()
+        tree = ast.parse(src)
+    except (OSError, SyntaxError):
+        tree = None
+    if tree is not None:
+        for node in ast.walk(tree):
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign):
+                target = node.target
+            if (isinstance(target, ast.Name)
+                    and target.id == "REQUIRED_FIELDS"
+                    and isinstance(node.value, ast.Dict)):
+                types = frozenset(k.value for k in node.value.keys
+                                  if isinstance(k, ast.Constant))
+                break
+    _EVENT_REGISTRY_CACHE = types
+    return types
 
 
 @dataclass
@@ -320,6 +374,7 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
                 and not norm_path.endswith(_WU_EXEMPT_SUFFIXES))
     thread_scope = not any(p in norm_path
                            for p in _THREAD_SANCTIONED_PARTS)
+    http_scope = not norm_path.endswith(_HTTP_EXEMPT_SUFFIX)
 
     # TF106: a module-level compiler-env write is safe only BEFORE the
     # module-level jax import (the conftest/bootstrap pattern).
@@ -401,6 +456,23 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
 
     def _check_node(node, fn: _FnInfo | None):
         traced = fn is not None and fn.traced
+        if http_scope and isinstance(node, (ast.Import, ast.ImportFrom)):
+            modules = ([a.name for a in node.names]
+                       if isinstance(node, ast.Import)
+                       else [node.module or ""])
+            if any(m == "http.server" or m.startswith("http.server.")
+                   for m in modules):
+                emit("TF113", node,
+                     "http.server imported outside obs/exporter.py — the "
+                     "exporter is the one sanctioned HTTP endpoint "
+                     "(OpenMetrics contract, health probe, port knobs); "
+                     "register gauges/collectors on it instead of "
+                     "standing up another server", fn)
+        if (http_scope and isinstance(node, ast.Attribute)
+                and _dotted(node) == "http.server"):
+            emit("TF113", node,
+                 "http.server used outside obs/exporter.py — route the "
+                 "endpoint through the telemetry exporter", fn)
         if isinstance(node, (ast.Assign, ast.AugAssign)):
             targets = (node.targets if isinstance(node, ast.Assign)
                        else [node.target])
@@ -491,6 +563,21 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
                      f"ckpt/checkpoint.py documents); if the thread "
                      f"provably never touches jax, suppress with "
                      f"tf-lint: ok[TF111] and a reason", fn)
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "emit"
+                    and _dotted(node.func.value).rsplit(".", 1)[-1]
+                    in _EMIT_RECEIVERS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                registry = _event_type_registry()
+                if registry and node.args[0].value not in registry:
+                    emit("TF112", node,
+                         f"events.emit({node.args[0].value!r}) — type not "
+                         f"registered in obs/events.py REQUIRED_FIELDS; "
+                         f"unregistered types fail schema validation at "
+                         f"read time (the selfcheck CI gate), so register "
+                         f"the type (with its required fields) first", fn)
             if remat_scope and callee in _BARE_REMAT_CALLEES:
                 emit("TF108", node,
                      f"{callee}() bare rematerialization in model/step "

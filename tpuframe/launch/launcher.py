@@ -33,6 +33,7 @@ import time
 from dataclasses import dataclass, field
 
 from tpuframe.launch.provision import SliceConfig
+from tpuframe.obs import exporter as exporter_lib
 from tpuframe.resilience.preempt import RC_PREEMPTED
 from tpuframe.utils import compile_cache
 
@@ -183,10 +184,30 @@ def run_with_relaunch(run_once, relaunches: int, *, log=print,
     # so one events.<host>.jsonl reconstructs the full supervised lifecycle.
     # Env contract, not an import: run_once children inherit os.environ.
     attempt_serial = int(os.environ.get("TPUFRAME_ATTEMPT", "0") or "0")
+    # Supervisor's own telemetry (obs/exporter.py): bound one port above
+    # the child's (``port_offset=1``) so both can serve on one host.
+    # Relaunch accounting is exactly what a pager wants from a supervisor:
+    # attempts spent, last exit code, crash-loop stall count.
+    exporter = exporter_lib.start_from_env(port_offset=1)
+
+    def _export(rc=None):
+        if exporter is None:
+            return
+        exporter.set_gauge("tpuframe_supervisor_attempts", attempt)
+        exporter.set_gauge("tpuframe_supervisor_attempt_serial",
+                           attempt_serial)
+        exporter.set_gauge("tpuframe_supervisor_stalled_relaunches",
+                           stalled)
+        if rc is not None:
+            exporter.set_gauge("tpuframe_supervisor_last_rc", rc)
+        exporter.flush()
+
     while True:
         os.environ["TPUFRAME_ATTEMPT"] = str(attempt_serial)
         attempt_serial += 1
+        _export()
         rc = run_once()
+        _export(rc)
         if rc == 0:
             return rc
         if rc == RC_PREEMPTED:

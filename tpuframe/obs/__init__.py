@@ -2,13 +2,18 @@
 heartbeat/stall detection, structured run-event tracing, goodput/MFU
 accounting and HBM telemetry — the TPU-native stand-ins for Horovod
 Timeline and HOROVOD_STALL_CHECK, plus the ``python -m tpuframe.obs``
-offline analyzer over ``events.<host>.jsonl`` logs."""
+offline analyzer over ``events.<host>.jsonl`` logs.  The live half is
+``exporter`` (OpenMetrics ``/metrics`` + ``/healthz``) and ``flight``
+(the crash flight recorder)."""
 
-from tpuframe.obs import devmem, events, goodput  # noqa: F401
+from tpuframe.obs import devmem, events, exporter, flight  # noqa: F401
+from tpuframe.obs import goodput  # noqa: F401
 from tpuframe.obs.devmem import DevmemSampler  # noqa: F401
 from tpuframe.obs.events import EventLog  # noqa: F401
+from tpuframe.obs.exporter import MetricsExporter  # noqa: F401
+from tpuframe.obs.flight import FlightRecorder  # noqa: F401
 from tpuframe.obs.goodput import GoodputMeter  # noqa: F401
 from tpuframe.obs.metrics import MetricLogger, RateMeter  # noqa: F401
 from tpuframe.obs.heartbeat import Heartbeat  # noqa: F401
-from tpuframe.obs.timeline import (StepTimeline, profile_trace,  # noqa: F401
-                                   start_profiler_server)
+from tpuframe.obs.timeline import (StepTimeline, parse_trace_steps,  # noqa: F401
+                                   profile_trace, start_profiler_server)

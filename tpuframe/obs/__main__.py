@@ -15,12 +15,18 @@ single file):
              save beyond ``--blocked-ms``, and attempts whose goodput
              buckets fail the sums-to-wall invariant.
              Exits 1 when anything is flagged (scriptable).
+  compare    the regression sentry: diff run B against baseline A on
+             step-time p50/p90, productive goodput fraction, MFU and
+             serve TTFT/TPOT p90 against thresholds; exits 1 when B
+             regressed.  With the on-chip relay down, this is how two
+             runs' profiles are proven same-or-better offline.
 
 Examples::
 
     python -m tpuframe.obs summarize /runs/r7/events
     python -m tpuframe.obs anomalies /runs/r7/events --mfu-min 0.3
     python -m tpuframe.obs merge /runs/r7/events -o merged.jsonl
+    python -m tpuframe.obs compare /runs/baseline /runs/candidate
 """
 
 from __future__ import annotations
@@ -79,6 +85,35 @@ def _sample_paths() -> list[str]:
     return paths
 
 
+def _samples_root() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "docs", "samples")
+
+
+def _selfcheck_compare() -> list[str]:
+    """The regression sentry's own golden test: the shipped fast/slow
+    pair must flag as a regression, and the identical pair must not —
+    a threshold or percentile change that breaks either direction fails
+    CI here before it ships."""
+    fast = os.path.join(_samples_root(), "compare_fast")
+    slow = os.path.join(_samples_root(), "compare_slow")
+    if not (events_lib.event_files(fast) and events_lib.event_files(slow)):
+        return [f"compare golden pair missing under {_samples_root()} "
+                f"(compare_fast/ + compare_slow/)"]
+    problems: list[str] = []
+    a, b = events_lib.merge(fast), events_lib.merge(slow)
+    flagged = goodput_lib.compare_runs(a, b)
+    if not flagged["regressions"]:
+        problems.append("compare(fast, slow) flagged no regression — the "
+                        "sentry is blind")
+    clean = goodput_lib.compare_runs(a, a)
+    for r in clean["regressions"]:
+        problems.append(f"compare(fast, fast) flagged {r['metric']} — "
+                        f"the sentry false-positives on identity")
+    return problems
+
+
 def cmd_selfcheck(directory: str | None) -> int:
     paths = (events_lib.event_files(directory) if directory
              else _sample_paths())
@@ -86,6 +121,10 @@ def cmd_selfcheck(directory: str | None) -> int:
         print("[obs] selfcheck: no event files found", file=sys.stderr)
         return 1
     problems = events_lib.validate_files(paths)
+    if directory is None:
+        # Default (shipped-samples) mode also proves the compare sentry
+        # against its golden pair.
+        problems += _selfcheck_compare()
     for p in problems:
         print(f"OBS {p}")
     print(f"[obs] selfcheck: {len(paths)} file(s), "
@@ -191,6 +230,38 @@ def cmd_anomalies(directory: str, args) -> int:
     return 1 if findings else 0
 
 
+def cmd_compare(args) -> int:
+    a = _load(args.a)
+    b = _load(args.b)
+    thresholds = {
+        "step_pct": args.step_pct,
+        "productive_drop": args.prod_drop,
+        "mfu_drop": args.mfu_drop,
+        "serve_pct": args.serve_pct,
+    }
+    result = goodput_lib.compare_runs(a, b, thresholds=thresholds,
+                                      generation=args.gen)
+    if not result["metrics"]:
+        print("[obs] compare: no overlapping metrics between the two runs",
+              file=sys.stderr)
+        return 2
+    print(f"compare: baseline={args.a} candidate={args.b}")
+    for name, m in sorted(result["metrics"].items()):
+        delta = m.get("delta_pct")
+        delta_s = (f"{delta:+.1f}%" if delta is not None
+                   else f"{m.get('delta', m.get('delta_rel', 0.0)):+.4f}")
+        print(f"  {name:<20} A={m['a']:<12.4g} B={m['b']:<12.4g} {delta_s}")
+    for r in result["regressions"]:
+        print(f"COMPARE-REGRESSION [{r['metric']}] {r['detail']}")
+    for r in result["improvements"]:
+        print(f"compare-improvement [{r['metric']}] "
+              f"{r['a']} -> {r['b']}")
+    print(f"[obs] compare: {len(result['regressions'])} regression(s), "
+          f"{len(result['improvements'])} improvement(s), "
+          f"{len(result['metrics'])} metric(s) compared")
+    return 1 if result["regressions"] else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="python -m tpuframe.obs",
                                 description=__doc__)
@@ -224,6 +295,29 @@ def main(argv: list[str] | None = None) -> int:
                     help="flag steps blocked on input or checkpoint "
                          "saves beyond this many ms (default 1000)")
 
+    cp = sub.add_parser("compare",
+                        help="regression sentry: diff run B vs baseline A")
+    cp.add_argument("a", help="baseline run's events directory")
+    cp.add_argument("b", help="candidate run's events directory")
+    cp.add_argument("--step-pct", type=float,
+                    default=goodput_lib.DEFAULT_COMPARE_THRESHOLDS[
+                        "step_pct"],
+                    help="step-time p50/p90 increase (%%) that regresses")
+    cp.add_argument("--prod-drop", type=float,
+                    default=goodput_lib.DEFAULT_COMPARE_THRESHOLDS[
+                        "productive_drop"],
+                    help="absolute productive-fraction drop that regresses")
+    cp.add_argument("--mfu-drop", type=float,
+                    default=goodput_lib.DEFAULT_COMPARE_THRESHOLDS[
+                        "mfu_drop"],
+                    help="relative MFU drop (fraction) that regresses")
+    cp.add_argument("--serve-pct", type=float,
+                    default=goodput_lib.DEFAULT_COMPARE_THRESHOLDS[
+                        "serve_pct"],
+                    help="serve TTFT/TPOT p90 increase (%%) that regresses")
+    cp.add_argument("--gen", default=None,
+                    help="TPU generation for MFU recompute")
+
     args = p.parse_args(argv)
     if args.cmd == "summarize":
         if args.selfcheck:
@@ -233,6 +327,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_summarize(args.dir, args.gen)
     if args.cmd == "merge":
         return cmd_merge(args.dir, args.out)
+    if args.cmd == "compare":
+        return cmd_compare(args)
     return cmd_anomalies(args.dir, args)
 
 

@@ -45,6 +45,8 @@ Event types (see ``REQUIRED_FIELDS`` for the per-type contract):
                  (mode replicated|zero1, resolution source, shard count)
   run_end        final step, wall s, goodput buckets, MFU, counters,
                  peak HBM per device
+  trace_start    a jax.profiler trace window opened (step, artifact path)
+  trace_end      the trace window closed (step, artifact path)
   serve_step     one continuous-batching scheduler step (active slots,
                  admissions, tokens produced, queue depth)
   serve_request  a served request retired (prompt/output token counts,
@@ -98,6 +100,8 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "remat_policy": ("policy", "source"),
     "weight_update": ("mode", "source"),
     "run_end": ("final_step", "wall_s", "goodput"),
+    "trace_start": ("step", "path"),
+    "trace_end": ("step", "path"),
     "serve_step": ("step", "wall_ms", "active"),
     "serve_request": ("id", "prompt_tokens", "output_tokens", "ttft_ms"),
     "serve_summary": ("requests", "tokens_per_s"),
@@ -106,6 +110,34 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
 _ENVELOPE = ("schema", "type", "t", "host", "proc", "attempt")
 
 _FILE_RE = re.compile(r"^events\.(?P<host>.+)\.jsonl$")
+
+# In-process tee: every record built by any EventLog is also handed to the
+# registered listeners (the flight recorder's hook).  Listeners see the
+# record BEFORE the file write and regardless of its outcome — a crash
+# that tears the JSONL mid-line must not also lose the in-memory copy.
+_listeners: list = []
+
+
+def add_listener(fn) -> None:
+    """Register ``fn(record: dict)`` to observe every emitted record.
+    Listener exceptions are swallowed (emission never raises)."""
+    if fn not in _listeners:
+        _listeners.append(fn)
+
+
+def remove_listener(fn) -> None:
+    try:
+        _listeners.remove(fn)
+    except ValueError:
+        pass
+
+
+def _notify(record: dict) -> None:
+    for fn in list(_listeners):
+        try:
+            fn(record)
+        except Exception:  # noqa: BLE001 — a broken listener must not
+            pass  # take down the seam that emitted
 
 
 def _hostname() -> str:
@@ -177,6 +209,7 @@ class EventLog:
             line = json.dumps(record, default=str)
         except (TypeError, ValueError):
             return None
+        _notify(record)
         with self._lock:
             if self._closed:
                 return None

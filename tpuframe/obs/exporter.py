@@ -1,0 +1,277 @@
+"""Live telemetry plane — an OpenMetrics endpoint over the obs counters.
+
+Everything else in ``tpuframe.obs`` is post-hoc: goodput, anomalies and
+serve percentiles exist only after ``python -m tpuframe.obs`` runs over
+the JSONL logs.  This module is the *live* half (the operational surface
+Horovod shipped as its timeline/monitoring hooks, arXiv:1802.05799): a
+stdlib ``http.server`` endpoint any Prometheus-style scraper can poll
+while the run is still going.
+
+Endpoints:
+
+  ``/metrics``  OpenMetrics text exposition — ``obs.metrics`` counters
+                (one ``tpuframe_events_total`` family, counter name as a
+                label), plus whatever gauges/collectors the harness
+                registered: live goodput bucket seconds, step index and
+                step-time, devmem HBM peaks, serve TTFT/TPOT percentiles.
+  ``/healthz``  200 while the registered health probe says healthy, 503
+                otherwise — train.py wires the heartbeat watchdog here,
+                so a stalled run flips unhealthy *before* the stall-abort
+                kills it.
+
+Enable via ``TPUFRAME_METRICS_PORT=<port>`` (0 = ephemeral; the bound
+port lands on ``MetricsExporter.port`` for tests).  Scrape-less hosts
+set ``TPUFRAME_METRICS_TEXTFILE=<path>`` instead (or additionally): every
+``flush()`` atomically rewrites the same exposition text for a
+node-exporter-style textfile collector to pick up.
+
+Pure stdlib, no jax import: the launcher's supervisor uses this before
+any backend exists, and the server thread only reads in-process state
+(never a device or a collective — the TF111 hazard does not apply).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+ENV_PORT = "TPUFRAME_METRICS_PORT"
+ENV_TEXTFILE = "TPUFRAME_METRICS_TEXTFILE"
+
+CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
+def _escape_label(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt_sample(name: str, labels: dict, value) -> str:
+    if labels:
+        body = ",".join(f'{k}="{_escape_label(v)}"'
+                        for k, v in sorted(labels.items()))
+        return f"{name}{{{body}}} {float(value):g}"
+    return f"{name} {float(value):g}"
+
+
+class MetricsExporter:
+    """Push-gauges + pull-collectors rendered as one OpenMetrics page.
+
+    ``set_gauge(name, value, **labels)`` stores a sample (the push API
+    for per-step facts); ``add_collector(fn)`` registers ``fn() ->
+    iterable of (name, labels_dict, value)`` polled at render time (the
+    pull API for live state like the goodput meter).  Families whose
+    name ends in ``_total`` render as counters (OpenMetrics requires the
+    suffix), everything else as gauges.
+    """
+
+    def __init__(self, *, port: int | None = None,
+                 textfile: str | None = None, health=None):
+        self._port_requested = port
+        self.port: int | None = None
+        self.textfile = textfile
+        self._health = health
+        self._lock = threading.Lock()
+        self._gauges: dict[tuple, float] = {}
+        self._collectors: list = []
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- registration ----------------------------------------------------
+
+    def set_gauge(self, name: str, value, **labels) -> None:
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            self._gauges[(name, tuple(sorted(labels.items())))] = v
+
+    def add_collector(self, fn) -> None:
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def set_health(self, fn) -> None:
+        self._health = fn
+
+    def healthy(self) -> bool:
+        fn = self._health
+        if fn is None:
+            return True
+        try:
+            return bool(fn())
+        except Exception:  # noqa: BLE001 — a broken probe reads unhealthy
+            return False
+
+    # -- rendering -------------------------------------------------------
+
+    def _samples(self) -> list[tuple[str, dict, float]]:
+        out: list[tuple[str, dict, float]] = []
+        try:
+            from tpuframe.obs import metrics
+
+            for name, v in sorted(metrics.counters().items()):
+                out.append(("tpuframe_events_total", {"name": name},
+                            float(v)))
+        except Exception:  # noqa: BLE001 — counters are best-effort
+            pass
+        with self._lock:
+            gauges = list(self._gauges.items())
+            collectors = list(self._collectors)
+        for (name, labels), v in gauges:
+            out.append((name, dict(labels), v))
+        for fn in collectors:
+            try:
+                for name, labels, v in fn():
+                    out.append((str(name), dict(labels or {}), float(v)))
+            except Exception:  # noqa: BLE001 — one broken collector must
+                continue  # not blank the whole exposition
+        return out
+
+    def render(self) -> str:
+        by_family: dict[str, list[str]] = {}
+        for name, labels, v in self._samples():
+            by_family.setdefault(name, []).append(
+                _fmt_sample(name, labels, v))
+        lines: list[str] = []
+        for name in sorted(by_family):
+            if name.endswith("_total"):
+                lines.append(f"# TYPE {name[:-len('_total')]} counter")
+            else:
+                lines.append(f"# TYPE {name} gauge")
+            lines.extend(sorted(by_family[name]))
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+    # -- serving ---------------------------------------------------------
+
+    def start(self) -> "MetricsExporter":
+        if self._port_requested is None or self._server is not None:
+            return self
+        exporter = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                if self.path.split("?")[0] == "/metrics":
+                    body = exporter.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                elif self.path.split("?")[0] == "/healthz":
+                    ok = exporter.healthy()
+                    body = (b"ok\n" if ok else b"unhealthy\n")
+                    self.send_response(200 if ok else 503)
+                    self.send_header("Content-Type",
+                                     "text/plain; charset=utf-8")
+                else:
+                    body = b"not found\n"
+                    self.send_response(404)
+                    self.send_header("Content-Type",
+                                     "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes must not spam stdout
+                pass
+
+        try:
+            self._server = ThreadingHTTPServer(
+                ("0.0.0.0", int(self._port_requested)), _Handler)
+        except OSError as e:
+            import sys
+
+            print(f"[tpuframe.obs] metrics exporter: cannot bind port "
+                  f"{self._port_requested} ({e}) — scrape endpoint off, "
+                  f"textfile output unaffected", file=sys.stderr)
+            self._server = None
+            return self
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        # Serves in-process snapshots only (counters/gauges under a plain
+        # lock) — never touches jax or a collective, so the TF111
+        # collective-ordering hazard does not apply.
+        self._thread = threading.Thread(  # tf-lint: ok[TF111]
+            target=self._server.serve_forever, daemon=True,
+            name="tpuframe-metrics")
+        self._thread.start()
+        return self
+
+    def flush(self) -> None:
+        """Rewrite the textfile exposition (atomic), when configured."""
+        if not self.textfile:
+            return
+        try:
+            d = os.path.dirname(self.textfile)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = f"{self.textfile}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(self.render())
+            os.replace(tmp, self.textfile)
+        except OSError:
+            pass  # scrape-less fallback is itself best-effort
+
+    def stop(self) -> None:
+        self.flush()
+        server, self._server = self._server, None
+        if server is not None:
+            try:
+                server.shutdown()
+                server.server_close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# Module-level singleton — one exporter per process, env-gated.
+# ---------------------------------------------------------------------------
+
+_exporter: MetricsExporter | None = None
+_exporter_lock = threading.Lock()
+
+
+def start_from_env(*, health=None, port_offset: int = 0
+                   ) -> MetricsExporter | None:
+    """Start (or return) the process-wide exporter.  Off unless
+    ``TPUFRAME_METRICS_PORT`` or ``TPUFRAME_METRICS_TEXTFILE`` is set.
+    ``port_offset`` shifts the bound port (the launcher's supervisor uses
+    +1 so it never collides with a child's bind on the same host)."""
+    global _exporter
+    with _exporter_lock:
+        if _exporter is not None:
+            if health is not None and _exporter._health is None:
+                _exporter.set_health(health)
+            return _exporter
+        port_s = os.environ.get(ENV_PORT, "").strip()
+        textfile = os.environ.get(ENV_TEXTFILE, "").strip() or None
+        if not port_s and not textfile:
+            return None
+        port: int | None = None
+        if port_s:
+            try:
+                port = int(port_s)
+            except ValueError:
+                port = None
+            else:
+                if port and port_offset:
+                    port += port_offset
+        _exporter = MetricsExporter(port=port, textfile=textfile,
+                                    health=health).start()
+        return _exporter
+
+
+def get() -> MetricsExporter | None:
+    return _exporter
+
+
+def stop() -> None:
+    global _exporter
+    with _exporter_lock:
+        if _exporter is not None:
+            _exporter.stop()
+            _exporter = None

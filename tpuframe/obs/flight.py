@@ -1,0 +1,149 @@
+"""Crash flight recorder — the last-N events, durable through any death.
+
+The JSONL event log is append-per-record, but a hard crash can still
+tear its final line mid-write, and the interesting records — the ones
+just before the death — are exactly the ones at risk.  This module keeps
+a bounded in-memory ring of every record the event layer builds (via
+``events.add_listener``, which fires BEFORE the file write) and dumps it
+as one small JSON file when something goes wrong:
+
+  * the train loop's exception path (``train.py`` wraps the run),
+  * SIGTERM/SIGINT preemption (``resilience/preempt.py``'s handler),
+  * an injected ``kind=crash`` fault (``resilience/faults.py`` dumps
+    right before its ``os._exit(42)`` — no exception handler can run),
+  * the stall-abort anomaly path (``train.py:_on_stall``).
+
+Dump layout (``flight_<attempt>.json``, ``.procN``-suffixed off the
+primary process so multi-host dumps never clobber)::
+
+    {"reason": "crash_injected", "t": ..., "host": ..., "proc": ...,
+     "attempt": ..., "counters": {...obs.metrics snapshot...},
+     "events": [...last N records, oldest first...]}
+
+Ring capacity comes from ``TPUFRAME_FLIGHT_EVENTS`` (default 256).
+Everything here is best-effort and stdlib-only: installed from signal
+handlers and crash paths, it must never raise and never import jax.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+from tpuframe.obs import events as events_lib
+
+ENV_EVENTS = "TPUFRAME_FLIGHT_EVENTS"
+DEFAULT_EVENTS = 256
+
+
+class FlightRecorder:
+    """Bounded ring of event records + the dump that survives a crash."""
+
+    def __init__(self, directory: str, *, maxlen: int = DEFAULT_EVENTS):
+        self.directory = directory
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(1, int(maxlen)))
+        self._lock = threading.Lock()
+        self.last_dump_path: str | None = None
+
+    # -- listener target (events.add_listener) --------------------------
+
+    def record(self, rec: dict) -> None:
+        with self._lock:
+            self._ring.append(rec)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    # -- the dump --------------------------------------------------------
+
+    def dump(self, reason: str) -> str | None:
+        """Write ``flight_<attempt>.json``; returns the path, or None on
+        any failure.  Never raises — callers are signal handlers and
+        crash paths mid-death."""
+        try:
+            proc = events_lib._process_index()
+            suffix = f".proc{proc}" if proc else ""
+            path = os.path.join(
+                self.directory,
+                f"flight_{events_lib.attempt_id()}{suffix}.json")
+            payload = {
+                "reason": reason,
+                "t": round(time.time(), 3),
+                "host": events_lib._hostname(),
+                "proc": proc,
+                "attempt": events_lib.attempt_id(),
+                "counters": _counters(),
+                "events": self.snapshot(),
+            }
+            os.makedirs(self.directory, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, default=str)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)  # atomic: a dump is whole or absent
+            self.last_dump_path = path
+            return path
+        except Exception:  # noqa: BLE001 — a failing dump must not turn
+            return None  # a recoverable death into an unrecoverable one
+
+
+def _counters() -> dict:
+    try:
+        from tpuframe.obs import metrics
+
+        return metrics.counters()
+    except Exception:  # noqa: BLE001 — interpreter teardown
+        return {}
+
+
+# ---------------------------------------------------------------------------
+# Module-level singleton — crash paths reach it via sys.modules.get(...)
+# (the preempt.py pattern) so no-jax/no-obs callers stay import-free.
+# ---------------------------------------------------------------------------
+
+_recorder: FlightRecorder | None = None
+
+
+def install(directory: str | None = None,
+            maxlen: int | None = None) -> FlightRecorder | None:
+    """Start recording.  ``directory=None`` uses ``TPUFRAME_EVENTS_DIR``
+    (the dump belongs next to the log it backs up); no directory at all
+    means flight recording stays off."""
+    global _recorder
+    directory = directory or os.environ.get(events_lib.ENV_DIR, "")
+    if not directory.strip():
+        return None
+    if maxlen is None:
+        try:
+            maxlen = int(os.environ.get(ENV_EVENTS, "") or DEFAULT_EVENTS)
+        except ValueError:
+            maxlen = DEFAULT_EVENTS
+    uninstall()
+    _recorder = FlightRecorder(directory, maxlen=maxlen)
+    events_lib.add_listener(_recorder.record)
+    return _recorder
+
+
+def get() -> FlightRecorder | None:
+    return _recorder
+
+
+def dump(reason: str) -> str | None:
+    """Dump the active recorder's ring; silent no-op when uninstalled."""
+    rec = _recorder
+    if rec is None:
+        return None
+    return rec.dump(reason)
+
+
+def uninstall() -> None:
+    global _recorder
+    if _recorder is not None:
+        events_lib.remove_listener(_recorder.record)
+        _recorder = None

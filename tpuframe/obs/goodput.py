@@ -526,3 +526,110 @@ def serve_stats(events: list) -> dict | None:
         if tokens_per_s is not None else None,
         "n_devices": n_devices,
     }
+
+
+# ---------------------------------------------------------------------------
+# Run comparison — the regression sentry (``python -m tpuframe.obs compare``).
+# ---------------------------------------------------------------------------
+
+# Thresholds are in the units of the metric they guard: percentage
+# increase for latencies (a run B more than ``step_pct``% slower at p50
+# or p90 regressed), absolute fraction for the productive share of wall,
+# relative fraction for MFU.  Policy defaults, overridable per-call and
+# per-CLI-flag — a latency-critical serving fleet will want tighter ones.
+DEFAULT_COMPARE_THRESHOLDS = {
+    "step_pct": 25.0,        # step-time p50/p90 increase (%)
+    "productive_drop": 0.10,  # absolute drop in productive wall fraction
+    "mfu_drop": 0.10,        # relative mfu_productive drop (fraction)
+    "serve_pct": 25.0,       # serve TTFT/TPOT p90 increase (%)
+}
+
+
+def _compare_metrics(events: list[dict], *,
+                     generation: str | None = None) -> dict:
+    """The comparable facts of one merged stream, in one flat dict."""
+    out: dict = {}
+    times = sorted(step_times_ms(events))
+    if times:
+        out["step_p50_ms"] = _pct(times, 0.5)
+        out["step_p90_ms"] = _pct(times, 0.9)
+    summary = from_events(events, generation=generation)
+    wall = summary.get("wall_s") or 0.0
+    if wall > 0:
+        out["productive_frac"] = \
+            summary["buckets"].get("productive", 0.0) / wall
+    if summary.get("mfu_productive") is not None:
+        out["mfu_productive"] = summary["mfu_productive"]
+    serve = serve_stats(events)
+    if serve is not None:
+        if serve.get("ttft_ms"):
+            out["serve_ttft_p90_ms"] = serve["ttft_ms"]["p90"]
+        if serve.get("tpot_ms"):
+            out["serve_tpot_p90_ms"] = serve["tpot_ms"]["p90"]
+    return out
+
+
+def compare_runs(a_events: list[dict], b_events: list[dict], *,
+                 thresholds: dict | None = None,
+                 generation: str | None = None) -> dict:
+    """Diff run B against baseline A on goodput, step time, MFU and serve
+    percentiles.  Returns ``{"metrics": {name: {"a", "b", ...}},
+    "regressions": [...], "improvements": [...]}`` — a metric only
+    participates when BOTH runs carry it (a training-only baseline never
+    "regresses" against a run that added serving traffic)."""
+    th = dict(DEFAULT_COMPARE_THRESHOLDS)
+    th.update(thresholds or {})
+    ma = _compare_metrics(a_events, generation=generation)
+    mb = _compare_metrics(b_events, generation=generation)
+
+    # (metric, kind, threshold): ``pct_increase`` flags B > A by more
+    # than threshold %; ``abs_drop``/``rel_drop`` flag B < A by more than
+    # an absolute / relative amount (higher-is-better metrics).
+    checks = (
+        ("step_p50_ms", "pct_increase", th["step_pct"]),
+        ("step_p90_ms", "pct_increase", th["step_pct"]),
+        ("productive_frac", "abs_drop", th["productive_drop"]),
+        ("mfu_productive", "rel_drop", th["mfu_drop"]),
+        ("serve_ttft_p90_ms", "pct_increase", th["serve_pct"]),
+        ("serve_tpot_p90_ms", "pct_increase", th["serve_pct"]),
+    )
+    out: dict = {"metrics": {}, "regressions": [], "improvements": []}
+    for name, kind, threshold in checks:
+        a, b = ma.get(name), mb.get(name)
+        if a is None or b is None:
+            continue
+        entry = {"metric": name, "a": round(float(a), 4),
+                 "b": round(float(b), 4), "threshold": threshold}
+        out["metrics"][name] = entry
+        if kind == "pct_increase":
+            if a <= 0:
+                continue
+            delta_pct = 100.0 * (b - a) / a
+            entry["delta_pct"] = round(delta_pct, 2)
+            if delta_pct > threshold:
+                entry["detail"] = (f"{name}: {a:.2f} -> {b:.2f} "
+                                   f"(+{delta_pct:.1f}% > {threshold:.0f}%)")
+                out["regressions"].append(entry)
+            elif delta_pct < -threshold:
+                out["improvements"].append(entry)
+        elif kind == "abs_drop":
+            entry["delta"] = round(float(b - a), 4)
+            if a - b > threshold:
+                entry["detail"] = (f"{name}: {a:.3f} -> {b:.3f} "
+                                   f"(dropped {a - b:.3f} > {threshold})")
+                out["regressions"].append(entry)
+            elif b - a > threshold:
+                out["improvements"].append(entry)
+        else:  # rel_drop
+            if a <= 0:
+                continue
+            rel = (a - b) / a
+            entry["delta_rel"] = round(rel, 4)
+            if rel > threshold:
+                entry["detail"] = (f"{name}: {a:.4f} -> {b:.4f} "
+                                   f"(-{100 * rel:.1f}% > "
+                                   f"{100 * threshold:.0f}%)")
+                out["regressions"].append(entry)
+            elif rel < -threshold:
+                out["improvements"].append(entry)
+    return out

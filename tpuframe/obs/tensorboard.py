@@ -9,8 +9,10 @@ sink works on a bare TPU-VM image.
 
 Files land as ``<log_dir>/events.out.tfevents.<ts>.<host>.<pid>`` — exactly
 the glob stock TensorBoard scans — on local disk or GCS (``gs://`` paths go
-through ``tpuframe.data.gcs``; the whole accumulated record stream is
-rewritten per flush, which is cheap for scalar-only files).
+through ``tpuframe.data.gcs``).  Local files append only the new records on
+each flush (O(new data), the buffer is drained); GCS objects are immutable,
+so only ``gs://`` paths rewrite the accumulated stream per flush — cheap
+for scalar-only files.
 
 Verified readable by tensorboard's own ``EventFileLoader`` in
 ``tests/test_observability.py``.
@@ -97,7 +99,8 @@ class SummaryWriter:
 
     ``add_scalars(step, {"loss": 0.3, "acc": 0.9}, prefix="train")`` writes
     tags ``train/loss``, ``train/acc``.  Buffers in memory; ``flush()``
-    persists (rewrite-whole-object semantics, GCS-safe).
+    persists — incremental append on local disk, whole-object rewrite only
+    on GCS (immutable objects).
     """
 
     def __init__(self, log_dir: str, *, flush_every: int = 20):

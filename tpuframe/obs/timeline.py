@@ -16,6 +16,28 @@ import time
 import jax
 
 
+ENV_TRACE_STEPS = "TPUFRAME_TRACE_STEPS"
+ENV_PROFILER_PORT = "TPUFRAME_PROFILER_PORT"
+
+
+def parse_trace_steps(spec: str | None) -> tuple[int, int] | None:
+    """Parse ``TPUFRAME_TRACE_STEPS="<start>:<count>"`` into
+    ``(start, count)``.  Returns None for unset, malformed, or degenerate
+    (count < 1, start < 0) specs — a bad knob must not kill the run."""
+    if not spec or not spec.strip():
+        return None
+    parts = spec.strip().split(":")
+    if len(parts) != 2:
+        return None
+    try:
+        start, count = int(parts[0]), int(parts[1])
+    except ValueError:
+        return None
+    if start < 0 or count < 1:
+        return None
+    return start, count
+
+
 def start_profiler_server(port: int = 9012) -> bool:
     """On-demand profiling endpoint (TensorBoard 'capture profile')."""
     try:

@@ -203,6 +203,18 @@ class FaultRegistry:
         if f.kind == "crash":
             print(f"[tpuframe] FAULT INJECTION: dying at step {self.step}",
                   flush=True)
+            try:
+                # ``os._exit`` bypasses every handler and atexit hook, so
+                # the flight recorder (obs/flight.py) must dump HERE —
+                # via sys.modules, keeping this module's no-jax/no-obs
+                # import guarantee.
+                import sys
+
+                flight = sys.modules.get("tpuframe.obs.flight")
+                if flight is not None:
+                    flight.dump("crash_injected")
+            except Exception:  # noqa: BLE001 — dying anyway
+                pass
             os._exit(_CRASH_RC)
         if f.kind in ("sigterm", "sigint"):
             sig = signal.SIGTERM if f.kind == "sigterm" else signal.SIGINT

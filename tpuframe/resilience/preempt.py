@@ -73,6 +73,12 @@ class PreemptionGuard:
             events = sys.modules.get("tpuframe.obs.events")
             if events is not None:
                 events.emit("preempt", signal=self.signal_name)
+            # Flight dump at the signal, not at the rc-14 exit: if the
+            # grace window expires mid-checkpoint the postmortem still
+            # has the ring as of the SIGTERM.
+            flight = sys.modules.get("tpuframe.obs.flight")
+            if flight is not None:
+                flight.dump(f"preempt_{self.signal_name}")
         except Exception:  # noqa: BLE001 — observability is optional here
             pass
         print(f"[tpuframe] received {self.signal_name} — will checkpoint "

@@ -33,6 +33,8 @@ import time
 from dataclasses import dataclass, field
 
 from tpuframe.obs import events as obs_events
+from tpuframe.obs import exporter as obs_exporter
+from tpuframe.obs.goodput import _pct
 
 
 @dataclass
@@ -81,6 +83,25 @@ class Scheduler:
         self.completed: list = []
         self.step_count = 0
         self.tokens_generated = 0
+        # Live telemetry (obs/exporter.py, env-gated no-op otherwise):
+        # queue/slot gauges pushed per step, TTFT/TPOT percentiles over
+        # retired requests served through a pull collector.
+        self._exporter = obs_exporter.start_from_env()
+        if self._exporter is not None:
+            self._exporter.add_collector(self._latency_samples)
+
+    def _latency_samples(self):
+        ttft = sorted(v for v in (r.ttft_ms() for r in self.completed)
+                      if v is not None)
+        tpot = sorted(v for v in (r.tpot_ms() for r in self.completed)
+                      if v is not None)
+        out = []
+        for name, vals in (("tpuframe_serve_ttft_ms", ttft),
+                           ("tpuframe_serve_tpot_ms", tpot)):
+            if vals:
+                for q, frac in (("p50", 0.5), ("p90", 0.9), ("p99", 0.99)):
+                    out.append((name, {"quantile": q}, _pct(vals, frac)))
+        return out
 
     def submit(self, request: Request) -> None:
         if len(request.prompt) > max(self.engine.prompt_buckets):
@@ -135,6 +156,14 @@ class Scheduler:
             active=sum(r is not None for r in self.active),
             admitted=admitted, produced=produced,
             queued=len(self.pending))
+        if self._exporter is not None:
+            self._exporter.set_gauge("tpuframe_serve_queue_depth",
+                                     len(self.pending))
+            self._exporter.set_gauge(
+                "tpuframe_serve_active_slots",
+                sum(r is not None for r in self.active))
+            self._exporter.set_gauge("tpuframe_serve_tokens_generated",
+                                     self.tokens_generated)
         return produced + admitted
 
     # -- internals ----------------------------------------------------------

@@ -36,9 +36,12 @@ from tpuframe import models
 from tpuframe.data import ShardedLoader, datasets
 from tpuframe.models import losses
 from tpuframe.obs import (Heartbeat, MetricLogger, RateMeter, StepTimeline,
-                          profile_trace)
+                          parse_trace_steps, profile_trace,
+                          start_profiler_server)
 from tpuframe.obs import devmem as devmem_lib
 from tpuframe.obs import events as events_lib
+from tpuframe.obs import exporter as exporter_lib
+from tpuframe.obs import flight as flight_lib
 from tpuframe.obs import goodput as goodput_lib
 from tpuframe.obs import metrics as obs_metrics
 from tpuframe.parallel import bootstrap
@@ -657,7 +660,22 @@ def _step_costs(train_step, state, batch):
 
 def train(cfg: TrainConfig, *, trace_dir: str | None = None,
           log_file: str | None = None) -> dict:
-    """Run the workload; returns final metrics (the driver/test surface)."""
+    """Run the workload; returns final metrics (the driver/test surface).
+
+    Thin shell around the real loop: any escaping exception first dumps
+    the flight recorder's ring (``obs/flight.py``) so the postmortem has
+    the last-N events even when the JSONL log's tail was torn."""
+    try:
+        return _train_impl(cfg, trace_dir=trace_dir, log_file=log_file)
+    except SystemExit:
+        raise  # clean exits (preemption rc 14) are not crashes
+    except BaseException:
+        flight_lib.dump("exception")
+        raise
+
+
+def _train_impl(cfg: TrainConfig, *, trace_dir: str | None = None,
+                log_file: str | None = None) -> dict:
     # Preemption contract (resilience/preempt.py): installed before the
     # harness so a SIGTERM during compile/restore is already caught; the
     # loop below checkpoints at the next step boundary and exits rc 14.
@@ -667,7 +685,20 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
     # The goodput meter starts here too: everything before the first step
     # (harness build, data, restore, compile-cache setup) is "init".
     events_lib.init()
+    # Flight recorder tees every emitted record into a bounded ring so a
+    # crash/preemption/stall dump carries the last-N events even when the
+    # JSONL tail was torn (installed right after init so the ring sees
+    # restore-time events too).
+    flight_lib.install()
     meter = goodput_lib.GoodputMeter()
+    # On-demand profiling endpoint (TensorBoard "capture profile"): env-
+    # gated, best-effort — a busy port must not kill training.
+    profiler_port = os.environ.get("TPUFRAME_PROFILER_PORT", "").strip()
+    if profiler_port:
+        try:
+            start_profiler_server(int(profiler_port))
+        except ValueError:
+            pass
     # Persistent compilation cache (utils/compile_cache): a relaunch or
     # crash-loop restart of the same program compiles from the on-disk
     # cache instead of from scratch — hit/miss counters land in the final
@@ -759,17 +790,50 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             # buckets must never sum past wall.
             meter.charge("stall", min(idle, meter.unaccounted_s()))
             _emit_run_end(run_info["step"])
+            flight_lib.dump("stall_abort")
             events_lib.close()
             logger.close()
             if timeline is not None:
                 timeline.instant("stall_abort", idle_s=idle)
                 timeline.close()
+            exporter_lib.stop()  # final textfile flush rides on stop()
         finally:
             os._exit(13)
 
     heartbeat = Heartbeat(timeout_s=stall_timeout, poll_s=stall_poll,
                           on_stall=_on_stall,
                           arm_after_first_beat=True).start()
+
+    # Live telemetry plane (obs/exporter.py): /metrics + /healthz, env-
+    # gated.  The health probe is the heartbeat watchdog — a run that
+    # stops completing steps reads 503 before the stall-abort kills it.
+    exporter = exporter_lib.start_from_env(
+        health=lambda: not heartbeat.stalled)
+    if exporter is not None:
+        def _goodput_samples():
+            s = meter.summary()
+            out = [("tpuframe_goodput_bucket_seconds", {"bucket": k}, v)
+                   for k, v in s["buckets"].items()]
+            out.append(("tpuframe_wall_seconds", {}, s["wall_s"]))
+            out.append(("tpuframe_steps_completed", {}, s["steps"]))
+            return out
+
+        def _devmem_samples():
+            sampler = run_info["devmem"]
+            if sampler is None:
+                return []
+            peaks = sampler.peak_summary()
+            out = []
+            if peaks.get("peak_hbm_bytes") is not None:
+                out.append(("tpuframe_hbm_peak_bytes", {},
+                            peaks["peak_hbm_bytes"]))
+            for did, b in (peaks.get("per_device") or {}).items():
+                out.append(("tpuframe_hbm_device_peak_bytes",
+                            {"device": did}, b))
+            return out
+
+        exporter.add_collector(_goodput_samples)
+        exporter.add_collector(_devmem_samples)
     examples_per_step = cfg.global_batch
 
     if bootstrap.is_primary():
@@ -866,14 +930,47 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             interval_s=float(os.environ.get("TPUFRAME_DEVMEM_INTERVAL_S",
                                             "30"))).start()
         meter.charge("init", meter.wall_s())
+    # Profiler trace window.  ``TPUFRAME_TRACE_STEPS="<start>:<count>"``
+    # (absolute step indices) captures a jax.profiler trace of exactly
+    # those steps; the legacy ``--trace-dir``-only invocation keeps its
+    # historical window (start_step+5, 3 steps).  The window is announced
+    # as typed trace_start/trace_end events carrying the artifact path,
+    # so the offline analyzer can join profile artifacts to the steps
+    # they cover.
+    trace_window = parse_trace_steps(os.environ.get("TPUFRAME_TRACE_STEPS"))
+    if trace_window is None and trace_dir is not None:
+        trace_window = (h.start_step + 5, 3)
+    events_dir = os.environ.get(events_lib.ENV_DIR, "").strip()
+    trace_path = trace_dir or (os.path.join(events_dir, "trace")
+                               if events_dir else "trace")
+
+    def _trace_end(at_step: int) -> None:
+        nonlocal t_trace
+        if t_trace is None:
+            return
+        try:
+            t_trace.__exit__(None, None, None)
+        except Exception:  # noqa: BLE001 — profiling must not kill the run
+            pass
+        t_trace = None
+        events_lib.emit("trace_end", step=at_step, path=trace_path)
+
     t_trace = None
     while step < cfg.total_steps:
-        if trace_dir is not None and step == h.start_step + 5:
-            t_trace = profile_trace(trace_dir)
-            t_trace.__enter__()
-        if t_trace is not None and step == h.start_step + 8:
-            t_trace.__exit__(None, None, None)
-            t_trace = None
+        if (trace_window is not None and t_trace is None
+                and step == trace_window[0]):
+            try:
+                ctx = profile_trace(trace_path)
+                ctx.__enter__()
+            except Exception:  # noqa: BLE001 — profiler unavailable: the
+                trace_window = None  # run goes on untraced
+            else:
+                t_trace = ctx
+                events_lib.emit("trace_start", step=step, path=trace_path)
+        if (t_trace is not None
+                and step >= trace_window[0] + trace_window[1]):
+            _trace_end(step)
+            trace_window = None  # one window per run
 
         t_step0 = time.perf_counter()
         if timeline is not None:
@@ -940,6 +1037,14 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             final_train_metrics.update(
                 obs_metrics.counters("compile_cache."))
             logger.log(step, final_train_metrics)
+            if exporter is not None:
+                exporter.set_gauge("tpuframe_step", step)
+                exporter.set_gauge("tpuframe_step_time_ms", step_s * 1e3)
+                exporter.set_gauge("tpuframe_input_wait_ms",
+                                   input_wait_s * 1e3)
+                if r is not None:
+                    exporter.set_gauge("tpuframe_examples_per_sec", r)
+                exporter.flush()  # keep the textfile fallback current
 
         if step % cfg.eval_every == 0 or step == cfg.total_steps:
             h.state = state
@@ -998,6 +1103,7 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
                           "committed step", flush=True)
                 meter.charge("ckpt", time.perf_counter() - t_ckpt0)
             heartbeat.stop()
+            _trace_end(step)
             if timeline is not None:
                 timeline.instant("preempted", step=step)
                 timeline.close()
@@ -1006,6 +1112,7 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
             _emit_run_end(step)
             events_lib.close()
             logger.close()
+            exporter_lib.stop()
             guard.uninstall()
             if bootstrap.is_primary():
                 print(f"[tpuframe] preempted ({guard.signal_name}): "
@@ -1013,8 +1120,7 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
                       f"{RC_PREEMPTED} for supervisor resume", flush=True)
             raise SystemExit(RC_PREEMPTED)
 
-    if t_trace is not None:
-        t_trace.__exit__(None, None, None)
+    _trace_end(step)
     t_ckpt0 = time.perf_counter()
     if h.manager is not None and step % cfg.ckpt_every != 0:
         h.manager.save(step, state)  # final state always durable
@@ -1032,6 +1138,10 @@ def train(cfg: TrainConfig, *, trace_dir: str | None = None,
         run_info["devmem"].stop()
     _emit_run_end(step)
     events_lib.close()
+    flight_lib.uninstall()
+    # Exporter goes down last: the final scrape (and the textfile flush
+    # inside stop()) reflects the completed run's books.
+    exporter_lib.stop()
     guard.uninstall()
     final_train_metrics["step"] = step
     final_train_metrics.update(obs_metrics.counters("retry."))
