@@ -30,3 +30,21 @@ else:
 
 allreduce_payload = _mod.allreduce_payload
 parse_collectives = _mod.parse_collectives
+
+# The collective-flow graph parser (analysis v2) rides the same shim:
+# still pure text parsing, still loadable before any backend decision.
+_COLLECTIVE_GRAPH = os.path.join(os.path.dirname(_HLO_AUDIT),
+                                 "collective_graph.py")
+
+if "tpuframe.analysis.collective_graph" in sys.modules:
+    _graph_mod = sys.modules["tpuframe.analysis.collective_graph"]
+else:
+    sys.modules.setdefault("_hlo_parse_impl", _mod)
+    _gspec = importlib.util.spec_from_file_location(
+        "_collective_graph_impl", _COLLECTIVE_GRAPH)
+    _graph_mod = importlib.util.module_from_spec(_gspec)
+    sys.modules["_collective_graph_impl"] = _graph_mod
+    _gspec.loader.exec_module(_graph_mod)
+
+parse_graph = _graph_mod.parse_graph
+CollectiveGraph = _graph_mod.CollectiveGraph

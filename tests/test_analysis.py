@@ -579,6 +579,115 @@ def test_tf111_suppression():
     assert source_lint.lint_source(src, "tpuframe/obs/devmem.py") == []
 
 
+def test_tf114_unlocked_mutation_in_lock_owning_class():
+    # A class that owns a lock has declared its state shared; mutating
+    # another attribute without holding the lock is the statically
+    # visible race (the contract the ckpt/obs worker threads rely on).
+    src = textwrap.dedent("""
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def push(self, x):
+                self.items.append(x)
+
+            def reset(self):
+                self.items = []
+    """)
+    findings = source_lint.lint_source(src, "tpuframe/ckpt/worker.py")
+    assert [f.rule for f in findings] == ["TF114", "TF114"]
+    assert "push" in findings[0].message
+    assert "reset" in findings[1].message
+    # same source outside the background-thread modules: out of scope
+    assert source_lint.lint_source(src, "tpuframe/train.py") == []
+
+
+def test_tf114_locked_and_ctor_mutations_are_clean():
+    src = textwrap.dedent("""
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def push(self, x):
+                with self._lock:
+                    self.items.append(x)
+                    self.count = len(self.items)
+    """)
+    assert source_lint.lint_source(src, "tpuframe/ckpt/worker.py") == []
+    # a class with no lock never opted in — nothing to check against
+    lockless = textwrap.dedent("""
+        class Plain:
+            def bump(self):
+                self.n = 1
+    """)
+    assert source_lint.lint_source(lockless,
+                                   "tpuframe/ckpt/worker.py") == []
+
+
+def test_tf114_worker_closure_runs_unlocked():
+    # A nested def's body executes when the WORKER calls it, not where
+    # it is defined — a lock held at definition time proves nothing.
+    src = textwrap.dedent("""
+        import threading
+
+        class Manager:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.errors = []
+
+            def start(self):
+                with self._lock:
+                    def work():
+                        self.errors.append("boom")
+                    return work
+    """)
+    findings = source_lint.lint_source(src, "tpuframe/ckpt/manager.py")
+    assert [f.rule for f in findings] == ["TF114"]
+    assert "errors" in findings[0].message
+
+
+def test_tf114_module_level_lock_guards_globals():
+    src = textwrap.dedent("""
+        import threading
+
+        _lock = threading.Lock()
+        _active = None
+
+        def stop():
+            global _active
+            _active = None
+
+        def start(x):
+            global _active
+            with _lock:
+                _active = x
+    """)
+    findings = source_lint.lint_source(src, "tpuframe/obs/exporter.py")
+    assert [f.rule for f in findings] == ["TF114"]
+    assert "stop" in findings[0].message and "_active" in findings[0].message
+
+
+def test_tf114_suppression():
+    src = textwrap.dedent("""
+        import threading
+
+        class Recorder:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.path = None
+
+            def dump(self, p):
+                self.path = p  # tf-lint: ok[TF114]
+    """)
+    assert source_lint.lint_source(src, "tpuframe/obs/flight.py") == []
+
+
 def test_shipped_tree_self_lints_clean():
     import tpuframe
 

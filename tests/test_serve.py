@@ -433,6 +433,18 @@ class TestServeDecodeAudit:
         # index/length bookkeeping, not tensor traffic
         for op in audit.report.ops:
             assert op.bytes < audit.budget.ignore_below
+        # the checked-in auto-derived budget is this program's exact
+        # record — asserted instead of hand-copied byte constants
+        import jax
+
+        from tpuframe.analysis import shardflow
+
+        derived_file = shardflow.load_derived()
+        assert derived_file is not None
+        if derived_file["jax"] == jax.__version__:
+            assert shardflow.derive_budget(
+                audit.report, audit.budget.ignore_below) == \
+                shardflow.derived_for("serve-dp-decode")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,434 @@
+"""Analysis v2: collective-flow graph parser + structural detectors.
+
+Everything here runs without compiling anything: the golden fixtures
+under ``tests/fixtures/hlo/`` are real optimized-HLO modules compiled
+once on an 8-device CPU mesh (regenerate with
+``tests/fixtures/regen_hlo.py``), and the seeded positives are
+hand-written HLO snippets each detector must flag — every detector is
+proven against both a known-bad program and the seven known-clean
+strategy programs.
+"""
+
+import gzip
+import json
+import os
+import types
+
+import pytest
+
+from tpuframe.analysis import hlo_audit, shardflow
+from tpuframe.analysis.collective_graph import parse_graph
+
+FIXDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "fixtures", "hlo")
+
+with open(os.path.join(FIXDIR, "goldens.json")) as _f:
+    GOLDENS = json.load(_f)
+
+
+def _fixture_text(name: str) -> str:
+    entry = GOLDENS["strategies"][name]
+    with gzip.open(os.path.join(FIXDIR, entry["file"]), "rt") as f:
+        return f.read()
+
+
+def _fake_audit(txt: str, *, name="seeded", ignore_below=0, meta=None):
+    """The duck-typed slice of StrategyAudit the shardflow APIs read."""
+    return types.SimpleNamespace(
+        name=name, status="ok", reason="", violations=[],
+        report=hlo_audit.parse_collectives(txt),
+        budget=types.SimpleNamespace(ignore_below=ignore_below),
+        compiled=types.SimpleNamespace(as_text=lambda: txt),
+        meta=meta)
+
+
+# ---------------------------------------------------------------------------
+# Golden fixtures: parser shape pins + detectors clean on real programs.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(GOLDENS["strategies"]))
+def test_golden_graph_shape(name):
+    """Same fixture text => same parsed shape.  A parser change that
+    drops computations/nodes/collectives fails here before it silently
+    blinds the detectors."""
+    graph = parse_graph(_fixture_text(name))
+    assert graph.summary() == GOLDENS["strategies"][name]["summary"]
+    assert graph.entry_computation is not None
+
+
+@pytest.mark.parametrize("name", sorted(GOLDENS["strategies"]))
+def test_golden_fixtures_pass_detectors(name):
+    """Every registered strategy's real compiled program is clean under
+    every structural detector (the acceptance criterion's clean half)."""
+    entry = GOLDENS["strategies"][name]
+    txt = _fixture_text(name)
+    graph = parse_graph(txt)
+    assert shardflow.detect_redundant_pairs(graph) == []
+    assert shardflow.detect_wire_dtype(graph, entry["wire_dtype"]) == []
+    assert shardflow.detect_replica_groups(
+        graph, dict(tuple(p) for p in entry["mesh_shape"])) == []
+    assert shardflow.census_cross_check(
+        graph, hlo_audit.parse_collectives(txt)) == []
+
+
+def test_goldens_match_checked_in_derived_budgets():
+    """The fixtures, the derived-budget declarations, and the live gate
+    all describe the same seven programs."""
+    derived = shardflow.load_derived()
+    assert derived is not None
+    assert set(GOLDENS["strategies"]) == set(derived["strategies"])
+    for name in GOLDENS["strategies"]:
+        report = hlo_audit.parse_collectives(_fixture_text(name))
+        decl = derived["strategies"][name]
+        fresh = shardflow.derive_budget(report, decl["ignore_below"])
+        assert fresh == decl, name
+
+
+# ---------------------------------------------------------------------------
+# Seeded positives: one known-bad program per detector.
+# ---------------------------------------------------------------------------
+
+_ADD = """\
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(%a, %b)
+}
+
+%max (c: f32[], d: f32[]) -> f32[] {
+  %c = f32[] parameter(0)
+  %d = f32[] parameter(1)
+  ROOT %m = f32[] maximum(%c, %d)
+}
+"""
+
+_GROUPS8 = "replica_groups={{0,1,2,3,4,5,6,7}}"
+
+
+def _module(entry_body: str) -> str:
+    return (f"HloModule seeded\n\n{_ADD}\n"
+            f"ENTRY %main (p0: f32[1024]) -> f32[1024] {{\n"
+            f"{entry_body}\n}}\n")
+
+
+def test_seeded_redundant_ag_rs_pair():
+    txt = _module(
+        f"  %p0 = f32[1024] parameter(0)\n"
+        f"  %ag = f32[8192] all-gather(%p0), {_GROUPS8}, dimensions={{0}}\n"
+        f"  %cp = f32[8192] copy(%ag)\n"
+        f"  ROOT %rs = f32[1024] reduce-scatter(%cp), {_GROUPS8}, "
+        f"to_apply=%add")
+    findings = shardflow.detect_redundant_pairs(parse_graph(txt))
+    assert len(findings) == 1
+    assert "redundant pair" in findings[0]
+    # the def-use chase went through the copy to the all-gather
+    assert "%ag" in findings[0] and "%rs" in findings[0]
+
+
+def test_seeded_redundant_pair_needs_same_groups():
+    txt = _module(
+        f"  %p0 = f32[1024] parameter(0)\n"
+        f"  %ag = f32[8192] all-gather(%p0), {_GROUPS8}, dimensions={{0}}\n"
+        f"  ROOT %rs = f32[1024] reduce-scatter(%ag), "
+        f"replica_groups={{{{0,1,2,3}},{{4,5,6,7}}}}, to_apply=%add")
+    assert shardflow.detect_redundant_pairs(parse_graph(txt)) == []
+
+
+def test_seeded_duplicate_all_reduce():
+    txt = _module(
+        f"  %p0 = f32[1024] parameter(0)\n"
+        f"  %ar1 = f32[1024] all-reduce(%p0), {_GROUPS8}, to_apply=%add\n"
+        f"  %ar2 = f32[1024] all-reduce(%p0), {_GROUPS8}, to_apply=%add\n"
+        f"  ROOT %o = f32[1024] add(%ar1, %ar2)")
+    findings = shardflow.detect_redundant_pairs(parse_graph(txt))
+    assert len(findings) == 1
+    assert "duplicate all-reduce" in findings[0]
+    assert "%ar1" in findings[0] and "%ar2" in findings[0]
+
+
+def test_seeded_duplicate_ar_distinct_reduce_fns_clean():
+    """A sum- and a max-reduction of one def are NOT duplicates."""
+    txt = _module(
+        f"  %p0 = f32[1024] parameter(0)\n"
+        f"  %ar1 = f32[1024] all-reduce(%p0), {_GROUPS8}, to_apply=%add\n"
+        f"  %ar2 = f32[1024] all-reduce(%p0), {_GROUPS8}, to_apply=%max\n"
+        f"  ROOT %o = f32[1024] add(%ar1, %ar2)")
+    assert shardflow.detect_redundant_pairs(parse_graph(txt)) == []
+
+
+def test_seeded_wire_dtype_violation():
+    txt = _module(
+        f"  %p0 = f32[1024] parameter(0)\n"
+        f"  ROOT %ar = f32[1024] all-reduce(%p0), {_GROUPS8}, "
+        f"to_apply=%add")
+    findings = shardflow.detect_wire_dtype(parse_graph(txt), "bf16")
+    assert len(findings) == 1
+    assert "carries f32" in findings[0]
+    # ...but an f32 wire declaration, or a byte floor above the payload,
+    # accepts the same program.
+    assert shardflow.detect_wire_dtype(parse_graph(txt), "f32") == []
+    assert shardflow.detect_wire_dtype(parse_graph(txt), "bf16",
+                                       ignore_below=1 << 20) == []
+
+
+def test_wire_format_allowlist_seam():
+    """A registered quantized wire format exempts its dtype set — the
+    EQuARX registration point."""
+    txt = _module(
+        f"  %p0 = f32[1024] parameter(0)\n"
+        f"  ROOT %ar = f32[1024] all-reduce(%p0), {_GROUPS8}, "
+        f"to_apply=%add")
+    graph = parse_graph(txt)
+    assert shardflow.detect_wire_dtype(graph, "bf16") != []
+    shardflow.register_wire_format("test-blockwise", {"f32", "u8"})
+    try:
+        assert "test-blockwise" in shardflow.registered_wire_formats()
+        assert shardflow.detect_wire_dtype(graph, "bf16") == []
+    finally:
+        shardflow._WIRE_FORMATS.pop("test-blockwise")
+
+
+def test_seeded_accidental_replication():
+    txt = ("HloModule seeded\n\n"
+           "ENTRY %main (p0: f32[1024,64]) -> f32[1024,64] {\n"
+           "  %p0 = f32[1024,64] parameter(0)\n"
+           "  ROOT %c = f32[1024,64] copy(%p0)\n}\n")
+    declared = (("f32", (1024, 64), (128, 64)),)
+    findings = shardflow.detect_replication(parse_graph(txt), declared)
+    assert len(findings) == 1
+    assert "accidental replication" in findings[0]
+    # sharded as declared -> clean; tiny leaves stay under the floor
+    sharded = ("HloModule ok\n\n"
+               "ENTRY %main (p0: f32[128,64]) -> f32[128,64] {\n"
+               "  %p0 = f32[128,64] parameter(0)\n"
+               "  ROOT %c = f32[128,64] copy(%p0)\n}\n")
+    assert shardflow.detect_replication(parse_graph(sharded),
+                                        declared) == []
+    assert shardflow.detect_replication(
+        parse_graph(txt), declared, floor=1 << 30) == []
+
+
+def test_seeded_replica_group_violations():
+    mesh = {"data": 8}
+
+    def groups_of(attr):
+        txt = _module(
+            f"  %p0 = f32[1024] parameter(0)\n"
+            f"  ROOT %ar = f32[1024] all-reduce(%p0), "
+            f"replica_groups={attr}, to_apply=%add")
+        return shardflow.detect_replica_groups(parse_graph(txt), mesh)
+
+    assert groups_of("{{0,1,2,3,4,5,6,7}}") == []
+    unequal = groups_of("{{0,1,2},{3,4},{5,6,7}}")
+    assert len(unequal) == 1 and "unequal group sizes" in unequal[0]
+    overlap = groups_of("{{0,1},{1,2},{3,4},{5,6}}")
+    assert len(overlap) == 1 and "overlap" in overlap[0]
+    partial = groups_of("{{0,1},{2,3}}")
+    assert len(partial) == 1 and "cover" in partial[0]
+
+
+def test_seeded_replica_group_size_not_axis_product():
+    # 12-device a×b mesh: size-2 groups partition the devices but no
+    # combination of the declared axes (4, 3) explains a 2-wide group.
+    mesh = {"a": 4, "b": 3}
+    groups = "{" + ",".join(
+        f"{{{2 * i},{2 * i + 1}}}" for i in range(6)) + "}"
+    txt = _module(
+        f"  %p0 = f32[1024] parameter(0)\n"
+        f"  ROOT %ar = f32[1024] all-reduce(%p0), "
+        f"replica_groups={groups}, to_apply=%add")
+    findings = shardflow.detect_replica_groups(parse_graph(txt), mesh)
+    assert len(findings) == 1
+    assert "not a product of declared mesh axes" in findings[0]
+
+
+def test_seeded_replica_group_iota_forms():
+    mesh = {"data": 8}
+
+    def iota_of(count, size):
+        txt = _module(
+            f"  %p0 = f32[1024] parameter(0)\n"
+            f"  ROOT %ar = f32[1024] all-reduce(%p0), "
+            f"replica_groups=[{count},{size}]<=[8], to_apply=%add")
+        return shardflow.detect_replica_groups(parse_graph(txt), mesh)
+
+    assert iota_of(1, 8) == []
+    short = iota_of(2, 2)                 # covers 4 of 8 devices
+    assert len(short) == 1 and "do not cover" in short[0]
+    odd = iota_of(4, 2)                   # covers, but 2 not in {1, 8}
+    assert len(odd) == 1 and "not a product" in odd[0]
+
+
+def test_seeded_collective_permute_pairs():
+    mesh = {"data": 8}
+
+    def permute_of(pairs):
+        txt = _module(
+            f"  %p0 = f32[1024] parameter(0)\n"
+            f"  ROOT %cp = f32[1024] collective-permute(%p0), "
+            f"source_target_pairs={pairs}")
+        return shardflow.detect_replica_groups(parse_graph(txt), mesh)
+
+    assert permute_of("{{0,1},{1,2},{2,3}}") == []
+    dup = permute_of("{{0,1},{0,2}}")
+    assert len(dup) == 1 and "duplicate" in dup[0]
+    out = permute_of("{{0,9}}")
+    assert len(out) == 1 and "outside the declared" in out[0]
+
+
+def test_census_cross_check_mismatch():
+    """Feed the census a report for a DIFFERENT program — the cross
+    check must notice the two parsers disagree."""
+    txt = _module(
+        f"  %p0 = f32[1024] parameter(0)\n"
+        f"  ROOT %ar = f32[1024] all-reduce(%p0), {_GROUPS8}, "
+        f"to_apply=%add")
+    other = _module("  ROOT %p0 = f32[1024] parameter(0)")
+    graph = parse_graph(txt)
+    assert shardflow.census_cross_check(
+        graph, hlo_audit.parse_collectives(txt)) == []
+    findings = shardflow.census_cross_check(
+        graph, hlo_audit.parse_collectives(other))
+    assert len(findings) == 1 and "census mismatch" in findings[0]
+
+
+# ---------------------------------------------------------------------------
+# Derived budgets: drift in either direction fails; version skew skips.
+# ---------------------------------------------------------------------------
+
+_AR_TXT = None  # built once below
+
+
+def _ar_audit():
+    global _AR_TXT
+    if _AR_TXT is None:
+        _AR_TXT = _module(
+            f"  %p0 = f32[1024] parameter(0)\n"
+            f"  ROOT %ar = f32[1024] all-reduce(%p0), {_GROUPS8}, "
+            f"to_apply=%add")
+    return _fake_audit(_AR_TXT)
+
+
+def _derived_file_for(audit) -> dict:
+    return {
+        "schema": shardflow.REPORT_SCHEMA,
+        "jax": shardflow._jax_version(),
+        "n_devices": 8,
+        "strategies": {audit.name: shardflow.derive_budget(
+            audit.report, audit.budget.ignore_below)},
+    }
+
+
+def test_budget_drift_clean_and_both_directions():
+    audit = _ar_audit()
+    derived = _derived_file_for(audit)
+    assert shardflow.budget_drift(audit, derived) == []
+    # declaration drifts above the program -> finding
+    high = json.loads(json.dumps(derived))
+    high["strategies"][audit.name]["kinds"]["all-reduce"]["bytes"] += 4
+    assert any("drift on all-reduce" in p
+               for p in shardflow.budget_drift(audit, high))
+    # declaration misses a kind the program has -> finding too
+    gone = json.loads(json.dumps(derived))
+    del gone["strategies"][audit.name]["kinds"]["all-reduce"]
+    assert any("drift on all-reduce" in p
+               for p in shardflow.budget_drift(audit, gone))
+
+
+def test_budget_drift_missing_entry_and_version_skew():
+    audit = _ar_audit()
+    derived = _derived_file_for(audit)
+    nobody = json.loads(json.dumps(derived))
+    nobody["strategies"] = {}
+    assert any("no entry" in p
+               for p in shardflow.budget_drift(audit, nobody))
+    skew = json.loads(json.dumps(derived))
+    skew["jax"] = "0.0.0-not-this-one"
+    assert shardflow.budget_drift(audit, skew) == []
+    assert shardflow.budget_drift(audit, None) != []
+
+
+def test_derived_for_every_fixture_strategy():
+    for name in GOLDENS["strategies"]:
+        entry = shardflow.derived_for(name)
+        assert entry is not None, name
+        assert set(entry) == {"ignore_below", "kinds", "above_floor",
+                              "total_bytes"}
+        assert entry["total_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# The --json report schema + the compare contract (rc 0/1/2).
+# ---------------------------------------------------------------------------
+
+_TOP_KEYS = {"schema", "jax", "n_devices", "lint", "strategies"}
+_STRATEGY_KEYS = {"name", "status", "reason", "violations", "collectives",
+                  "total_bytes", "derived", "drift", "detectors", "graph"}
+_DETECTOR_KEYS = {"redundant_pair", "wire_dtype", "replication",
+                  "replica_groups", "census"}
+
+
+def _build_one_report(tmp_path, *, name="seeded"):
+    audit = _fake_audit(_ar_audit().compiled.as_text(), name=name)
+    derived_path = tmp_path / f"derived_{name}.json"
+    derived_path.write_text(json.dumps(_derived_file_for(audit)))
+    finding = types.SimpleNamespace(rule="TF999", path="x.py", line=3,
+                                    message="demo")
+    return shardflow.build_report([audit], lint_findings=[finding],
+                                  n_devices=8,
+                                  derived_path=str(derived_path))
+
+
+def test_report_schema_pinned(tmp_path):
+    """The --json report shape is an API: obs-compare-style tooling
+    parses it, so key changes must be deliberate (bump REPORT_SCHEMA)."""
+    report = _build_one_report(tmp_path)
+    assert set(report) == _TOP_KEYS
+    assert report["schema"] == shardflow.REPORT_SCHEMA == 1
+    assert report["lint"] == [{"rule": "TF999", "path": "x.py",
+                               "line": 3, "message": "demo"}]
+    (entry,) = report["strategies"]
+    assert set(entry) == _STRATEGY_KEYS
+    assert set(entry["detectors"]) == _DETECTOR_KEYS
+    assert set(entry["derived"]) == {"ignore_below", "kinds",
+                                     "above_floor", "total_bytes"}
+    assert set(entry["graph"]) == {"computations", "nodes",
+                                   "entry_parameters",
+                                   "collectives_by_kind"}
+    assert entry["drift"] == []
+    json.dumps(report)  # must be serializable as-is
+
+
+def test_compare_reports_contract(tmp_path):
+    base = _build_one_report(tmp_path)
+    # identical reports: rc 0, one "ok" line per strategy
+    rc, lines = shardflow.compare_reports(base, base)
+    assert rc == 0 and any(ln.startswith("ok seeded") for ln in lines)
+    # op-count change: rc 1 with a REGRESSION line
+    worse = json.loads(json.dumps(base))
+    worse["strategies"][0]["derived"]["kinds"]["all-reduce"]["count"] += 1
+    rc, lines = shardflow.compare_reports(base, worse)
+    assert rc == 1 and any("op count" in ln for ln in lines)
+    # kind disappearing: rc 1
+    gone = json.loads(json.dumps(base))
+    del gone["strategies"][0]["derived"]["kinds"]["all-reduce"]
+    rc, _ = shardflow.compare_reports(base, gone)
+    assert rc == 1
+    # byte move beyond tolerance: rc 1; within tolerance: rc 0
+    fat = json.loads(json.dumps(base))
+    kinds = fat["strategies"][0]["derived"]["kinds"]["all-reduce"]
+    kinds["bytes"] = int(kinds["bytes"] * 1.5)
+    rc, _ = shardflow.compare_reports(base, fat)
+    assert rc == 1
+    rc, _ = shardflow.compare_reports(base, fat, bytes_tol=0.6)
+    assert rc == 0
+    # a detector going from clean to firing: rc 1
+    noisy = json.loads(json.dumps(base))
+    noisy["strategies"][0]["detectors"]["wire_dtype"] = ["boom"]
+    rc, lines = shardflow.compare_reports(base, noisy)
+    assert rc == 1 and any("detector wire_dtype" in ln for ln in lines)
+    # disjoint strategy sets: rc 2
+    other = _build_one_report(tmp_path, name="different")
+    rc, _ = shardflow.compare_reports(base, other)
+    assert rc == 2

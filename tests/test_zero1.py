@@ -311,6 +311,17 @@ class TestAudit:
         # scalar floor means the swap did not happen
         assert audit.report.bytes_by_kind(
             min_bytes=budget.ignore_below).get("all-reduce", 0) == 0
+        # and the checked-in auto-derived budget IS this program's
+        # record — no hand-copied byte constants to fall out of date
+        # (python -m tpuframe.analysis --emit-budgets regenerates it)
+        from tpuframe.analysis import shardflow
+
+        derived_file = shardflow.load_derived()
+        assert derived_file is not None
+        if derived_file["jax"] == jax.__version__:
+            assert shardflow.derive_budget(
+                audit.report, budget.ignore_below) == \
+                shardflow.derived_for("dp-zero1")
 
     def test_budget_is_exact_padded_bytes(self):
         b = budgets_lib.zero1_budget(1000)

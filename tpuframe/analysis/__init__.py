@@ -10,7 +10,7 @@ is spent (the same argument as GSPMD's weight-update-sharding analysis
 and Horovod's tensor-order consistency checks: in SPMD systems the
 communication structure is decided at compile time, so check it there).
 
-Three layers, all offline:
+Four layers, all offline:
 
   1. :mod:`tpuframe.analysis.hlo_audit` — parse every collective
      (all-reduce, all-gather, reduce-scatter, all-to-all,
@@ -27,9 +27,16 @@ Three layers, all offline:
      on tracers, Python control flow on tracer values, timing without
      ``block_until_ready``, pallas calls without an explicit
      interpret/Mosaic decision.
+  4. :mod:`tpuframe.analysis.collective_graph` +
+     :mod:`tpuframe.analysis.shardflow` — the *structural* layer
+     (analysis v2): the optimized HLO parsed into a typed def-use graph
+     of collectives/parameters, detectors for redundant collective
+     pairs, wire-dtype violations, accidental replication and
+     replica-group/mesh inconsistency, and per-strategy derived budgets
+     drift-checked against the checked-in ``derived_budgets.json``.
 
 CLI: ``python -m tpuframe.analysis`` (see ``__main__.py``) runs all
-three layers CPU-only and exits non-zero on any finding — the CI gate.
+four layers CPU-only and exits non-zero on any finding — the CI gate.
 Runtime registration: ``tpuframe.obs.spmd_check.check_step_program``
 accepts a ``budget=`` so the startup hash check and the collective
 audit run off the same lowering.
@@ -40,6 +47,13 @@ from tpuframe.analysis.budgets import (  # noqa: F401
     KNOWN_VMEM_EXCLUSIONS,
     check_budget,
     strategy_budget,
+)
+from tpuframe.analysis.collective_graph import (  # noqa: F401
+    CollectiveGraph,
+    Computation,
+    Node,
+    graph_of_compiled,
+    parse_graph,
 )
 from tpuframe.analysis.hlo_audit import (  # noqa: F401
     CollectiveOp,
@@ -55,6 +69,13 @@ from tpuframe.analysis.jaxpr_checks import (  # noqa: F401
     find_f32_matmuls,
     find_large_constants,
     parse_input_output_alias,
+)
+from tpuframe.analysis.shardflow import (  # noqa: F401
+    build_report,
+    compare_reports,
+    derive_budget,
+    derived_for,
+    register_wire_format,
 )
 from tpuframe.analysis.source_lint import (  # noqa: F401
     LintFinding,

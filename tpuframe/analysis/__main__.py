@@ -22,7 +22,19 @@ non-zero on any finding:
   6. mem self-check — the remat policy registry must apply every preset,
      ``save_named`` must parse (and reject unknown seams), and the
      model/step files must pass the TF108 registry-seam lint
-     (``tpuframe.mem.check``).
+     (``tpuframe.mem.check``);
+  7. shardflow — the structural detectors of
+     :mod:`tpuframe.analysis.shardflow` (redundant collective pairs,
+     wire-dtype, accidental replication, replica-group consistency) run
+     over the collective-flow graph of every compiled strategy, and the
+     auto-derived per-kind budgets are drift-checked against the
+     checked-in ``derived_budgets.json`` (regenerate with
+     ``--emit-budgets``).
+
+``--json PATH`` writes the whole gate outcome as a schema-pinned report;
+``--compare A.json B.json`` diffs two such reports for structural
+collective regressions (rc 1 regression / 0 clean / 2 no overlap — the
+``obs compare`` contract) without touching jax at all.
 
 Strategies this interpreter cannot express (see
 :class:`~tpuframe.analysis.strategies.Unavailable`) print as SKIP and do
@@ -76,6 +88,21 @@ def _parse(argv):
     ap.add_argument("--devices", type=int, default=8,
                     help="virtual CPU device count for the strategy "
                          "audits (default 8)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write the gate outcome as a "
+                         "machine-readable report (schema-pinned)")
+    ap.add_argument("--emit-budgets", action="store_true",
+                    help="regenerate tpuframe/analysis/"
+                         "derived_budgets.json from the compiled "
+                         "strategies (the drift check's declarations)")
+    ap.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                    default=None,
+                    help="diff two --json reports for structural "
+                         "collective regressions (no jax; rc 1 "
+                         "regression, 0 clean, 2 no overlap)")
+    ap.add_argument("--bytes-tol", type=float, default=0.10,
+                    help="relative per-kind byte tolerance for "
+                         "--compare (default 0.10)")
     return ap.parse_args(argv)
 
 
@@ -85,7 +112,7 @@ def _default_lint_paths() -> list[str]:
     return [os.path.dirname(os.path.abspath(tpuframe.__file__))]
 
 
-def _run_lint(paths) -> int:
+def _run_lint(paths) -> list:
     from tpuframe.analysis.source_lint import lint_paths
 
     findings = lint_paths(paths)
@@ -93,18 +120,62 @@ def _run_lint(paths) -> int:
         print(f"LINT {f}")
     print(f"[analysis] source lint: {len(findings)} finding(s) over "
           f"{', '.join(map(str, paths))}")
-    return len(findings)
+    return findings
 
 
-def _run_strategies(names, n_devices) -> int:
+def _run_strategies(names, n_devices) -> tuple[int, list]:
     from tpuframe.analysis import strategies
 
     failures = 0
-    for audit in strategies.audit_all(n_devices, names):
+    audits = strategies.audit_all(n_devices, names)
+    for audit in audits:
         print(f"[analysis] {audit}")
         if audit.status == "violation":
             failures += len(audit.violations) or 1
-    return failures
+    return failures, audits
+
+
+def _run_shardflow(audits, n_devices, *, emit: bool) -> int:
+    from tpuframe.analysis import shardflow
+
+    if emit:
+        shardflow.emit_derived(audits, n_devices=n_devices)
+        print(f"[analysis] wrote {shardflow.DERIVED_BUDGETS_PATH}")
+    problems = shardflow.check(audits, n_devices=n_devices)
+    for p in problems:
+        print(f"FLOW {p}")
+    print(f"[analysis] shardflow: {len(problems)} problem(s) over "
+          f"{sum(1 for a in audits if a.compiled is not None)} "
+          f"compiled strategy program(s)")
+    return len(problems)
+
+
+def _run_compare(path_a, path_b, bytes_tol) -> int:
+    import json
+
+    from tpuframe.analysis import shardflow
+
+    with open(path_a) as f:
+        a = json.load(f)
+    with open(path_b) as f:
+        b = json.load(f)
+    rc, lines = shardflow.compare_reports(a, b, bytes_tol=bytes_tol)
+    for line in lines:
+        print(line)
+    return rc
+
+
+def _write_json(path, audits, lint_findings, n_devices) -> None:
+    import json
+
+    from tpuframe.analysis import shardflow
+
+    report = shardflow.build_report(audits, lint_findings=lint_findings,
+                                    n_devices=n_devices)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"[analysis] wrote {path}")
 
 
 def _run_tune_check() -> int:
@@ -172,6 +243,16 @@ def main(argv=None) -> int:
     args = _parse(argv if argv is not None else sys.argv[1:])
     lint_paths_arg = args.paths or _default_lint_paths()
 
+    if args.compare:
+        # Pure JSON diffing — no jax, no re-exec, usable anywhere.
+        return _run_compare(args.compare[0], args.compare[1],
+                            args.bytes_tol)
+
+    if args.emit_budgets and args.strategy:
+        print("[analysis] --emit-budgets regenerates the whole "
+              "declaration file and cannot be combined with --strategy")
+        return 2
+
     if not args.lint_only and os.environ.get(_CHILD_FLAG) != "1":
         # Re-exec with a clean multi-device CPU backend; the child runs
         # this same main() with _CHILD_FLAG set.
@@ -179,19 +260,29 @@ def main(argv=None) -> int:
                "--devices", str(args.devices)]
         for s in args.strategy or ():
             cmd += ["--strategy", s]
+        if args.json:
+            cmd += ["--json", args.json]
+        if args.emit_budgets:
+            cmd += ["--emit-budgets"]
         cmd += args.paths or []
         return subprocess.call(cmd, env=_scrubbed_cpu_env(args.devices))
 
-    n_findings = _run_lint(lint_paths_arg)
+    lint_findings = _run_lint(lint_paths_arg)
+    n_findings = len(lint_findings)
     if not args.lint_only:
-        n_findings += _run_strategies(
+        strat_failures, audits = _run_strategies(
             tuple(args.strategy) if args.strategy else None, args.devices)
+        n_findings += strat_failures
+        n_findings += _run_shardflow(audits, args.devices,
+                                     emit=args.emit_budgets)
         n_findings += _run_registry_checks()
         n_findings += _run_tune_check()
         n_findings += _run_mem_check()
         n_findings += _run_serve_check()
         n_findings += _run_zero1_check()
         n_findings += _run_obs_check()
+        if args.json:
+            _write_json(args.json, audits, lint_findings, args.devices)
 
     if n_findings:
         print(f"[analysis] FAIL: {n_findings} finding(s)")

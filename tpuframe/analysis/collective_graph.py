@@ -1,0 +1,289 @@
+"""Layer 1.5: the typed collective-flow graph of a compiled program.
+
+``hlo_audit`` answers *how many bytes* each collective class moves — a
+flat census, enough for the budget ceilings.  This module answers the
+*structural* questions the censuses cannot: which value feeds which
+collective, whether two all-reduces sit on one def, whether a parameter
+stayed at its full (replicated) shape under a sharding strategy.  It
+parses the optimized HLO text (``compiled.as_text()``, post-GSPMD — the
+authoritative program) into typed :class:`Node`/:class:`Computation`
+objects with def-use edges, replica groups, shapes and dtypes, and the
+detectors in :mod:`tpuframe.analysis.shardflow` run over the result.
+
+Same contract as ``hlo_audit``: pure text parsing, stdlib only (perf
+scripts import it through ``perf/_hlo_parse.py`` before their env-guard
+re-exec, when initializing jax would pin the wrong backend).  The parser
+is deliberately tolerant — an instruction it cannot classify still lands
+in the graph as an opaque node with its def-use edges intact, so a new
+XLA opcode degrades coverage, never correctness of the edges.
+
+Byte accounting here is *result bytes* (what the instruction defines),
+not the wire-traffic proxy — budget derivation stays on
+``hlo_audit.parse_collectives`` so the derived budgets and the audit
+ceilings are measured by the same ruler; the graph cross-checks the
+census by collective *count*, where the two parsers must agree exactly.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+try:
+    # When perf/_hlo_parse.py loads this module by file path (its
+    # side-effect-free contract), hlo_audit is already registered under
+    # this name and importing the tpuframe package (jax!) must not run.
+    from _hlo_parse_impl import COLLECTIVE_KINDS, _DTYPE_BYTES
+except ImportError:
+    from tpuframe.analysis.hlo_audit import COLLECTIVE_KINDS, _DTYPE_BYTES
+
+# `%comp_name (args...) -> result {` — ENTRY marks the top computation.
+_COMP_RE = re.compile(
+    r"^(ENTRY\s+)?%?([\w.$-]+)\s*\(.*\)\s*->\s*.+\{\s*$")
+
+# `[ROOT] %name = <result-type> opcode(` — lazy result-type match stops
+# at the first lowercase word directly followed by '(' (the opcode; type
+# text never has that shape).
+_INSTR_RE = re.compile(
+    r"^\s*(ROOT\s+)?%?([\w.-]+)\s*=\s*(.+?)\s*([a-z][a-z0-9-]*)\(")
+
+_SHAPE_RE = re.compile(
+    r"(" + "|".join(sorted(_DTYPE_BYTES, key=len, reverse=True))
+    + r")\[([0-9,]*)\]")
+
+_OPERAND_RE = re.compile(r"%([\w.-]+)")
+_CHANNEL_RE = re.compile(r"channel_id=(\d+)")
+_GROUPS_RE = re.compile(r"replica_groups=\{((?:\{[0-9, ]*\},?)*)\}")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{[0-9, ]*\},?)*)\}")
+_CALLED_RE = re.compile(
+    r"(?:to_apply|calls|body|condition|true_computation|"
+    r"false_computation)=%?([\w.$-]+)")
+_SHARDING_RE = re.compile(r"sharding=\{")
+
+#: opcodes that forward their operand's value unchanged (or reshaped) —
+#: def-use chains for the redundancy detectors look *through* these.
+PASSTHROUGH_OPS = frozenset({
+    "copy", "bitcast", "reshape", "transpose", "get-tuple-element",
+    "optimization-barrier", "all-reduce-done", "all-gather-done",
+    "reduce-scatter-done", "collective-permute-done", "all-to-all-done",
+})
+
+_COLLECTIVE_OPS = {}
+for _k in COLLECTIVE_KINDS:
+    _COLLECTIVE_OPS[_k] = _k
+    _COLLECTIVE_OPS[_k + "-start"] = _k
+
+
+def _span_paren(line: str, start: int) -> int:
+    """Index just past the ')' matching the '(' at ``start``."""
+    depth = 0
+    for i in range(start, len(line)):
+        c = line[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return len(line)
+
+
+def _parse_groups(txt: str) -> tuple[tuple[int, ...], ...]:
+    groups = []
+    for body in re.findall(r"\{([0-9, ]*)\}", txt):
+        groups.append(tuple(int(x) for x in body.replace(" ", "").split(",")
+                            if x))
+    return tuple(g for g in groups if g)
+
+
+@dataclass
+class Node:
+    """One HLO instruction: a def, its shape/dtype, and its uses."""
+
+    name: str                       # instruction name, '%' stripped
+    op: str                         # raw opcode ("all-reduce-start", "dot")
+    kind: str | None                # canonical collective kind, else None
+    is_root: bool = False
+    is_async_start: bool = False
+    shapes: tuple[tuple[str, tuple[int, ...]], ...] = ()  # (dtype, dims)
+    operands: tuple[str, ...] = ()  # operand instruction names (in order)
+    called: tuple[str, ...] = ()    # called computation names
+    replica_groups: tuple[tuple[int, ...], ...] | None = None
+    iota_groups: tuple[int, int] | None = None   # (count, size) iota form
+    source_target_pairs: tuple[tuple[int, ...], ...] | None = None
+    channel_id: int | None = None
+    sharded: bool = False           # carries a sharding={...} annotation
+    line_no: int = 0
+    line: str = ""                  # stripped, truncated source line
+
+    @property
+    def result_bytes(self) -> int:
+        total = 0
+        for dt, dims in self.shapes:
+            n = 1
+            for d in dims:
+                n *= d
+            total += n * _DTYPE_BYTES[dt]
+        return total
+
+    @property
+    def dtypes(self) -> frozenset:
+        return frozenset(dt for dt, _ in self.shapes)
+
+    def __str__(self):
+        shp = ", ".join(f"{dt}[{','.join(map(str, dims))}]"
+                        for dt, dims in self.shapes)
+        return f"{self.op} %{self.name} = {shp}"
+
+
+@dataclass
+class Computation:
+    """One HLO computation: an ordered def list plus the use index."""
+
+    name: str
+    is_entry: bool = False
+    nodes: dict[str, Node] = field(default_factory=dict)
+    root: str | None = None
+
+    def users_of(self) -> dict[str, list[str]]:
+        """operand name -> names of nodes that consume it (def-use)."""
+        users: dict[str, list[str]] = {}
+        for node in self.nodes.values():
+            for op_name in node.operands:
+                users.setdefault(op_name, []).append(node.name)
+        return users
+
+    def resolve_value(self, name: str) -> str:
+        """Chase ``name`` back through pass-through ops to the def that
+        actually produces the value (bounded by graph size — cycles are
+        impossible in HLO SSA)."""
+        seen = set()
+        while name in self.nodes and name not in seen:
+            seen.add(name)
+            node = self.nodes[name]
+            if node.op in PASSTHROUGH_OPS and node.operands:
+                name = node.operands[0]
+                continue
+            break
+        return name
+
+    def parameters(self) -> list[Node]:
+        return [n for n in self.nodes.values() if n.op == "parameter"]
+
+    def collectives(self) -> list[Node]:
+        return [n for n in self.nodes.values() if n.kind is not None]
+
+
+@dataclass
+class CollectiveGraph:
+    """The whole module: computations by name, entry singled out."""
+
+    computations: dict[str, Computation] = field(default_factory=dict)
+    entry: str | None = None
+
+    @property
+    def entry_computation(self) -> Computation | None:
+        return self.computations.get(self.entry) if self.entry else None
+
+    def all_nodes(self):
+        for comp in self.computations.values():
+            yield from comp.nodes.values()
+
+    def collectives(self) -> list[tuple[Computation, Node]]:
+        """Every collective node, paired with its computation (collectives
+        inside while/fusion bodies count — a scan-based pipeline keeps its
+        ppermutes in the loop body computation)."""
+        out = []
+        for comp in self.computations.values():
+            for node in comp.collectives():
+                out.append((comp, node))
+        return out
+
+    def count_by_kind(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for _, node in self.collectives():
+            out[node.kind] = out.get(node.kind, 0) + 1
+        return out
+
+    def summary(self) -> dict:
+        """Graph-shape census (what the golden fixtures pin)."""
+        return {
+            "computations": len(self.computations),
+            "nodes": sum(len(c.nodes) for c in self.computations.values()),
+            "entry_parameters": len(
+                self.entry_computation.parameters())
+            if self.entry_computation else 0,
+            "collectives_by_kind": dict(sorted(
+                self.count_by_kind().items())),
+        }
+
+
+def _parse_instruction(line: str, line_no: int,
+                       m: re.Match) -> Node:
+    is_root, name, result_txt, op = (bool(m.group(1)), m.group(2),
+                                     m.group(3), m.group(4))
+    open_paren = m.end() - 1
+    close = _span_paren(line, open_paren)
+    args_txt = line[open_paren + 1:close - 1]
+    attrs_txt = line[close:]
+    shapes = tuple((dt, tuple(int(d) for d in dims.split(",") if d))
+                   for dt, dims in _SHAPE_RE.findall(result_txt))
+    gm = _GROUPS_RE.search(attrs_txt)
+    im = _GROUPS_IOTA_RE.search(attrs_txt)
+    pm = _PAIRS_RE.search(attrs_txt)
+    cm = _CHANNEL_RE.search(attrs_txt)
+    return Node(
+        name=name,
+        op=op,
+        kind=_COLLECTIVE_OPS.get(op),
+        is_root=is_root,
+        is_async_start=op.endswith("-start"),
+        shapes=shapes,
+        operands=tuple(_OPERAND_RE.findall(args_txt)),
+        called=tuple(_CALLED_RE.findall(attrs_txt)),
+        replica_groups=_parse_groups(gm.group(1)) if gm else None,
+        iota_groups=(int(im.group(1)), int(im.group(2))) if im else None,
+        source_target_pairs=_parse_groups(pm.group(1)) if pm else None,
+        channel_id=int(cm.group(1)) if cm else None,
+        sharded=bool(_SHARDING_RE.search(attrs_txt)),
+        line_no=line_no,
+        line=line.strip()[:200],
+    )
+
+
+def parse_graph(txt: str) -> CollectiveGraph:
+    """Parse optimized-HLO module text into a :class:`CollectiveGraph`."""
+    graph = CollectiveGraph()
+    current: Computation | None = None
+    for line_no, raw in enumerate(txt.splitlines(), start=1):
+        stripped = raw.strip()
+        if current is None:
+            cm = _COMP_RE.match(stripped)
+            if cm:
+                current = Computation(name=cm.group(2),
+                                      is_entry=bool(cm.group(1)))
+            continue
+        if stripped == "}":
+            graph.computations[current.name] = current
+            if current.is_entry:
+                graph.entry = current.name
+            current = None
+            continue
+        im = _INSTR_RE.match(raw)
+        if im:
+            node = _parse_instruction(raw, line_no, im)
+            current.nodes[node.name] = node
+            if node.is_root:
+                current.root = node.name
+    # a torn tail (no closing brace) still lands in the graph
+    if current is not None:
+        graph.computations[current.name] = current
+        if current.is_entry:
+            graph.entry = current.name
+    return graph
+
+
+def graph_of_compiled(compiled) -> CollectiveGraph:
+    """Graph of an AOT-compiled executable (``jit(f).lower(...).compile()``)."""
+    return parse_graph(compiled.as_text())

@@ -45,11 +45,16 @@ _DTYPE_BYTES = {
 
 _DTYPE_RE = "|".join(sorted(_DTYPE_BYTES, key=len, reverse=True))
 
-# `%name = (types) all-reduce(-start)?(operands), ...` — group(1) is the
-# result-type text, group(3) the optional async suffix.  `-done` ops fail
-# the `\(` right after the optional suffix and are skipped by design.
+# `[ROOT] %name = (types) all-reduce(-start)?(operands), ...` — group(1)
+# is the result-type text, group(3) the optional async suffix.  `-done`
+# ops fail the `\(` right after the optional suffix and are skipped by
+# design.  The ROOT prefix matters when a collective IS a computation's
+# root (rare in full step programs, where the root is the result tuple,
+# but routine in reduced/seeded modules) — the shardflow census
+# cross-check caught this census blind spot.
 _HLO_RE = re.compile(
-    r"%?[\w.-]+ = (.*?) (" + "|".join(COLLECTIVE_KINDS) + r")(-start)?\(")
+    r"(?:ROOT )?%?[\w.-]+ = (.*?) ("
+    + "|".join(COLLECTIVE_KINDS) + r")(-start)?\(")
 
 # StableHLO / MHLO: `stablehlo.all_reduce`, `"stablehlo.all_gather"` ...
 # result type parsed from the trailing `-> tensor<...>` (or the tensor

@@ -1,0 +1,572 @@
+"""Static detectors over the collective-flow graph + derived budgets.
+
+``hlo_audit`` polices *volume* (bytes per collective class against the
+declared ceilings).  This module polices *structure*, on the typed graph
+:mod:`tpuframe.analysis.collective_graph` builds from the same optimized
+HLO:
+
+  (a) :func:`detect_redundant_pairs` — an all-gather feeding a
+      reduce-scatter of the same value over the same groups (the pair is
+      a resharding no-op GSPMD should have cancelled), and duplicate
+      all-reduces on one def (same operands, groups, and reduce fn —
+      the sharding-annotation mistake that syncs a gradient twice).
+  (b) :func:`detect_wire_dtype` — a floating collective wider than the
+      strategy's declared wire dtype (an f32 gradient on a wire the
+      strategy declares bf16 silently doubles every budget).  Quantized
+      wire formats register through :func:`register_wire_format` — the
+      allowlist seam the EQuARX-style compressed collectives (ROADMAP
+      item 2, arXiv:2506.17615) will occupy, so the quantization wire
+      contract is declared here once instead of per-detector.
+  (c) :func:`detect_replication` — a tensor the strategy declares
+      sharded showing up among the entry parameters at its full
+      (replicated) shape above a size floor: the accidental-replication
+      failure GSPMD commits silently when one in_sharding is missing.
+  (d) :func:`detect_replica_groups` — structural validity of every
+      collective's replica groups against the strategy's declared mesh
+      (equal sizes, disjoint, complete cover, group size a product of
+      declared mesh axes) — the consistency check hierarchical
+      ICI×DCN meshes (ROADMAP item 3, arXiv:2011.03641) will need
+      per-slice.
+
+From the same program the *exact* per-kind communication budget is
+derived (:func:`derive_budget`, measured by ``hlo_audit``'s wire-traffic
+ruler so derivation and ceiling audits never disagree) and diffed
+against the checked-in declarations in ``derived_budgets.json`` —
+drift in either direction fails the gate, and ``python -m
+tpuframe.analysis --emit-budgets`` regenerates the file from one source
+of truth.  ``budgets.py``'s hand-declared class ceilings stay as policy
+(which *kinds* may exist at what order of magnitude); the derived file
+is the byte-exact record of what the compiler actually emits today.
+
+Stdlib-only at import time (the ``hlo_audit`` contract); jax is touched
+only inside the gate entry points that already run under the analysis
+CLI's scrubbed child process.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+from collections import Counter
+
+from tpuframe.analysis import collective_graph as cg
+from tpuframe.analysis import hlo_audit
+
+#: schema version of both the --json report and derived_budgets.json.
+REPORT_SCHEMA = 1
+
+DERIVED_BUDGETS_PATH = os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "derived_budgets.json")
+
+#: floating wire dtypes by width; integer/pred collectives are index
+#: bookkeeping and never wire-dtype findings.
+_FLOAT_WIDTHS = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2}
+
+#: size floor for the replication detector — below this a replicated
+#: tensor is a scalar/norm/metric, not the HBM-capacity failure class.
+REPLICATION_FLOOR = 4096
+
+# ---------------------------------------------------------------------------
+# The quantized-wire allowlist seam (ROADMAP item 2's registration point).
+# ---------------------------------------------------------------------------
+
+_WIRE_FORMATS: dict[str, frozenset] = {}
+
+
+def register_wire_format(name: str, dtypes) -> None:
+    """Declare a compressed/quantized wire format: collectives carrying
+    only ``dtypes`` are then exempt from the wire-dtype audit regardless
+    of the strategy's declared dtype (EQuARX-style int8/bf16 blocks ride
+    under the name they registered, not under a silent exemption)."""
+    _WIRE_FORMATS[name] = frozenset(dtypes)
+
+
+def registered_wire_formats() -> dict[str, frozenset]:
+    return dict(_WIRE_FORMATS)
+
+
+def _wire_exempt(dtypes: frozenset) -> str | None:
+    """Name of the registered wire format covering ``dtypes``, if any."""
+    for name, allowed in _WIRE_FORMATS.items():
+        if dtypes <= allowed:
+            return name
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Detectors.  Each takes the graph (plus strategy facts) and returns
+# finding strings; empty list == clean.
+# ---------------------------------------------------------------------------
+
+
+def _groups_key(node: cg.Node):
+    if node.replica_groups is not None:
+        return tuple(tuple(g) for g in node.replica_groups)
+    return node.iota_groups
+
+
+def detect_redundant_pairs(graph: cg.CollectiveGraph) -> list[str]:
+    """(a) all-gather → reduce-scatter of one value over one group set,
+    and duplicate all-reduces on one def."""
+    findings: list[str] = []
+    for comp in graph.computations.values():
+        for node in comp.collectives():
+            if node.kind != "reduce-scatter":
+                continue
+            for operand in node.operands:
+                src_name = comp.resolve_value(operand)
+                src = comp.nodes.get(src_name)
+                if (src is not None and src.kind == "all-gather"
+                        and _groups_key(src) == _groups_key(node)):
+                    findings.append(
+                        f"redundant pair in %{comp.name}: "
+                        f"reduce-scatter %{node.name} consumes all-gather "
+                        f"%{src.name} over the same replica groups — the "
+                        f"gather/scatter round-trip is a no-op resharding "
+                        f"({node.line})")
+        by_def: dict[tuple, list[cg.Node]] = {}
+        for node in comp.collectives():
+            if node.kind != "all-reduce":
+                continue
+            roots = tuple(comp.resolve_value(o) for o in node.operands)
+            reduce_fn = _reduce_fn(graph, node)
+            by_def.setdefault((roots, _groups_key(node), reduce_fn),
+                              []).append(node)
+        for (roots, _, fn), nodes in sorted(by_def.items()):
+            if len(nodes) > 1:
+                names = ", ".join(f"%{n.name}" for n in nodes)
+                findings.append(
+                    f"duplicate all-reduce in %{comp.name}: {names} all "
+                    f"{fn}-reduce the same def(s) "
+                    f"{', '.join('%' + r for r in roots)} over the same "
+                    f"groups — one collective's result should be reused")
+    return findings
+
+
+def _reduce_fn(graph: cg.CollectiveGraph, node: cg.Node) -> str:
+    """Root opcode of the collective's to_apply computation ('add',
+    'maximum', ...) — the semantic reduce fn, stable across the
+    compiler's region-name suffixes."""
+    for called in node.called:
+        comp = graph.computations.get(called)
+        if comp is not None and comp.root and comp.root in comp.nodes:
+            return comp.nodes[comp.root].op
+    return "?"
+
+
+def detect_wire_dtype(graph: cg.CollectiveGraph, wire_dtype: str,
+                      *, ignore_below: int = 0) -> list[str]:
+    """(b) collectives carrying a float dtype wider than declared."""
+    declared_w = _FLOAT_WIDTHS.get(wire_dtype)
+    if declared_w is None:
+        return [f"unknown declared wire dtype {wire_dtype!r} "
+                f"(expected one of {sorted(_FLOAT_WIDTHS)})"]
+    findings: list[str] = []
+    for comp, node in graph.collectives():
+        if node.result_bytes < ignore_below:
+            continue
+        wide = sorted(dt for dt in node.dtypes
+                      if _FLOAT_WIDTHS.get(dt, 0) > declared_w)
+        if not wide:
+            continue
+        fmt = _wire_exempt(node.dtypes)
+        if fmt is not None:
+            continue  # registered quantized wire format
+        findings.append(
+            f"wire dtype in %{comp.name}: {node.kind} %{node.name} "
+            f"carries {'/'.join(wide)} where the strategy declares "
+            f"{wire_dtype} on the wire ({node.line})")
+    return findings
+
+
+def detect_replication(graph: cg.CollectiveGraph, declared_leaves,
+                       *, floor: int = REPLICATION_FLOOR) -> list[str]:
+    """(c) declared-sharded tensors appearing replicated at entry.
+
+    ``declared_leaves``: iterable of ``(dtype, full_dims, shard_dims)``
+    for every state leaf the strategy declares a sharding for (HLO dtype
+    spelling, dim tuples).  A leaf whose per-device shape should differ
+    from its full shape must NOT appear among the entry parameters at
+    the full shape more often than other leaves legitimately land there.
+    """
+    entry = graph.entry_computation
+    if entry is None or not declared_leaves:
+        return []
+    expected: Counter = Counter()
+    for dt, _full, shard in declared_leaves:
+        expected[(dt, tuple(shard))] += 1
+    actual: Counter = Counter()
+    for node in entry.parameters():
+        if node.shapes:
+            dt, dims = node.shapes[0]
+            actual[(dt, tuple(dims))] += 1
+    findings: list[str] = []
+    flagged: set = set()
+    for dt, full, shard in sorted(declared_leaves):
+        full, shard = tuple(full), tuple(shard)
+        if full == shard or (dt, full) in flagged:
+            continue
+        n = 1
+        for d in full:
+            n *= d
+        if n * hlo_audit._DTYPE_BYTES.get(dt, 4) < floor:
+            continue
+        if actual.get((dt, full), 0) > expected.get((dt, full), 0):
+            flagged.add((dt, full))
+            findings.append(
+                f"accidental replication: a {dt}[{','.join(map(str, full))}] "
+                f"entry parameter sits at the FULL shape of a leaf this "
+                f"strategy declares sharded to "
+                f"[{','.join(map(str, shard))}] — one in_sharding is "
+                f"missing or GSPMD dropped it")
+    return findings
+
+
+def detect_replica_groups(graph: cg.CollectiveGraph,
+                          mesh_shape: dict) -> list[str]:
+    """(d) structural validity of replica groups against the mesh."""
+    if not mesh_shape:
+        return []  # no declared mesh — nothing to check against
+    sizes = [int(s) for s in mesh_shape.values()]
+    n_devices = 1
+    for s in sizes:
+        n_devices *= s
+    valid_sizes = set()
+    for r in range(len(sizes) + 1):
+        for combo in itertools.combinations(sizes, r):
+            p = 1
+            for s in combo:
+                p *= s
+            valid_sizes.add(p)
+    findings: list[str] = []
+    for comp, node in graph.collectives():
+        where = f"{node.kind} %{node.name} in %{comp.name}"
+        if node.kind == "collective-permute":
+            pairs = node.source_target_pairs or ()
+            srcs = [p[0] for p in pairs]
+            dsts = [p[1] for p in pairs]
+            if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+                findings.append(
+                    f"replica groups: {where} has a duplicate "
+                    f"source or target in source_target_pairs={pairs}")
+            if any(d >= n_devices for p in pairs for d in p):
+                findings.append(
+                    f"replica groups: {where} names a device outside the "
+                    f"declared {n_devices}-device mesh {mesh_shape}")
+            continue
+        if node.iota_groups is not None:
+            count, size = node.iota_groups
+            if count * size != n_devices:
+                findings.append(
+                    f"replica groups: {where} iota groups "
+                    f"[{count},{size}] do not cover the declared "
+                    f"{n_devices}-device mesh {mesh_shape}")
+            elif size not in valid_sizes:
+                findings.append(
+                    f"replica groups: {where} group size {size} is not a "
+                    f"product of declared mesh axes {mesh_shape}")
+            continue
+        groups = node.replica_groups
+        if not groups:
+            continue  # absent/empty groups = all devices, always valid
+        flat = [d for g in groups for d in g]
+        if len({len(g) for g in groups}) != 1:
+            findings.append(
+                f"replica groups: {where} has unequal group sizes "
+                f"{[len(g) for g in groups]}")
+            continue
+        if len(set(flat)) != len(flat):
+            findings.append(
+                f"replica groups: {where} groups overlap (a device "
+                f"appears twice): {groups}")
+            continue
+        if set(flat) != set(range(n_devices)):
+            findings.append(
+                f"replica groups: {where} groups cover {sorted(set(flat))}"
+                f", not the declared {n_devices}-device mesh {mesh_shape}")
+            continue
+        if len(groups[0]) not in valid_sizes:
+            findings.append(
+                f"replica groups: {where} group size {len(groups[0])} is "
+                f"not a product of declared mesh axes {mesh_shape} — the "
+                f"collective spans a device set no mesh axis explains")
+    return findings
+
+
+def census_cross_check(graph: cg.CollectiveGraph,
+                       report: hlo_audit.CollectiveReport) -> list[str]:
+    """The two parsers must agree on the collective count per kind —
+    a graph-parser regression must not silently blind the detectors."""
+    g, r = graph.count_by_kind(), report.count_by_kind()
+    if g == r:
+        return []
+    return [f"parser census mismatch: graph sees {g} but hlo_audit sees "
+            f"{r} — collective_graph and hlo_audit disagree on what the "
+            f"program contains"]
+
+
+# ---------------------------------------------------------------------------
+# Derived budgets: the exact per-kind record, emitted and drift-checked.
+# ---------------------------------------------------------------------------
+
+
+def derive_budget(report: hlo_audit.CollectiveReport,
+                  ignore_below: int) -> dict:
+    """Exact per-kind {bytes, count} of a program, measured by the same
+    wire-traffic ruler as the ceiling audits (``hlo_audit``).
+
+    ``kinds`` is the FULL census (no floor) — the drift gate pins every
+    collective the compiler emits, not just the budget-relevant slice.
+    ``above_floor`` is the slice the hand-declared ceiling actually
+    polices (filtered at the budget's ``ignore_below``)."""
+    counts = report.count_by_kind()
+    above = report.filter(ignore_below)
+    return {
+        "ignore_below": int(ignore_below),
+        "kinds": {k: {"bytes": int(b), "count": int(counts[k])}
+                  for k, b in sorted(report.bytes_by_kind().items())},
+        "above_floor": {k: int(b)
+                        for k, b in sorted(above.bytes_by_kind().items())},
+        "total_bytes": int(report.total_bytes),
+    }
+
+
+def load_derived(path: str = DERIVED_BUDGETS_PATH) -> dict | None:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict) or "strategies" not in data:
+        return None
+    return data
+
+
+def emit_derived(audits, *, n_devices: int, path: str =
+                 DERIVED_BUDGETS_PATH) -> dict:
+    """Regenerate ``derived_budgets.json`` from fresh audits — the
+    one-source-of-truth half of the drift contract."""
+    data = {
+        "schema": REPORT_SCHEMA,
+        "jax": _jax_version(),
+        "n_devices": int(n_devices),
+        "strategies": {
+            a.name: derive_budget(a.report, a.budget.ignore_below)
+            for a in audits
+            if a.status in ("ok", "violation") and a.report is not None
+        },
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+def budget_drift(audit, derived_file: dict | None) -> list[str]:
+    """Diff a fresh derivation against the checked-in declaration.
+    Either direction of drift is a finding; a strategy this jax can
+    compile that has no declaration is one too."""
+    if derived_file is None:
+        return ["derived_budgets.json missing/unreadable — run "
+                "`python -m tpuframe.analysis --emit-budgets`"]
+    if derived_file.get("jax") != _jax_version():
+        # Another jax emits different programs; the drift contract is
+        # pinned to the version that emitted the file.  Not a finding —
+        # the strategy audits still police the class ceilings here.
+        return []
+    declared = derived_file.get("strategies", {}).get(audit.name)
+    if declared is None:
+        return [f"[{audit.name}] compiles here but has no entry in "
+                f"derived_budgets.json — run `python -m tpuframe.analysis "
+                f"--emit-budgets` to declare its derived budget"]
+    fresh = derive_budget(audit.report, audit.budget.ignore_below)
+    problems = []
+    for kind in sorted(set(fresh["kinds"]) | set(declared["kinds"])):
+        f_e, d_e = fresh["kinds"].get(kind), declared["kinds"].get(kind)
+        if f_e == d_e:
+            continue
+        problems.append(
+            f"[{audit.name}] derived-budget drift on {kind}: compiled "
+            f"program has {f_e or 'nothing'} but derived_budgets.json "
+            f"declares {d_e or 'nothing'} — fix the regression or "
+            f"re-emit with --emit-budgets")
+    return problems
+
+
+def derived_for(name: str, *, path: str = DERIVED_BUDGETS_PATH
+                ) -> dict | None:
+    """Checked-in derived budget for one strategy (tests assert against
+    this instead of hand-copying byte constants)."""
+    data = load_derived(path)
+    if data is None:
+        return None
+    return data.get("strategies", {}).get(name)
+
+
+# ---------------------------------------------------------------------------
+# Per-audit flow check + the gate entry point.
+# ---------------------------------------------------------------------------
+
+
+def audit_flow(audit, *, derived_file: dict | None = None,
+               graph: cg.CollectiveGraph | None = None) -> dict:
+    """All structural detectors over one strategy audit.  Returns the
+    per-strategy report fragment; ``problems`` is the flattened finding
+    list the gate counts."""
+    if graph is None:
+        graph = cg.parse_graph(audit.compiled.as_text())
+    meta = getattr(audit, "meta", None)
+    detectors = {
+        "redundant_pair": detect_redundant_pairs(graph),
+        "wire_dtype": detect_wire_dtype(
+            graph, meta.wire_dtype if meta else "f32",
+            ignore_below=audit.budget.ignore_below),
+        "replication": detect_replication(
+            graph, meta.declared_leaves if meta else ()),
+        "replica_groups": detect_replica_groups(
+            graph, meta.mesh_dict if meta else {}),
+        "census": census_cross_check(graph, audit.report),
+    }
+    drift = budget_drift(audit, derived_file)
+    problems = [f"[{audit.name}] {f}"
+                for fs in detectors.values() for f in fs] + drift
+    return {
+        "graph": graph.summary(),
+        "detectors": detectors,
+        "derived": derive_budget(audit.report, audit.budget.ignore_below),
+        "drift": drift,
+        "problems": problems,
+    }
+
+
+def check(audits=None, *, n_devices: int = 8,
+          derived_path: str = DERIVED_BUDGETS_PATH) -> list[str]:
+    """Gate entry point: structural detectors + derived-budget drift for
+    every strategy this environment can compile.  ``audits`` reuses the
+    CLI's already-compiled audit objects (one compile pays for both the
+    ceiling audit and the flow check)."""
+    if audits is None:
+        from tpuframe.analysis import strategies
+
+        audits = strategies.audit_all(n_devices)
+    derived_file = load_derived(derived_path)
+    problems: list[str] = []
+    for audit in audits:
+        if audit.status == "unavailable" or audit.compiled is None:
+            continue
+        problems.extend(audit_flow(audit, derived_file=derived_file)
+                        ["problems"])
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# The --json report + obs-compare-style structural diffing.
+# ---------------------------------------------------------------------------
+
+
+def build_report(audits, *, lint_findings=(), n_devices: int = 8,
+                 derived_path: str = DERIVED_BUDGETS_PATH) -> dict:
+    """Machine-readable gate report (schema pinned by tests — a future
+    commit diffs two of these the way ``obs compare`` diffs step times)."""
+    derived_file = load_derived(derived_path)
+    strategies_out = []
+    for audit in audits:
+        entry = {
+            "name": audit.name,
+            "status": audit.status,
+            "reason": audit.reason,
+            "violations": list(audit.violations),
+        }
+        if audit.status != "unavailable" and audit.report is not None:
+            flow = audit_flow(audit, derived_file=derived_file)
+            entry.update({
+                "collectives": flow["derived"]["kinds"],
+                "total_bytes": flow["derived"]["total_bytes"],
+                "derived": flow["derived"],
+                "drift": flow["drift"],
+                "detectors": {k: list(v)
+                              for k, v in flow["detectors"].items()},
+                "graph": flow["graph"],
+            })
+        strategies_out.append(entry)
+    return {
+        "schema": REPORT_SCHEMA,
+        "jax": _jax_version(),
+        "n_devices": int(n_devices),
+        "lint": [{"rule": f.rule, "path": f.path, "line": f.line,
+                  "message": f.message} for f in lint_findings],
+        "strategies": strategies_out,
+    }
+
+
+def compare_reports(a: dict, b: dict, *,
+                    bytes_tol: float = 0.10) -> tuple[int, list[str]]:
+    """Structural diff of two --json reports (A = baseline, B =
+    candidate).  rc 1 on a structural regression, 0 clean, 2 when no
+    strategy overlaps — the ``obs compare`` return-code contract.
+
+    Regression = a collective kind appears/disappears, a per-kind op
+    count changes, per-kind bytes move more than ``bytes_tol``
+    (relative), or a detector that was clean now finds something.
+    """
+    lines: list[str] = []
+    a_s = {s["name"]: s for s in a.get("strategies", [])
+           if s.get("status") in ("ok", "violation") and "derived" in s}
+    b_s = {s["name"]: s for s in b.get("strategies", [])
+           if s.get("status") in ("ok", "violation") and "derived" in s}
+    common = sorted(set(a_s) & set(b_s))
+    if not common:
+        return 2, ["no strategy audited in both reports — nothing to "
+                   "compare"]
+    regression = False
+    for name in common:
+        ka = a_s[name]["derived"]["kinds"]
+        kb = b_s[name]["derived"]["kinds"]
+        for kind in sorted(set(ka) | set(kb)):
+            ea, eb = ka.get(kind), kb.get(kind)
+            if ea is None:
+                regression = True
+                lines.append(f"REGRESSION {name}: new collective kind "
+                             f"{kind} ({eb})")
+                continue
+            if eb is None:
+                regression = True
+                lines.append(f"REGRESSION {name}: collective kind {kind} "
+                             f"disappeared (was {ea})")
+                continue
+            if ea["count"] != eb["count"]:
+                regression = True
+                lines.append(
+                    f"REGRESSION {name}: {kind} op count "
+                    f"{ea['count']} -> {eb['count']}")
+            elif ea["bytes"] and (abs(eb["bytes"] - ea["bytes"])
+                                  / ea["bytes"]) > bytes_tol:
+                regression = True
+                lines.append(
+                    f"REGRESSION {name}: {kind} bytes "
+                    f"{ea['bytes']} -> {eb['bytes']} "
+                    f"({(eb['bytes'] - ea['bytes']) / ea['bytes']:+.1%} "
+                    f"> ±{bytes_tol:.0%})")
+        da = a_s[name].get("detectors", {})
+        db = b_s[name].get("detectors", {})
+        for det in sorted(set(da) | set(db)):
+            na, nb = len(da.get(det, [])), len(db.get(det, []))
+            if nb > na:
+                regression = True
+                lines.append(f"REGRESSION {name}: detector {det} findings "
+                             f"{na} -> {nb}")
+        if not any(ln.startswith(f"REGRESSION {name}:") for ln in lines):
+            lines.append(f"ok {name}: collective structure unchanged")
+    return (1 if regression else 0), lines
+
+
+def _jax_version() -> str:
+    try:
+        import jax
+
+        return jax.__version__
+    except Exception:  # noqa: BLE001 — report stays buildable without jax
+        return "unknown"

@@ -87,6 +87,21 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          ``import jax``.  Per-compile ``compiler_options``
          (``TPUFRAME_XLA_OPTS`` / tpuframe.tune) is the safe carrier —
          it travels inside the compile request.
+  TF114  lock discipline in the background-thread modules — inside the
+         TF111-sanctioned modules that actually run worker threads
+         (``ckpt/``, ``obs/exporter.py``, ``obs/flight.py``,
+         ``data/pipeline.py``), shared state guarded by a lock must
+         only be mutated under ``with <lock>:``.  The rule is opt-in
+         by construction: a class that owns a ``threading.Lock``/
+         ``RLock``/``Condition`` attribute (or a module that owns a
+         module-level one) has declared its state shared, so every
+         unlocked mutation of instance attributes (or lock-guarded
+         module globals) is a statically visible race — the hammer
+         PR 9 applied to the obs counters, made a checked invariant.
+         Constructor bodies (``__init__``/``__post_init__``/
+         ``__new__``) are happens-before publication and exempt;
+         call-site-serialized lifecycle mutations suppress with
+         ``# tf-lint: ok[TF114]`` and a reason.
 
 Scope: TF101/TF102 only fire *inside functions known to be traced*
 (decorated with ``jax.jit``/``pmap``/``shard_map`` or passed to
@@ -98,6 +113,14 @@ Suppression: append ``# tf-lint: ok[TF103]`` (or bare ``# tf-lint: ok``
 for all rules) to the offending line or to the enclosing ``def`` line,
 with a reason in a neighbouring comment.  Suppressions are grep-able
 policy, the same contract as the VMEM known-exclusion registry.
+
+Structure: the shared scaffolding — suppression-comment parsing,
+path-scope flags, the traced-function walk, finding emission — lives in
+:class:`FileContext` plus three registries (``_NODE_RULES`` run on every
+non-def node with the enclosing function's traced-ness, ``_FN_RULES``
+once per function, ``_FILE_RULES`` once per file).  A new rule is one
+registered function reading ``ctx``/``node``/``fn`` — it never copies
+the walk or the suppression plumbing (TF114 below is the template).
 """
 
 from __future__ import annotations
@@ -130,6 +153,9 @@ RULES = {
              "obs/events.py's REQUIRED_FIELDS schema contract",
     "TF113": "http.server used outside the sanctioned telemetry endpoint "
              "(obs/exporter.py)",
+    "TF114": "lock-guarded shared state mutated outside `with <lock>:` in "
+             "a background-thread module (ckpt/, obs/exporter.py, "
+             "obs/flight.py, data/pipeline.py)",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -195,6 +221,22 @@ _EMIT_RECEIVERS = {"events", "events_lib", "obs_events"}
 # sockets with no OpenMetrics contract, invisible to the exporter's
 # health/port knobs.
 _HTTP_EXEMPT_SUFFIX = "obs/exporter.py"
+
+# TF114: the modules whose threads actually share mutable host state —
+# the subset of the TF111-sanctioned list with a writer thread (ckpt's
+# async save worker, the exporter's HTTP server thread, the flight
+# recorder's dump-on-crash path, the pipeline's prefetch producer).
+_LOCK_DISCIPLINE_PARTS = ("ckpt/", "obs/exporter.py", "obs/flight.py",
+                          "data/pipeline.py")
+
+# TF114: lock-type constructors whose assignment declares shared state,
+# and container methods that mutate their receiver in place.
+_LOCK_CTOR_TAILS = {"Lock", "RLock", "Condition"}
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "remove", "pop", "clear", "update",
+    "add", "discard", "popitem", "setdefault", "appendleft", "popleft",
+}
+_CTOR_METHODS = {"__init__", "__post_init__", "__new__"}
 
 # TF105a: google.cloud.storage blob/bucket methods — allowed only inside
 # the retry-wrapped data/gcs.py layer.
@@ -352,6 +394,552 @@ def _probes_backend(fn_node) -> bool:
     return False
 
 
+def _iter_local(node):
+    """Child nodes of ``node`` excluding nested function subtrees (each
+    nested def is checked in its own visit with its own traced-ness)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield child
+        yield from _iter_local(child)
+
+
+def _nested_defs(node):
+    out = []
+
+    def rec(n):
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(child)
+            else:
+                rec(child)
+
+    rec(node)
+    return out
+
+
+class FileContext:
+    """Everything one lint pass shares across rules: the parsed tree,
+    the raw lines (suppression comments live there), the path-derived
+    scope flags, and the emit/suppression plumbing.  Rules receive this
+    instead of re-deriving any of it."""
+
+    def __init__(self, tree: ast.Module, src: str, path: str):
+        self.tree = tree
+        self.lines = src.splitlines()
+        self.path = path
+        norm = path.replace("\\", "/")
+        self.norm_path = norm
+        self.findings: list[LintFinding] = []
+        self.jitted = _jitted_names(tree)
+        self.hot_path = norm.endswith(_HOT_PATH_SUFFIXES)
+        self.remat_scope = (any(p in norm for p in _REMAT_SCOPE_PARTS)
+                            and not any(p in norm
+                                        for p in _REMAT_EXEMPT_PARTS))
+        self.serve_scope = (_SERVE_SCOPE_PART in norm
+                            and not norm.endswith(_SERVE_EXEMPT_SUFFIX))
+        self.wu_scope = ((_WU_SCOPE_PART in norm
+                          or norm.endswith(_WU_SCOPE_SUFFIX))
+                         and not norm.endswith(_WU_EXEMPT_SUFFIXES))
+        self.thread_scope = not any(p in norm
+                                    for p in _THREAD_SANCTIONED_PARTS)
+        self.http_scope = not norm.endswith(_HTTP_EXEMPT_SUFFIX)
+        self.lock_scope = any(p in norm for p in _LOCK_DISCIPLINE_PARTS)
+        # TF106: a module-level compiler-env write is safe only BEFORE
+        # the module-level jax import (the conftest/bootstrap pattern).
+        self.jax_import_line = None
+        for top in tree.body:
+            if isinstance(top, ast.Import) and any(
+                    a.name == "jax" or a.name.startswith("jax.")
+                    for a in top.names):
+                self.jax_import_line = top.lineno
+                break
+            if isinstance(top, ast.ImportFrom) and top.module and (
+                    top.module == "jax"
+                    or top.module.startswith("jax.")):
+                self.jax_import_line = top.lineno
+                break
+
+    def suppressed(self, rule: str, *linenos: int) -> bool:
+        for ln in linenos:
+            if not (1 <= ln <= len(self.lines)):
+                continue
+            m = _SUPPRESS_RE.search(self.lines[ln - 1])
+            if m and (m.group(1) is None
+                      or rule in re.split(r"[,\s]+", m.group(1))):
+                return True
+        return False
+
+    def emit(self, rule: str, node: ast.AST, msg: str,
+             fn: _FnInfo | None = None) -> None:
+        def_line = fn.node.lineno if fn is not None else node.lineno
+        if not self.suppressed(rule, node.lineno, def_line):
+            self.findings.append(
+                LintFinding(rule, self.path, node.lineno, msg))
+
+
+# ---------------------------------------------------------------------------
+# Rule registries.  _NODE_RULES run on every non-def node (module level
+# with fn=None, then once per enclosing function with its _FnInfo);
+# _FN_RULES once per function def; _FILE_RULES once per file, last.
+# Registration order is emission order — tests pin it.
+# ---------------------------------------------------------------------------
+
+_NODE_RULES: list = []
+_FN_RULES: list = []
+_FILE_RULES: list = []
+
+
+def _node_rule(fn):
+    _NODE_RULES.append(fn)
+    return fn
+
+
+def _fn_rule(fn):
+    _FN_RULES.append(fn)
+    return fn
+
+
+def _file_rule(fn):
+    _FILE_RULES.append(fn)
+    return fn
+
+
+@_node_rule
+def _tf113_http_server(ctx: FileContext, node, fn):
+    if not ctx.http_scope:
+        return
+    if isinstance(node, (ast.Import, ast.ImportFrom)):
+        modules = ([a.name for a in node.names]
+                   if isinstance(node, ast.Import)
+                   else [node.module or ""])
+        if any(m == "http.server" or m.startswith("http.server.")
+               for m in modules):
+            ctx.emit("TF113", node,
+                     "http.server imported outside obs/exporter.py — the "
+                     "exporter is the one sanctioned HTTP endpoint "
+                     "(OpenMetrics contract, health probe, port knobs); "
+                     "register gauges/collectors on it instead of "
+                     "standing up another server", fn)
+    if (isinstance(node, ast.Attribute)
+            and _dotted(node) == "http.server"):
+        ctx.emit("TF113", node,
+                 "http.server used outside obs/exporter.py — route the "
+                 "endpoint through the telemetry exporter", fn)
+
+
+def _tf106_emit(ctx: FileContext, node, key, fn):
+    if fn is not None:
+        if fn.probes_backend:
+            return  # checked backend init / re-execs — tuning.apply
+    elif (ctx.jax_import_line is None
+          or node.lineno < ctx.jax_import_line):
+        return  # module-level write before the jax import: safe
+    ctx.emit("TF106", node,
+             f"os.environ[{key!r}] written where the jax backend may "
+             f"already be initialized — the backend snapshots compiler "
+             f"env at init and later writes are silently dead; pass "
+             f"per-compile compiler_options (TPUFRAME_XLA_OPTS / "
+             f"tpuframe.tune) or probe xla_bridge._backends first", fn)
+
+
+@_node_rule
+def _tf106_compiler_env(ctx: FileContext, node, fn):
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if (isinstance(t, ast.Subscript)
+                    and _dotted(t.value) == "os.environ"
+                    and isinstance(t.slice, ast.Constant)
+                    and t.slice.value in _COMPILER_ENV_KEYS):
+                _tf106_emit(ctx, node, t.slice.value, fn)
+    if isinstance(node, ast.Call):
+        callee = _dotted(node.func)
+        if (callee in ("os.environ.setdefault", "os.putenv")
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value in _COMPILER_ENV_KEYS):
+            _tf106_emit(ctx, node, node.args[0].value, fn)
+        elif callee == "os.environ.update":
+            keys = [kw.arg for kw in node.keywords
+                    if kw.arg in _COMPILER_ENV_KEYS]
+            for a in node.args:
+                if isinstance(a, ast.Dict):
+                    keys += [k.value for k in a.keys
+                             if isinstance(k, ast.Constant)
+                             and k.value in _COMPILER_ENV_KEYS]
+            for key in keys:
+                _tf106_emit(ctx, node, key, fn)
+
+
+@_node_rule
+def _tf_call_rules(ctx: FileContext, node, fn):
+    """The per-call rules (TF101/104/105a/107/108/109/110/111/112), in
+    the historical emission order for any single call node."""
+    if not isinstance(node, ast.Call):
+        return
+    traced = fn is not None and fn.traced
+    callee = _dotted(node.func)
+    tail = callee.rsplit(".", 1)[-1]
+    if traced:
+        if (tail in _HOST_CONVERTERS and callee == tail
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)):
+            ctx.emit("TF101", node,
+                     f"{tail}() on a possibly-traced value inside "
+                     f"traced code — concretizes at trace time", fn)
+        elif (callee.startswith(("np.", "numpy.", "onp."))
+              and tail in _NP_CONVERTERS):
+            ctx.emit("TF101", node,
+                     f"{callee}() pulls a traced value to host — "
+                     f"use jnp inside traced code", fn)
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _METHOD_CONVERTERS
+              and not callee.startswith(("np.", "numpy."))):
+            ctx.emit("TF101", node,
+                     f".{node.func.attr}() on a possibly-traced "
+                     f"value inside traced code", fn)
+    if tail == "pallas_call" and not any(
+            kw.arg == "interpret" for kw in node.keywords):
+        ctx.emit("TF104", node,
+                 "pallas_call without interpret= — decide "
+                 "Mosaic-vs-interpret explicitly (_auto_interpret())",
+                 fn)
+    if ctx.serve_scope and (
+            tail in _SERVE_COMPILE_TAILS
+            or (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "apply")):
+        what = (f"{callee}()" if tail in _SERVE_COMPILE_TAILS
+                else f".apply()")
+        ctx.emit("TF109", node,
+                 f"{what} in the serving path above the compile seam "
+                 f"— every serving program must come from "
+                 f"serve/engine.py's bucketed AOT table (an "
+                 f"un-bucketed shape compiling mid-serving is a "
+                 f"multi-second stall)", fn)
+    if ctx.wu_scope and (
+            callee in ("optax.apply_updates", "apply_updates")
+            or (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "update"
+                and _dotted(node.func.value).rsplit(".", 1)[-1]
+                in _WU_OPTIMIZER_RECEIVERS
+                and len(node.args) >= 2)):
+        ctx.emit("TF110", node,
+                 f"{callee}() optimizer update outside the "
+                 f"weight-update seam — route it through "
+                 f"parallel/step.py's _reduce_and_apply (or "
+                 f"parallel/zero1.py's sharded_update) so "
+                 f"TPUFRAME_WEIGHT_UPDATE=zero1 still shards the "
+                 f"update and optimizer state", fn)
+    if (ctx.thread_scope
+            and callee in ("threading.Thread", "Thread")):
+        ctx.emit("TF111", node,
+                 f"{callee}() outside the sanctioned background-work "
+                 f"modules (ckpt/, data/pipeline.py, "
+                 f"obs/heartbeat.py, launch/) — a background thread "
+                 f"that issues collectives interleaves with the main "
+                 f"loop's compiled steps (the ordering hazard "
+                 f"ckpt/checkpoint.py documents); if the thread "
+                 f"provably never touches jax, suppress with "
+                 f"tf-lint: ok[TF111] and a reason", fn)
+    if (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "emit"
+            and _dotted(node.func.value).rsplit(".", 1)[-1]
+            in _EMIT_RECEIVERS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)):
+        registry = _event_type_registry()
+        if registry and node.args[0].value not in registry:
+            ctx.emit("TF112", node,
+                     f"events.emit({node.args[0].value!r}) — type not "
+                     f"registered in obs/events.py REQUIRED_FIELDS; "
+                     f"unregistered types fail schema validation at "
+                     f"read time (the selfcheck CI gate), so register "
+                     f"the type (with its required fields) first", fn)
+    if ctx.remat_scope and callee in _BARE_REMAT_CALLEES:
+        ctx.emit("TF108", node,
+                 f"{callee}() bare rematerialization in model/step "
+                 f"code bypasses the tpuframe.mem policy registry — "
+                 f"use mem.remat_module for modules, mem.wrap / the "
+                 f"step factories' remat_policy= for loss functions",
+                 fn)
+    if (isinstance(node.func, ast.Attribute)
+            and node.func.attr in _RAW_GCS_METHODS
+            and not ctx.norm_path.endswith("data/gcs.py")):
+        ctx.emit("TF105", node,
+                 f".{node.func.attr}() raw GCS client call outside "
+                 f"data/gcs.py — route it through the retry-wrapped "
+                 f"gcs layer (tpuframe.resilience)", fn)
+    if callee == "print":
+        if traced:
+            ctx.emit("TF107", node,
+                     "print() inside traced code runs at trace time "
+                     "only, not per step — use jax.debug.print, or "
+                     "emit from the host loop via tpuframe.obs", fn)
+        elif ctx.hot_path and fn is not None:
+            ctx.emit("TF107", node,
+                     "print() in per-step hot-path code bypasses the "
+                     "structured event log — use tpuframe.obs "
+                     "(events.emit / metrics.bump)", fn)
+    elif ctx.hot_path and fn is not None and callee in _CLOCK_CALLS:
+        ctx.emit("TF107", node,
+                 f"{callee}() hand-rolled step timing in a hot path "
+                 f"— the train loop's goodput meter owns step "
+                 f"timing; route measurements through tpuframe.obs",
+                 fn)
+
+
+def _tf105_unbounded_retry(ctx: FileContext, node: ast.While, fn):
+    """TF105b: ``while True`` + sleep with no comparison, raise, or
+    clock read in the loop's own body is a retry loop that can never
+    give up — it outlives deadlines, watchdogs and operators."""
+    sleeps = False
+    bounded = False
+    for child in node.body:
+        for sub in [child, *_iter_local(child)]:
+            if isinstance(sub, (ast.Compare, ast.Raise)):
+                bounded = True
+            elif isinstance(sub, ast.Call):
+                tail = _dotted(sub.func).rsplit(".", 1)[-1]
+                if tail == "sleep":
+                    sleeps = True
+                elif tail in ("time", "monotonic", "perf_counter"):
+                    bounded = True
+    if sleeps and not bounded:
+        ctx.emit("TF105", node,
+                 "unbounded `while True` retry loop: sleeps but never "
+                 "compares, raises, or reads a clock — use "
+                 "resilience.RetryPolicy (bounded attempts + deadline)",
+                 fn)
+
+
+@_node_rule
+def _tf102_control_flow(ctx: FileContext, node, fn):
+    traced = fn is not None and fn.traced
+    if isinstance(node, ast.While):
+        if (isinstance(node.test, ast.Constant)
+                and node.test.value is True):
+            _tf105_unbounded_retry(ctx, node, fn)
+        if traced and _test_touches_arrays(node.test):
+            ctx.emit("TF102", node,
+                     "Python branch on an array-valued test inside "
+                     "traced code — use lax.cond/jnp.where", fn)
+    elif traced and isinstance(node, (ast.If, ast.IfExp)):
+        if _test_touches_arrays(node.test):
+            ctx.emit("TF102", node,
+                     "Python branch on an array-valued test inside "
+                     "traced code — use lax.cond/jnp.where", fn)
+
+
+@_fn_rule
+def _tf103_timing(ctx: FileContext, fn: _FnInfo):
+    node = fn.node
+    timing_names: set[str] = set()
+    has_device_work = False
+    has_sync = False
+    durations = []
+
+    def is_timing_call(c):
+        return (isinstance(c, ast.Call)
+                and _dotted(c.func).rsplit(".", 1)[-1]
+                in ("time", "perf_counter", "monotonic"))
+
+    local = list(_iter_local(node))
+    for child in local:
+        if isinstance(child, ast.Assign) and is_timing_call(child.value):
+            for t in child.targets:
+                if isinstance(t, ast.Name):
+                    timing_names.add(t.id)
+        if isinstance(child, ast.Call):
+            callee = _dotted(child.func)
+            tail = callee.rsplit(".", 1)[-1]
+            if tail in _SYNC_MARKERS:
+                has_sync = True
+            elif _DEVICE_WORK_RE.search(tail):
+                has_device_work = True
+    for child in local:
+        if isinstance(child, ast.BinOp) and isinstance(
+                child.op, ast.Sub):
+            sides = (child.left, child.right)
+            if all(is_timing_call(s)
+                   or (isinstance(s, ast.Name)
+                       and s.id in timing_names)
+                   for s in sides) and (
+                    timing_names or any(map(is_timing_call, sides))):
+                durations.append(child)
+    if durations and has_device_work and not has_sync:
+        for d in durations:
+            ctx.emit("TF103", d,
+                     "duration measured around dispatched device work "
+                     "with no block_until_ready/sync in scope — this "
+                     "times dispatch, not execution", fn)
+
+
+# ---------------------------------------------------------------------------
+# TF114 — lock discipline (file rule: needs the class-level view).
+# ---------------------------------------------------------------------------
+
+
+def _is_lock_ctor(value) -> bool:
+    return (isinstance(value, ast.Call)
+            and _dotted(value.func).rsplit(".", 1)[-1] in _LOCK_CTOR_TAILS)
+
+
+def _assign_target_attrs(node):
+    """Flattened assignment-target list for Assign/AugAssign/Delete —
+    tuple targets (``a, self.b = ...``) included."""
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, ast.AugAssign):
+        targets = [node.target]
+    elif isinstance(node, ast.Delete):
+        targets = list(node.targets)
+    else:
+        return
+    while targets:
+        t = targets.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            targets.extend(t.elts)
+        else:
+            yield t
+
+
+def _locked_by(with_node: ast.With, lock_exprs: set[str]) -> bool:
+    return any(_dotted(item.context_expr) in lock_exprs
+               for item in with_node.items)
+
+
+def _tf114_walk(ctx, lock_exprs, mutated_cb, node, locked):
+    """Walk one subtree tracking ``with <lock>:`` nesting.  Nested defs
+    are descended with ``locked=False`` — their bodies run whenever the
+    function is *called* (usually on the worker thread), not where it
+    is defined, so a lock held at definition time proves nothing."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        for sub in node.body:
+            _tf114_walk(ctx, lock_exprs, mutated_cb, sub, False)
+        return
+    if isinstance(node, ast.With):
+        inner = locked or _locked_by(node, lock_exprs)
+        for sub in node.body:
+            _tf114_walk(ctx, lock_exprs, mutated_cb, sub, inner)
+        return
+    if not locked:
+        mutated_cb(node)
+    for child in ast.iter_child_nodes(node):
+        _tf114_walk(ctx, lock_exprs, mutated_cb, child, locked)
+
+
+@_file_rule
+def _tf114_lock_discipline(ctx: FileContext):
+    """Within _LOCK_DISCIPLINE_PARTS: a class owning a lock attribute
+    (``self._lock = threading.Lock()``) must mutate its other instance
+    attributes only under ``with self._lock:``; a module owning a
+    module-level lock must mutate its ``global``-declared state only
+    under that lock.  ~30 lines of logic on top of the shared
+    scaffolding — the template for future rules."""
+    if not ctx.lock_scope:
+        return
+    for cls in [n for n in ast.walk(ctx.tree)
+                if isinstance(n, ast.ClassDef)]:
+        locks = {t.attr for m in ast.walk(cls)
+                 if isinstance(m, ast.Assign) and _is_lock_ctor(m.value)
+                 for t in m.targets
+                 if isinstance(t, ast.Attribute)
+                 and isinstance(t.value, ast.Name) and t.value.id == "self"}
+        if not locks:
+            continue
+        lock_exprs = {f"self.{name}" for name in locks}
+        for meth in [m for m in cls.body
+                     if isinstance(m, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))
+                     and m.name not in _CTOR_METHODS]:
+            info = _FnInfo(meth, traced=False)
+
+            def mutated(stmt, meth=meth, info=info):
+                for t in _assign_target_attrs(stmt):
+                    base = t.value if isinstance(t, ast.Subscript) else t
+                    if (isinstance(base, ast.Attribute)
+                            and isinstance(base.value, ast.Name)
+                            and base.value.id == "self"
+                            and base.attr not in locks):
+                        ctx.emit("TF114", stmt,
+                                 f"self.{base.attr} mutated outside "
+                                 f"`with self.{sorted(locks)[0]}:` in "
+                                 f"{cls.name}.{meth.name}() — this class "
+                                 f"declares its state shared by owning a "
+                                 f"lock, and this module runs background "
+                                 f"threads; hold the lock, or suppress "
+                                 f"with tf-lint: ok[TF114] and a reason "
+                                 f"if the site is provably "
+                                 f"caller-serialized", info)
+                if (isinstance(stmt, ast.Call)
+                        and isinstance(stmt.func, ast.Attribute)
+                        and stmt.func.attr in _MUTATING_METHODS
+                        and isinstance(stmt.func.value, ast.Attribute)
+                        and isinstance(stmt.func.value.value, ast.Name)
+                        and stmt.func.value.value.id == "self"):
+                    ctx.emit("TF114", stmt,
+                             f"self.{stmt.func.value.attr}."
+                             f"{stmt.func.attr}() mutates shared "
+                             f"container state outside `with self."
+                             f"{sorted(locks)[0]}:` in {cls.name}."
+                             f"{meth.name}() — hold the lock, or "
+                             f"suppress with tf-lint: ok[TF114] and a "
+                             f"reason", info)
+
+            _tf114_walk(ctx, lock_exprs, mutated, meth, False)
+    # Module-level locks guard module globals the same way.
+    mod_locks = {t.id for stmt in ctx.tree.body
+                 if isinstance(stmt, ast.Assign)
+                 and _is_lock_ctor(stmt.value)
+                 for t in stmt.targets if isinstance(t, ast.Name)}
+    if not mod_locks:
+        return
+    for func in _nested_defs(ctx.tree):
+        declared = {n for s in ast.walk(func)
+                    if isinstance(s, ast.Global) for n in s.names}
+        if not declared:
+            continue
+        info = _FnInfo(func, traced=False)
+
+        def g_mutated(stmt, func=func, info=info, declared=declared):
+            for t in _assign_target_attrs(stmt):
+                base = t.value if isinstance(t, ast.Subscript) else t
+                if (isinstance(base, ast.Name) and base.id in declared
+                        and base.id not in mod_locks):
+                    ctx.emit("TF114", stmt,
+                             f"global {base.id} mutated outside "
+                             f"`with {sorted(mod_locks)[0]}:` in "
+                             f"{func.name}() — this module guards its "
+                             f"globals with a module-level lock; hold "
+                             f"it, or suppress with tf-lint: ok[TF114] "
+                             f"and a reason", info)
+
+        _tf114_walk(ctx, mod_locks, g_mutated, func, False)
+
+
+# ---------------------------------------------------------------------------
+# Driver.
+# ---------------------------------------------------------------------------
+
+
+def _visit_fn(ctx: FileContext, node, enclosing_traced: bool):
+    traced = (enclosing_traced
+              or node.name in ctx.jitted
+              or any(_is_tracing_decorator(d)
+                     for d in node.decorator_list))
+    info = _FnInfo(node, traced, probes_backend=_probes_backend(node))
+    for rule in _FN_RULES:
+        rule(ctx, info)
+    for child in _iter_local(node):
+        for rule in _NODE_RULES:
+            rule(ctx, child, info)
+    for sub in _nested_defs(node):
+        _visit_fn(ctx, sub, traced)
+
+
 def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
     """Run every rule over one source blob; suppressions already applied."""
     try:
@@ -359,339 +947,15 @@ def lint_source(src: str, path: str = "<string>") -> list[LintFinding]:
     except SyntaxError as e:
         return [LintFinding("TF100", path, e.lineno or 0,
                             f"syntax error: {e.msg}")]
-    lines = src.splitlines()
-    jitted = _jitted_names(tree)
-    findings: list[LintFinding] = []
-    norm_path = path.replace("\\", "/")
-    hot_path = norm_path.endswith(_HOT_PATH_SUFFIXES)
-    remat_scope = (any(p in norm_path for p in _REMAT_SCOPE_PARTS)
-                   and not any(p in norm_path
-                               for p in _REMAT_EXEMPT_PARTS))
-    serve_scope = (_SERVE_SCOPE_PART in norm_path
-                   and not norm_path.endswith(_SERVE_EXEMPT_SUFFIX))
-    wu_scope = ((_WU_SCOPE_PART in norm_path
-                 or norm_path.endswith(_WU_SCOPE_SUFFIX))
-                and not norm_path.endswith(_WU_EXEMPT_SUFFIXES))
-    thread_scope = not any(p in norm_path
-                           for p in _THREAD_SANCTIONED_PARTS)
-    http_scope = not norm_path.endswith(_HTTP_EXEMPT_SUFFIX)
-
-    # TF106: a module-level compiler-env write is safe only BEFORE the
-    # module-level jax import (the conftest/bootstrap pattern).
-    jax_import_line = None
-    for top in tree.body:
-        if isinstance(top, ast.Import) and any(
-                a.name == "jax" or a.name.startswith("jax.")
-                for a in top.names):
-            jax_import_line = top.lineno
-            break
-        if isinstance(top, ast.ImportFrom) and top.module and (
-                top.module == "jax" or top.module.startswith("jax.")):
-            jax_import_line = top.lineno
-            break
-
-    def suppressed(rule: str, *linenos: int) -> bool:
-        for ln in linenos:
-            if not (1 <= ln <= len(lines)):
-                continue
-            m = _SUPPRESS_RE.search(lines[ln - 1])
-            if m and (m.group(1) is None
-                      or rule in re.split(r"[,\s]+", m.group(1))):
-                return True
-        return False
-
-    def emit(rule: str, node: ast.AST, msg: str, fn: _FnInfo | None = None):
-        def_line = fn.node.lineno if fn is not None else node.lineno
-        if not suppressed(rule, node.lineno, def_line):
-            findings.append(LintFinding(rule, path, node.lineno, msg))
-
-    def _iter_local(node):
-        """Child nodes of ``node`` excluding nested function subtrees
-        (each nested def is checked in its own visit with its own
-        traced-ness)."""
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            yield child
-            yield from _iter_local(child)
-
-    def visit_fn(node, enclosing_traced: bool):
-        traced = (enclosing_traced
-                  or node.name in jitted
-                  or any(_is_tracing_decorator(d)
-                         for d in node.decorator_list))
-        info = _FnInfo(node, traced, probes_backend=_probes_backend(node))
-        _check_timing(node, info)
-        for child in _iter_local(node):
-            _check_node(child, info)
-        for sub in _nested_defs(node):
-            visit_fn(sub, traced)
-
-    def _nested_defs(node):
-        out = []
-
-        def rec(n):
-            for child in ast.iter_child_nodes(n):
-                if isinstance(child,
-                              (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    out.append(child)
-                else:
-                    rec(child)
-
-        rec(node)
-        return out
-
-    def _tf106(node, key, fn: _FnInfo | None):
-        if fn is not None:
-            if fn.probes_backend:
-                return  # checked backend init / re-execs — tuning.apply
-        elif jax_import_line is None or node.lineno < jax_import_line:
-            return  # module-level write before the jax import: safe
-        emit("TF106", node,
-             f"os.environ[{key!r}] written where the jax backend may "
-             f"already be initialized — the backend snapshots compiler "
-             f"env at init and later writes are silently dead; pass "
-             f"per-compile compiler_options (TPUFRAME_XLA_OPTS / "
-             f"tpuframe.tune) or probe xla_bridge._backends first", fn)
-
-    def _check_node(node, fn: _FnInfo | None):
-        traced = fn is not None and fn.traced
-        if http_scope and isinstance(node, (ast.Import, ast.ImportFrom)):
-            modules = ([a.name for a in node.names]
-                       if isinstance(node, ast.Import)
-                       else [node.module or ""])
-            if any(m == "http.server" or m.startswith("http.server.")
-                   for m in modules):
-                emit("TF113", node,
-                     "http.server imported outside obs/exporter.py — the "
-                     "exporter is the one sanctioned HTTP endpoint "
-                     "(OpenMetrics contract, health probe, port knobs); "
-                     "register gauges/collectors on it instead of "
-                     "standing up another server", fn)
-        if (http_scope and isinstance(node, ast.Attribute)
-                and _dotted(node) == "http.server"):
-            emit("TF113", node,
-                 "http.server used outside obs/exporter.py — route the "
-                 "endpoint through the telemetry exporter", fn)
-        if isinstance(node, (ast.Assign, ast.AugAssign)):
-            targets = (node.targets if isinstance(node, ast.Assign)
-                       else [node.target])
-            for t in targets:
-                if (isinstance(t, ast.Subscript)
-                        and _dotted(t.value) == "os.environ"
-                        and isinstance(t.slice, ast.Constant)
-                        and t.slice.value in _COMPILER_ENV_KEYS):
-                    _tf106(node, t.slice.value, fn)
-        if isinstance(node, ast.Call):
-            callee106 = _dotted(node.func)
-            if (callee106 in ("os.environ.setdefault", "os.putenv")
-                    and node.args
-                    and isinstance(node.args[0], ast.Constant)
-                    and node.args[0].value in _COMPILER_ENV_KEYS):
-                _tf106(node, node.args[0].value, fn)
-            elif callee106 == "os.environ.update":
-                keys = [kw.arg for kw in node.keywords
-                        if kw.arg in _COMPILER_ENV_KEYS]
-                for a in node.args:
-                    if isinstance(a, ast.Dict):
-                        keys += [k.value for k in a.keys
-                                 if isinstance(k, ast.Constant)
-                                 and k.value in _COMPILER_ENV_KEYS]
-                for key in keys:
-                    _tf106(node, key, fn)
-        if isinstance(node, ast.Call):
-            callee = _dotted(node.func)
-            tail = callee.rsplit(".", 1)[-1]
-            if traced:
-                if (tail in _HOST_CONVERTERS and callee == tail
-                        and node.args
-                        and not isinstance(node.args[0], ast.Constant)):
-                    emit("TF101", node,
-                         f"{tail}() on a possibly-traced value inside "
-                         f"traced code — concretizes at trace time", fn)
-                elif (callee.startswith(("np.", "numpy.", "onp."))
-                      and tail in _NP_CONVERTERS):
-                    emit("TF101", node,
-                         f"{callee}() pulls a traced value to host — "
-                         f"use jnp inside traced code", fn)
-                elif (isinstance(node.func, ast.Attribute)
-                      and node.func.attr in _METHOD_CONVERTERS
-                      and not callee.startswith(("np.", "numpy."))):
-                    emit("TF101", node,
-                         f".{node.func.attr}() on a possibly-traced "
-                         f"value inside traced code", fn)
-            if tail == "pallas_call" and not any(
-                    kw.arg == "interpret" for kw in node.keywords):
-                emit("TF104", node,
-                     "pallas_call without interpret= — decide "
-                     "Mosaic-vs-interpret explicitly (_auto_interpret())",
-                     fn)
-            if serve_scope and (
-                    tail in _SERVE_COMPILE_TAILS
-                    or (isinstance(node.func, ast.Attribute)
-                        and node.func.attr == "apply")):
-                what = (f"{callee}()" if tail in _SERVE_COMPILE_TAILS
-                        else f".apply()")
-                emit("TF109", node,
-                     f"{what} in the serving path above the compile seam "
-                     f"— every serving program must come from "
-                     f"serve/engine.py's bucketed AOT table (an "
-                     f"un-bucketed shape compiling mid-serving is a "
-                     f"multi-second stall)", fn)
-            if wu_scope and (
-                    callee in ("optax.apply_updates", "apply_updates")
-                    or (isinstance(node.func, ast.Attribute)
-                        and node.func.attr == "update"
-                        and _dotted(node.func.value).rsplit(".", 1)[-1]
-                        in _WU_OPTIMIZER_RECEIVERS
-                        and len(node.args) >= 2)):
-                emit("TF110", node,
-                     f"{callee}() optimizer update outside the "
-                     f"weight-update seam — route it through "
-                     f"parallel/step.py's _reduce_and_apply (or "
-                     f"parallel/zero1.py's sharded_update) so "
-                     f"TPUFRAME_WEIGHT_UPDATE=zero1 still shards the "
-                     f"update and optimizer state", fn)
-            if (thread_scope
-                    and callee in ("threading.Thread", "Thread")):
-                emit("TF111", node,
-                     f"{callee}() outside the sanctioned background-work "
-                     f"modules (ckpt/, data/pipeline.py, "
-                     f"obs/heartbeat.py, launch/) — a background thread "
-                     f"that issues collectives interleaves with the main "
-                     f"loop's compiled steps (the ordering hazard "
-                     f"ckpt/checkpoint.py documents); if the thread "
-                     f"provably never touches jax, suppress with "
-                     f"tf-lint: ok[TF111] and a reason", fn)
-            if (isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "emit"
-                    and _dotted(node.func.value).rsplit(".", 1)[-1]
-                    in _EMIT_RECEIVERS
-                    and node.args
-                    and isinstance(node.args[0], ast.Constant)
-                    and isinstance(node.args[0].value, str)):
-                registry = _event_type_registry()
-                if registry and node.args[0].value not in registry:
-                    emit("TF112", node,
-                         f"events.emit({node.args[0].value!r}) — type not "
-                         f"registered in obs/events.py REQUIRED_FIELDS; "
-                         f"unregistered types fail schema validation at "
-                         f"read time (the selfcheck CI gate), so register "
-                         f"the type (with its required fields) first", fn)
-            if remat_scope and callee in _BARE_REMAT_CALLEES:
-                emit("TF108", node,
-                     f"{callee}() bare rematerialization in model/step "
-                     f"code bypasses the tpuframe.mem policy registry — "
-                     f"use mem.remat_module for modules, mem.wrap / the "
-                     f"step factories' remat_policy= for loss functions",
-                     fn)
-            if (isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _RAW_GCS_METHODS
-                    and not path.replace("\\", "/").endswith("data/gcs.py")):
-                emit("TF105", node,
-                     f".{node.func.attr}() raw GCS client call outside "
-                     f"data/gcs.py — route it through the retry-wrapped "
-                     f"gcs layer (tpuframe.resilience)", fn)
-            if callee == "print":
-                if traced:
-                    emit("TF107", node,
-                         "print() inside traced code runs at trace time "
-                         "only, not per step — use jax.debug.print, or "
-                         "emit from the host loop via tpuframe.obs", fn)
-                elif hot_path and fn is not None:
-                    emit("TF107", node,
-                         "print() in per-step hot-path code bypasses the "
-                         "structured event log — use tpuframe.obs "
-                         "(events.emit / metrics.bump)", fn)
-            elif hot_path and fn is not None and callee in _CLOCK_CALLS:
-                emit("TF107", node,
-                     f"{callee}() hand-rolled step timing in a hot path "
-                     f"— the train loop's goodput meter owns step "
-                     f"timing; route measurements through tpuframe.obs",
-                     fn)
-        elif isinstance(node, ast.While):
-            if (isinstance(node.test, ast.Constant)
-                    and node.test.value is True):
-                _check_unbounded_retry(node, fn)
-            if traced and _test_touches_arrays(node.test):
-                emit("TF102", node,
-                     "Python branch on an array-valued test inside "
-                     "traced code — use lax.cond/jnp.where", fn)
-        elif traced and isinstance(node, (ast.If, ast.IfExp)):
-            if _test_touches_arrays(node.test):
-                emit("TF102", node,
-                     "Python branch on an array-valued test inside "
-                     "traced code — use lax.cond/jnp.where", fn)
-
-    def _check_unbounded_retry(node: ast.While, fn: _FnInfo | None):
-        """TF105b: ``while True`` + sleep with no comparison, raise, or
-        clock read in the loop's own body is a retry loop that can never
-        give up — it outlives deadlines, watchdogs and operators."""
-        sleeps = False
-        bounded = False
-        for child in node.body:
-            for sub in [child, *_iter_local(child)]:
-                if isinstance(sub, (ast.Compare, ast.Raise)):
-                    bounded = True
-                elif isinstance(sub, ast.Call):
-                    tail = _dotted(sub.func).rsplit(".", 1)[-1]
-                    if tail == "sleep":
-                        sleeps = True
-                    elif tail in ("time", "monotonic", "perf_counter"):
-                        bounded = True
-        if sleeps and not bounded:
-            emit("TF105", node,
-                 "unbounded `while True` retry loop: sleeps but never "
-                 "compares, raises, or reads a clock — use "
-                 "resilience.RetryPolicy (bounded attempts + deadline)",
-                 fn)
-
-    def _check_timing(node, fn: _FnInfo):
-        timing_names: set[str] = set()
-        has_device_work = False
-        has_sync = False
-        durations = []
-
-        def is_timing_call(c):
-            return (isinstance(c, ast.Call)
-                    and _dotted(c.func).rsplit(".", 1)[-1]
-                    in ("time", "perf_counter", "monotonic"))
-
-        local = list(_iter_local(node))
-        for child in local:
-            if isinstance(child, ast.Assign) and is_timing_call(child.value):
-                for t in child.targets:
-                    if isinstance(t, ast.Name):
-                        timing_names.add(t.id)
-            if isinstance(child, ast.Call):
-                callee = _dotted(child.func)
-                tail = callee.rsplit(".", 1)[-1]
-                if tail in _SYNC_MARKERS:
-                    has_sync = True
-                elif _DEVICE_WORK_RE.search(tail):
-                    has_device_work = True
-        for child in local:
-            if isinstance(child, ast.BinOp) and isinstance(
-                    child.op, ast.Sub):
-                sides = (child.left, child.right)
-                if all(is_timing_call(s)
-                       or (isinstance(s, ast.Name)
-                           and s.id in timing_names)
-                       for s in sides) and (
-                        timing_names or any(map(is_timing_call, sides))):
-                    durations.append(child)
-        if durations and has_device_work and not has_sync:
-            for d in durations:
-                emit("TF103", d,
-                     "duration measured around dispatched device work "
-                     "with no block_until_ready/sync in scope — this "
-                     "times dispatch, not execution", fn)
-
+    ctx = FileContext(tree, src, path)
     for top in _iter_local(tree):
-        _check_node(top, None)     # module level: TF104 still applies
+        for rule in _NODE_RULES:
+            rule(ctx, top, None)   # module level: TF104 still applies
     for top in _nested_defs(tree):
-        visit_fn(top, False)
-    return findings
+        _visit_fn(ctx, top, False)
+    for rule in _FILE_RULES:
+        rule(ctx)
+    return ctx.findings
 
 
 def lint_paths(paths, exclude: tuple[str, ...] = ()) -> list[LintFinding]:

@@ -39,6 +39,23 @@ class Unavailable(Exception):
     feature or too few devices) — a skip, not a failure."""
 
 
+@dataclass(frozen=True)
+class StrategyMeta:
+    """What a strategy *declares* about itself, for the shardflow
+    detectors: the mesh its replica groups must decompose over, the
+    dtype its collectives are allowed to carry on the wire, and the
+    per-leaf (dtype, full_dims, shard_dims) sharding expectations the
+    accidental-replication detector checks entry parameters against."""
+
+    mesh_shape: tuple[tuple[str, int], ...]
+    wire_dtype: str = "f32"
+    declared_leaves: tuple = ()    # ((hlo_dtype, full_dims, shard_dims),)
+
+    @property
+    def mesh_dict(self) -> dict:
+        return dict(self.mesh_shape)
+
+
 @dataclass
 class StrategyAudit:
     """Outcome of auditing one strategy's step program."""
@@ -51,6 +68,7 @@ class StrategyAudit:
     budget: budgets_lib.CommBudget | None = None
     param_bytes: int = 0
     compiled: object = None        # the AOT executable, for chained checks
+    meta: StrategyMeta | None = None
 
     def __str__(self):
         if self.status == "unavailable":
@@ -67,6 +85,49 @@ def _tree_bytes(tree) -> int:
 
     return int(sum(np.prod(l.shape or (1,)) * np.dtype(l.dtype).itemsize
                    for l in jax.tree.leaves(tree)))
+
+
+#: numpy dtype name -> optimized-HLO spelling (what parse_graph sees).
+_HLO_DTYPES = {
+    "float64": "f64", "float32": "f32", "float16": "f16",
+    "bfloat16": "bf16", "int64": "s64", "int32": "s32", "int16": "s16",
+    "int8": "s8", "uint64": "u64", "uint32": "u32", "uint16": "u16",
+    "uint8": "u8", "bool": "pred",
+}
+
+
+def _meta(mesh, *, wire_dtype: str = "f32",
+          declared_leaves: tuple = ()) -> StrategyMeta:
+    return StrategyMeta(
+        mesh_shape=tuple((str(a), int(s)) for a, s in mesh.shape.items()),
+        wire_dtype=wire_dtype, declared_leaves=declared_leaves)
+
+
+def _declared_leaves(tree, shardings) -> tuple:
+    """(hlo_dtype, full_dims, shard_dims) per state leaf — what the
+    accidental-replication detector expects entry parameters to look
+    like.  ``shardings`` is a matching pytree of NamedSharding."""
+    import jax
+
+    out = []
+    for leaf, sh in zip(jax.tree.leaves(tree), jax.tree.leaves(shardings)):
+        dt = _HLO_DTYPES.get(str(getattr(leaf, "dtype", "")))
+        if dt is None or not hasattr(sh, "shard_shape"):
+            continue
+        full = tuple(int(d) for d in leaf.shape)
+        shard = tuple(int(d) for d in sh.shard_shape(full))
+        out.append((dt, full, shard))
+    return tuple(out)
+
+
+def _leaves_from_sds(tree) -> tuple:
+    """Same, for trees of ShapeDtypeStruct that carry their sharding."""
+    import jax
+
+    annotated = [(l, l.sharding) for l in jax.tree.leaves(tree)
+                 if getattr(l, "sharding", None) is not None]
+    return _declared_leaves([l for l, _ in annotated],
+                            [s for _, s in annotated])
 
 
 def _require_devices(n: int):
@@ -112,7 +173,8 @@ def _lm_pieces(batch: int = 8, seq: int = 32, **cfg_kw):
 
 
 # --------------------------------------------------------------------------
-# Builders.  Each returns (jitted_step, example_args, budget, param_bytes).
+# Builders.  Each returns
+# (jitted_step, example_args, budget, param_bytes, meta).
 # --------------------------------------------------------------------------
 
 
@@ -122,7 +184,7 @@ def _build_dp(n_devices: int):
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=n_devices))
     _, loss_fn, tx, example, pb, _ = _lm_pieces()
     step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False)
-    return step, example, budgets_lib.dp_budget(pb), pb
+    return step, example, budgets_lib.dp_budget(pb), pb, _meta(mesh)
 
 
 def _build_zero1(n_devices: int):
@@ -147,7 +209,8 @@ def _build_zero1(n_devices: int):
     step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
                                     weight_update="zero1")
     padded = zero1_lib.padded_bytes(state.params, n)
-    return step, (state, batch), budgets_lib.zero1_budget(padded), pb
+    return (step, (state, batch), budgets_lib.zero1_budget(padded), pb,
+            _meta(mesh))
 
 
 def _build_fsdp(n_devices: int):
@@ -160,7 +223,9 @@ def _build_fsdp(n_devices: int):
     shardings = fsdp_lib.state_shardings(state, mesh)
     step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
                                     state_shardings=shardings)
-    return step, (state, batch), budgets_lib.fsdp_budget(pb), pb
+    return (step, (state, batch), budgets_lib.fsdp_budget(pb), pb,
+            _meta(mesh,
+                  declared_leaves=_declared_leaves(state, shardings)))
 
 
 def _build_tp(n_devices: int):
@@ -175,8 +240,10 @@ def _build_tp(n_devices: int):
         state, mesh, tp_rules=tp_lib.rules_for_model("transformer-lm"))
     step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
                                     state_shardings=shardings)
-    return step, (state, batch), budgets_lib.tp_budget(
-        pb, ab, num_layers=2), pb
+    return (step, (state, batch),
+            budgets_lib.tp_budget(pb, ab, num_layers=2), pb,
+            _meta(mesh,
+                  declared_leaves=_declared_leaves(state, shardings)))
 
 
 def _build_ring_sp(n_devices: int, seq_mode: str = "ring"):
@@ -197,7 +264,7 @@ def _build_ring_sp(n_devices: int, seq_mode: str = "ring"):
                                             sp_degree=sp)
     else:
         budget = budgets_lib.ulysses_sp_budget(pb, ab)
-    return step, (state, batch), budget, pb
+    return step, (state, batch), budget, pb, _meta(mesh)
 
 
 def _build_ulysses(n_devices: int):
@@ -232,7 +299,8 @@ def _build_pp(n_devices: int):
     pb = _tree_bytes(variables["params"])
     ab = 8 * 16 * 32 * 4
     return (step, (state, {"input_ids": ids, "labels": ids}),
-            budgets_lib.pp_budget(pb, ab, n_micro=n_micro), pb)
+            budgets_lib.pp_budget(pb, ab, n_micro=n_micro), pb,
+            _meta(mesh))
 
 
 def _build_ep(n_devices: int):
@@ -275,7 +343,9 @@ def _build_ep(n_devices: int):
     pb = _tree_bytes(variables["params"])
     ab = 8 * 16 * 32 * 4
     return (step, (state, {"input_ids": ids, "labels": ids}),
-            budgets_lib.ep_budget(pb, ab), pb)
+            budgets_lib.ep_budget(pb, ab), pb,
+            _meta(mesh,
+                  declared_leaves=_declared_leaves(state, shardings)))
 
 
 def _build_serve_decode(n_devices: int):
@@ -316,7 +386,8 @@ def _build_serve_decode(n_devices: int):
                sds((spec.slots,), jnp.int32, sharding=row),
                cache_sds)
     return (jax.jit(decode_fn), example,
-            budgets_lib.serve_decode_budget(pb), pb)
+            budgets_lib.serve_decode_budget(pb), pb,
+            _meta(mesh, declared_leaves=_leaves_from_sds(example)))
 
 
 def _build_adasum(n_devices: int):
@@ -326,7 +397,8 @@ def _build_adasum(n_devices: int):
     _, loss_fn, tx, example, pb, _ = _lm_pieces()
     step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
                                     grad_reduce="adasum")
-    return step, example, budgets_lib.adasum_budget(pb, n_devices), pb
+    return (step, example, budgets_lib.adasum_budget(pb, n_devices), pb,
+            _meta(mesh))
 
 
 #: MULTICHIP_r05.json strategy name -> builder.
@@ -351,7 +423,7 @@ def audit_strategy(name: str, n_devices: int = 8) -> StrategyAudit:
                          f"have {sorted(STRATEGIES)}")
     try:
         _require_devices(n_devices)
-        step, example, budget, pb = STRATEGIES[name](n_devices)
+        step, example, budget, pb, meta = STRATEGIES[name](n_devices)
         report, compiled = hlo_audit.audit_jitted(step, *example)
     except Unavailable as e:
         return StrategyAudit(name=name, status="unavailable",
@@ -365,7 +437,7 @@ def audit_strategy(name: str, n_devices: int = 8) -> StrategyAudit:
     return StrategyAudit(
         name=name, status="ok" if not violations else "violation",
         violations=violations, report=report, budget=budget,
-        param_bytes=pb, compiled=compiled)
+        param_bytes=pb, compiled=compiled, meta=meta)
 
 
 def audit_all(n_devices: int = 8,

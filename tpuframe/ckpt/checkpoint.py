@@ -623,8 +623,15 @@ class CheckpointManager:
         self.async_write = async_write
         # (worker, step, path) per in-flight async save — flush() needs
         # the step/path to quarantine a deadline-stranded upload.
+        # _mutex guards _pending/_errors/_last_path: the worker thread
+        # appends errors while the train loop prunes/waits, so every
+        # mutation holds it (TF114) — and NO join() ever runs under it
+        # (the worker takes it to report an error; joining while holding
+        # it would deadlock).
+        self._mutex = threading.Lock()
         self._pending: list[tuple[threading.Thread, int, str]] = []
         self._errors: list[str] = []
+        self._last_path: str | None = None
         gcs.makedirs(directory)
 
     def should_save(self, step: int) -> bool:
@@ -648,12 +655,18 @@ class CheckpointManager:
         # Cap the backlog at 2 (one writing + one queued) — beyond that,
         # block briefly on the oldest instead of accumulating snapshots
         # until the host OOMs; and prune finished workers (only the newest
-        # is needed for ordering).
-        self._pending = [p for p in self._pending if p[0].is_alive()]
-        while len(self._pending) >= 2:
-            self._pending[0][0].join()
-            self._pending = [p for p in self._pending if p[0].is_alive()]
-        prev = self._pending[-1][0] if self._pending else None
+        # is needed for ordering).  Prune/read under the mutex, join
+        # outside it.
+        while True:
+            with self._mutex:
+                self._pending = [p for p in self._pending
+                                 if p[0].is_alive()]
+                oldest = (self._pending[0][0]
+                          if len(self._pending) >= 2 else None)
+                prev = self._pending[-1][0] if self._pending else None
+            if oldest is None:
+                break
+            oldest.join()
         # What the step path actually waited for: the snapshot plus any
         # backpressure join above.  Captured here so the worker can stamp
         # it on the ckpt_save event next to the full span.
@@ -674,13 +687,15 @@ class CheckpointManager:
                                 block_ms=block_ms,
                                 async_write=True)
             except Exception as e:  # noqa: BLE001 — surfaced by wait_pending
-                self._errors.append(f"save step {step}: "
-                                    f"{type(e).__name__}: {e}")
+                with self._mutex:
+                    self._errors.append(f"save step {step}: "
+                                        f"{type(e).__name__}: {e}")
 
         t = threading.Thread(target=work, name=f"ckpt-save-{step}",
                              daemon=True)
-        self._pending.append((t, step, path))
-        self._last_path = path
+        with self._mutex:
+            self._pending.append((t, step, path))
+            self._last_path = path
         t.start()
         # Preemption-while-uploading seam: SIGTERM lands the instant a
         # snapshot is in flight — the exact window flush() exists for.
@@ -758,14 +773,17 @@ class CheckpointManager:
         host additionally polls for it — after this returns, the newest
         checkpoint is durably visible to all hosts (or the timeout left it
         torn, which restore already tolerates)."""
-        for t, _, _ in self._pending:
+        with self._mutex:
+            pending = list(self._pending)
+        for t, _, _ in pending:
             t.join()
-        self._pending.clear()
-        if self._errors:
+        with self._mutex:
+            self._pending = [p for p in self._pending if p not in pending]
             errs = "; ".join(self._errors)
             self._errors = []
+            last = self._last_path
+        if errs:
             raise RuntimeError(f"async checkpoint save(s) failed: {errs}")
-        last = getattr(self, "_last_path", None)
         if last is None or jax.process_index() == 0:
             return
         deadline = time.time() + commit_timeout_s
@@ -791,13 +809,16 @@ class CheckpointManager:
         left behind.  Sync managers have nothing in flight and return
         True immediately."""
         deadline = time.time() + deadline_s
-        for t, _, _ in self._pending:
+        with self._mutex:
+            pending = list(self._pending)
+        for t, _, _ in pending:
             t.join(max(0.0, deadline - time.time()))
-        pending, self._pending = self._pending, []
-        if self._errors:
-            print(f"[ckpt] flush: async save error(s): "
-                  f"{'; '.join(self._errors)}", flush=True)
+        with self._mutex:
+            self._pending = [p for p in self._pending if p not in pending]
+            errs = "; ".join(self._errors)
             self._errors = []
+        if errs:
+            print(f"[ckpt] flush: async save error(s): {errs}", flush=True)
         all_committed = True
         for t, step, path in pending:
             committed = gcs.exists(gcs.join(path, _COMMIT))

@@ -94,7 +94,8 @@ class MetricsExporter:
                 self._collectors.append(fn)
 
     def set_health(self, fn) -> None:
-        self._health = fn
+        with self._lock:
+            self._health = fn
 
     def healthy(self) -> bool:
         fn = self._health
@@ -176,8 +177,13 @@ class MetricsExporter:
             def log_message(self, *args):  # scrapes must not spam stdout
                 pass
 
+        # Lifecycle fields (_server/port/_thread) are caller-serialized:
+        # start()/stop() only run under the module _exporter_lock
+        # (start_from_env/stop below), and the handler thread never
+        # writes them — so TF114 is suppressed here rather than holding
+        # self._lock across bind/serve setup.
         try:
-            self._server = ThreadingHTTPServer(
+            self._server = ThreadingHTTPServer(  # tf-lint: ok[TF114]
                 ("0.0.0.0", int(self._port_requested)), _Handler)
         except OSError as e:
             import sys
@@ -185,14 +191,14 @@ class MetricsExporter:
             print(f"[tpuframe.obs] metrics exporter: cannot bind port "
                   f"{self._port_requested} ({e}) — scrape endpoint off, "
                   f"textfile output unaffected", file=sys.stderr)
-            self._server = None
+            self._server = None  # tf-lint: ok[TF114] — caller-serialized
             return self
         self._server.daemon_threads = True
-        self.port = self._server.server_address[1]
+        self.port = self._server.server_address[1]  # tf-lint: ok[TF114]
         # Serves in-process snapshots only (counters/gauges under a plain
         # lock) — never touches jax or a collective, so the TF111
         # collective-ordering hazard does not apply.
-        self._thread = threading.Thread(  # tf-lint: ok[TF111]
+        self._thread = threading.Thread(  # tf-lint: ok[TF111, TF114]
             target=self._server.serve_forever, daemon=True,
             name="tpuframe-metrics")
         self._thread.start()
@@ -214,8 +220,11 @@ class MetricsExporter:
             pass  # scrape-less fallback is itself best-effort
 
     def stop(self) -> None:
+        # Same caller-serialized lifecycle contract as start(): runs only
+        # under the module _exporter_lock, and holding self._lock across
+        # shutdown()/join() would stall a mid-scrape handler holding it.
         self.flush()
-        server, self._server = self._server, None
+        server, self._server = self._server, None  # tf-lint: ok[TF114]
         if server is not None:
             try:
                 server.shutdown()
@@ -224,7 +233,7 @@ class MetricsExporter:
                 pass
         if self._thread is not None:
             self._thread.join(timeout=2.0)
-            self._thread = None
+            self._thread = None  # tf-lint: ok[TF114] — caller-serialized
 
 
 # ---------------------------------------------------------------------------

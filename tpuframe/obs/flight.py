@@ -87,7 +87,11 @@ class FlightRecorder:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)  # atomic: a dump is whole or absent
-            self.last_dump_path = path
+            # Benign single-writer publish: dump() runs on the crashing
+            # thread; readers only see the path post-mortem, and taking
+            # self._lock inside a signal handler could deadlock against
+            # a record() mid-append on the interrupted thread.
+            self.last_dump_path = path  # tf-lint: ok[TF114]
             return path
         except Exception:  # noqa: BLE001 — a failing dump must not turn
             return None  # a recoverable death into an unrecoverable one
