@@ -86,6 +86,8 @@ def _emit(value: float, n_chips: int, **extra) -> None:
         line["policy"] = _RESULT["remat_policy"]
     if _RESULT.get("weight_update", "replicated") != "replicated":
         line["weight_update"] = _RESULT["weight_update"]
+    if _RESULT.get("wire_format", "fp") != "fp":
+        line["wire_format"] = _RESULT["wire_format"]
     line.update(extra)
     print(json.dumps(line), flush=True)
 
@@ -251,6 +253,17 @@ def run(batch_per_chip: int, warmup: int, measure: int) -> float:
     if weight_update == "zero1":
         _log(f"weight update: {weight_update} (source: {wu_source})")
     _RESULT["weight_update"] = weight_update
+    # TPUFRAME_WIRE_FORMAT=int8-block A/Bs block-quantized gradient
+    # collectives (quantized all-to-all + all-gather instead of the f32
+    # all-reduce); unset, the DB's offline wire_format_* winner applies.
+    from tpuframe.parallel import quantwire
+
+    wire_format, wf_source = quantwire.resolve(
+        program=f"train_resnet50_b{global_batch}",
+        family="wire_format_resnet50")
+    if wire_format != "fp":
+        _log(f"wire format: {wire_format} (source: {wf_source})")
+    _RESULT["wire_format"] = wire_format
     model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem,
                             bn=bn)
     rng = np.random.default_rng(0)
@@ -301,10 +314,16 @@ def run(batch_per_chip: int, warmup: int, measure: int) -> float:
         if wu_source != "env":
             weight_update = "replicated"
             _RESULT["weight_update"] = weight_update
+    if wire_format != "fp" and mesh is None:
+        # single-chip run: no cross-chip wire to quantize — same idiom.
+        if wf_source != "env":
+            wire_format = "fp"
+            _RESULT["wire_format"] = wire_format
     train_step = step_lib.make_train_step(
         loss_fn, tx, mesh, donate=True, compiler_options=xla_opts,
         remat_policy=None if remat_policy == "none" else remat_policy,
-        weight_update=weight_update)
+        weight_update=weight_update,
+        wire_format=wire_format)
 
     if mesh is not None:
         if weight_update == "zero1":

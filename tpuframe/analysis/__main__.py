@@ -218,6 +218,16 @@ def _run_zero1_check() -> int:
     return len(problems)
 
 
+def _run_quantwire_check() -> int:
+    from tpuframe.parallel import quantwire
+
+    problems = quantwire.check()
+    for p in problems:
+        print(f"QUANTWIRE {p}")
+    print(f"[analysis] quantwire self-check: {len(problems)} problem(s)")
+    return len(problems)
+
+
 def _run_obs_check() -> int:
     # Through the real CLI entry point, not an import — the gate then
     # also catches a broken ``python -m tpuframe.obs`` invocation.
@@ -280,6 +290,7 @@ def main(argv=None) -> int:
         n_findings += _run_mem_check()
         n_findings += _run_serve_check()
         n_findings += _run_zero1_check()
+        n_findings += _run_quantwire_check()
         n_findings += _run_obs_check()
         if args.json:
             _write_json(args.json, audits, lint_findings, args.devices)

@@ -130,6 +130,58 @@ def zero1_budget(padded_param_bytes: int, name: str = "dp-zero1") -> CommBudget:
     )
 
 
+def dp_int8_budget(param_bytes: int, n_devices: int = 8,
+                   name: str = "dp-int8") -> CommBudget:
+    """Plain DP over the int8-block wire (quantwire, arXiv:2506.17615
+    style): the grad all-reduce is REPLACED by a quantized all-to-all
+    (reduce-scatter phase) plus all-gather, both carrying s8 payloads
+    with f32 per-block scales.  Each leg's ceiling is half the f32 param
+    bytes — 2x headroom over the ~param_bytes/4 s8 payload + scale/pad
+    overhead, and still 4x under :func:`dp_budget`'s 2.0x all-reduce
+    ceiling, so the budget itself documents the wire-byte drop.  Leaves
+    under the quantization floor (quantwire.MIN_QUANT_ELEMS) fall back
+    to fp all-reduce; that residue plus metric reductions gets a small
+    explicit allowance rather than a silent exemption, and the floor
+    drops to 1 KiB so the audit actually sees the quantized ops (the
+    tiny audit model's per-leaf collectives sit below the default
+    floor)."""
+    del n_devices  # wire bytes are per-device; degree cancels out
+    leg = int(0.5 * param_bytes)
+    return CommBudget(
+        name=name,
+        allowed={"all-to-all": leg, "all-gather": leg,
+                 "all-reduce": int(0.25 * param_bytes)},
+        ignore_below=1024,
+        notes="quantized a2a+ag grad path (s8 payload + f32 block "
+              "scales), fp all-reduce residue for sub-floor leaves",
+    )
+
+
+def zero1_int8_budget(padded_param_bytes: int, n_devices: int = 8,
+                      name: str = "dp-zero1-int8") -> CommBudget:
+    """ZeRO-1 over the int8-block wire: the grad reduce-scatter becomes
+    a quantized all-to-all, and the param all-gather becomes a quantized
+    DELTA all-gather (new_shard - old_shard on the wire; masters stay
+    f32).  Each quantized leg is capped at half the padded f32 bytes
+    (2x headroom over the s8 payload) versus :func:`zero1_budget`'s
+    exact 1.0x per leg — the +9%-step-time all-gather PERF §18 charges
+    ZeRO-1 for is the leg this shrinks.  Leaves whose padded size is
+    under the quantization floor keep the fp reduce-scatter/all-gather
+    pair; that residue is small per leaf (< 4 KiB) and gets an explicit
+    quarter-size allowance on the reduce-scatter kind."""
+    del n_devices
+    leg = int(0.5 * padded_param_bytes)
+    return CommBudget(
+        name=name,
+        allowed={"all-to-all": leg, "all-gather": leg,
+                 "reduce-scatter": int(0.25 * padded_param_bytes)},
+        ignore_below=1024,
+        notes="quantized a2a grad-in + s8 delta all-gather param-out; "
+              "fp reduce-scatter residue for sub-floor leaves; "
+              "all-reduce still forbidden above the 1 KiB scalar floor",
+    )
+
+
 def serve_decode_budget(param_bytes: int = 0,
                         name: str = "serve-dp-decode") -> CommBudget:
     """Plain-DP serving decode: params replicated, KV slots sharded over
@@ -270,7 +322,9 @@ def strategy_budget(strategy: str, **sizes) -> CommBudget:
     """Budget for a MULTICHIP strategy name from program-derived sizes."""
     builders = {
         "dp": dp_budget,
+        "dp-int8": dp_int8_budget,
         "dp-zero1": zero1_budget,
+        "dp-zero1-int8": zero1_int8_budget,
         "serve-dp-decode": serve_decode_budget,
         "resnet-fsdp": fsdp_budget,
         "lm-seq-parallel": ring_sp_budget,

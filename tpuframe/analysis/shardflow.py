@@ -94,6 +94,51 @@ def _wire_exempt(dtypes: frozenset) -> str | None:
     return None
 
 
+# The EQuARX-style block-quantized wire (tpuframe.parallel.quantwire,
+# arXiv:2506.17615): s8 payload collectives are the declared compressed
+# format.  The f32 block scales ride their own small collectives and are
+# deliberately NOT exempted — a registration containing f32 would cover
+# every full-precision collective and blind the detector (the seeded
+# positive below pins that).
+register_wire_format("int8-block", {"s8"})
+
+
+# A minimal optimized-HLO program with one gradient-sized f32 all-reduce.
+# Under a declared bf16 wire this MUST stay a finding even with quantized
+# formats registered — proves registration exempts only its own payload
+# dtype, never full-precision strays.
+_SEEDED_WIRE_HLO = """\
+HloModule seeded_wire_positive
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %r = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main (p0: f32[65536]) -> f32[65536] {
+  %p0 = f32[65536]{0} parameter(0)
+  ROOT %ar = f32[65536]{0} all-reduce(f32[65536]{0} %p0), replica_groups={}, to_apply=%add
+}
+"""
+
+
+def seeded_wire_positive() -> list[str]:
+    """Self-test of the wire-dtype detector: the seeded f32-under-bf16
+    program must yield exactly one finding.  Zero findings means a wire
+    registration (e.g. an int8 format accidentally including f32) has
+    silently blinded the detector; returns problem strings for the gate."""
+    graph = cg.parse_graph(_SEEDED_WIRE_HLO)
+    found = detect_wire_dtype(graph, "bf16")
+    if len(found) != 1:
+        return [f"seeded wire-dtype positive: expected exactly 1 finding "
+                f"for an f32 all-reduce under a declared bf16 wire, got "
+                f"{len(found)} — a registered wire format "
+                f"({sorted(_WIRE_FORMATS)}) is exempting full-precision "
+                f"payloads: {found}"]
+    return []
+
+
 # ---------------------------------------------------------------------------
 # Detectors.  Each takes the graph (plus strategy facts) and returns
 # finding strings; empty list == clean.
@@ -453,7 +498,7 @@ def check(audits=None, *, n_devices: int = 8,
 
         audits = strategies.audit_all(n_devices)
     derived_file = load_derived(derived_path)
-    problems: list[str] = []
+    problems: list[str] = seeded_wire_positive()
     for audit in audits:
         if audit.status == "unavailable" or audit.compiled is None:
             continue

@@ -156,6 +156,9 @@ RULES = {
     "TF114": "lock-guarded shared state mutated outside `with <lock>:` in "
              "a background-thread module (ckpt/, obs/exporter.py, "
              "obs/flight.py, data/pipeline.py)",
+    "TF115": "raw lax collective (psum/ppermute/all_gather/psum_scatter) "
+             "in the wire-format seam (parallel/step.py, "
+             "parallel/zero1.py) bypassing the resolved wire format",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -237,6 +240,16 @@ _MUTATING_METHODS = {
     "add", "discard", "popitem", "setdefault", "appendleft", "popleft",
 }
 _CTOR_METHODS = {"__init__", "__post_init__", "__new__"}
+
+# TF115: the wire-format seam.  step.py and zero1.py resolve the wire
+# format (fp vs int8-block) per strategy and must route gradient-path
+# collectives through that dispatch — a raw lax.psum/all_gather here is
+# a call site the quantized wire silently never reaches.  lax.pmean is
+# deliberately NOT in the tails: it IS the fp wire's dispatch target.
+# Sanctioned raw uses (scalar reductions under every wire's size floor)
+# carry ``# tf-lint: ok[TF115]`` and a reason.
+_WIRE_SEAM_SUFFIXES = ("parallel/step.py", "parallel/zero1.py")
+_WIRE_RAW_TAILS = {"psum", "ppermute", "all_gather", "psum_scatter"}
 
 # TF105a: google.cloud.storage blob/bucket methods — allowed only inside
 # the retry-wrapped data/gcs.py layer.
@@ -445,6 +458,7 @@ class FileContext:
                                     for p in _THREAD_SANCTIONED_PARTS)
         self.http_scope = not norm.endswith(_HTTP_EXEMPT_SUFFIX)
         self.lock_scope = any(p in norm for p in _LOCK_DISCIPLINE_PARTS)
+        self.wire_scope = norm.endswith(_WIRE_SEAM_SUFFIXES)
         # TF106: a module-level compiler-env write is safe only BEFORE
         # the module-level jax import (the conftest/bootstrap pattern).
         self.jax_import_line = None
@@ -731,6 +745,21 @@ def _tf102_control_flow(ctx: FileContext, node, fn):
             ctx.emit("TF102", node,
                      "Python branch on an array-valued test inside "
                      "traced code — use lax.cond/jnp.where", fn)
+
+
+@_node_rule
+def _tf115_wire_seam(ctx: FileContext, node, fn):
+    if not ctx.wire_scope or not isinstance(node, ast.Call):
+        return
+    callee = _dotted(node.func)
+    if not callee.startswith(("lax.", "jax.lax.")):
+        return
+    if callee.rsplit(".", 1)[-1] in _WIRE_RAW_TAILS:
+        ctx.emit("TF115", node,
+                 f"raw `{callee}` in the wire-format seam bypasses the "
+                 f"resolved wire format — route through the wire "
+                 f"dispatch (quantwire/collectives helpers) or suppress "
+                 f"with tf-lint: ok[TF115] and a reason", fn)
 
 
 @_fn_rule

@@ -43,6 +43,8 @@ Event types (see ``REQUIRED_FIELDS`` for the per-type contract):
                  (policy name, resolution source, predicted bytes)
   weight_update  weight-update sharding mode chosen for the step program
                  (mode replicated|zero1, resolution source, shard count)
+  wire_format    gradient-path collective wire format chosen for the
+                 step program (format fp|int8-block, resolution source)
   run_end        final step, wall s, goodput buckets, MFU, counters,
                  peak HBM per device
   trace_start    a jax.profiler trace window opened (step, artifact path)
@@ -99,6 +101,7 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "devmem": ("devices",),
     "remat_policy": ("policy", "source"),
     "weight_update": ("mode", "source"),
+    "wire_format": ("format", "source"),
     "run_end": ("final_step", "wall_s", "goodput"),
     "trace_start": ("step", "path"),
     "trace_end": ("step", "path"),

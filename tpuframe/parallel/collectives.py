@@ -172,6 +172,28 @@ def allgather(x: jax.Array, axis: AxisName = "data", *, tiled: bool = True) -> j
     return lax.all_gather(x, bound, axis=0, tiled=tiled)
 
 
+# jax >= 0.6 vma machinery (mirrors zero1._HAS_VMA): all_gather_invariant
+# exists and can mark a gather's result replication-invariant.
+_HAS_VMA = hasattr(jax, "typeof") and hasattr(lax, "pcast")
+
+
+def allgather_invariant(x: jax.Array, axis: AxisName = "data", *,
+                        gather_axis: int = 0, tiled: bool = True) -> jax.Array:
+    """Tiled all-gather whose result is marked replication-INVARIANT where
+    this jax can express it: every replica gathers the identical full
+    array, so the output is legal under a replicated out_spec (the zero1
+    param regather and the quantwire int8 gather both rely on this).
+    Falls back to a plain ``lax.all_gather`` on legacy jax, where
+    check_rep=False tracks nothing anyway.  Unmapped: identity."""
+    bound = _bound_axes(axis)
+    if not bound:
+        return x
+    gather = getattr(lax, "all_gather_invariant", None)
+    if gather is not None and _HAS_VMA:
+        return gather(x, bound, axis=gather_axis, tiled=tiled)
+    return lax.all_gather(x, bound, axis=gather_axis, tiled=tiled)
+
+
 def _linear_index(bound: tuple[str, ...]) -> jax.Array:
     """Row-major linearized replica index over the bound axes — the single
     rank space Horovod exposes (``hvd.rank()`` in its one-process-per-GPU
@@ -401,61 +423,34 @@ def _bcast_sum(sharding: NamedSharding):
     return jax.jit(lambda a: a.sum(axis=0), out_shardings=sharding)
 
 
+_QUANTIZED_MEAN_WARNED = False
+
+
 def quantized_mean(tree: PyTree, axis: AxisName = "data") -> PyTree:
-    """Cross-replica gradient mean with quantized wire traffic — the
-    EQuARX-style option for the ring-allreduce row (SURVEY.md §3b;
-    PAPERS.md:7).
+    """Deprecated alias for :func:`tpuframe.parallel.quantwire.all_reduce_mean`.
 
-    XLA owns the ring's internals, so per-hop requantization is not
-    reachable from program level; the reachable sound formulation is a
-    shared-scale integer allreduce:
-
-      1. ``s = pmax(max|g|) / 127`` — one scalar f32 collective;
-      2. ``q = round(g / s)`` symmetric int8 per replica (local);
-      3. ``psum(q)`` accumulated in **int16** — 2 bytes per element on the
-         wire vs 4 for f32 (the ring's ~2x traffic factor applies to both
-         dtypes and cancels): 2x compression; int16 holds 127 x N exactly
-         for N <= 258 replicas (int32 beyond, parity with f32 bytes);
-      4. dequantize ``sum * s / N`` locally, cast back.
-
-    psum keeps the result invariant over the reduced axes (an all_gather
-    formulation would leave it vma-varying and unusable for replicated
-    params).  Error: one shared-scale quantization step per contribution,
-    |mean err| <= s/2 = global max|g| / 254 (pinned by test).  Presummed
-    (unvarying) leaves pass through like ``average_gradients``'s.
+    The original shared-scale int16-accumulated psum prototype grew into
+    the block-quantized ``int8-block`` wire format (per-block scales, s8
+    payload over all-to-all + all-gather — arXiv:2506.17615), resolved
+    per strategy through ``TPUFRAME_WIRE_FORMAT`` / the tune DB on the
+    step path.  This shim keeps the old always-quantized call-site
+    semantics (``min_elems=0``: every leaf takes the quantized wire) and
+    warns once per process, the PR 5/PR 8 legacy-knob idiom.
     """
-    names = _bound_axes(axis)
-    if not names:
-        return tree
+    global _QUANTIZED_MEAN_WARNED
+    if not _QUANTIZED_MEAN_WARNED:
+        _QUANTIZED_MEAN_WARNED = True
+        import warnings
 
-    def _qmean(g):
-        vma = jax.typeof(g).vma
-        varying = tuple(a for a in names if a in vma)
-        if not varying:
-            size = 1
-            for n in names:
-                size *= lax.axis_size(n)
-            return g / size if size > 1 else g
-        n_total = 1
-        for a in varying:
-            n_total *= lax.axis_size(a)
-        # Bound-but-unvarying axes arrive presummed (average_gradients'
-        # contract): divide by their size too so the result is the mean
-        # over ALL bound axes regardless of each leaf's arrival state.
-        size_presummed = 1
-        for a in names:
-            if a not in vma:
-                size_presummed *= lax.axis_size(a)
-        acc_dtype = jnp.int16 if n_total <= 258 else jnp.int32
-        gf = g.astype(jnp.float32)
-        scale = lax.pmax(jnp.max(jnp.abs(gf)), varying) / 127.0
-        safe = jnp.where(scale == 0.0, 1.0, scale)
-        q = jnp.clip(jnp.round(gf / safe), -127, 127).astype(acc_dtype)
-        total = lax.psum(q, varying)            # narrow-int wire
-        return (total.astype(jnp.float32) * safe
-                / (n_total * size_presummed)).astype(g.dtype)
+        warnings.warn(
+            "collectives.quantized_mean is deprecated; call "
+            "tpuframe.parallel.quantwire.all_reduce_mean (or select the "
+            "wire per strategy via TPUFRAME_WIRE_FORMAT / the tune DB "
+            "on the make_train_step path)",
+            DeprecationWarning, stacklevel=2)
+    from tpuframe.parallel import quantwire
 
-    return jax.tree.map(_qmean, tree)
+    return quantwire.all_reduce_mean(tree, axis, min_elems=0)
 
 
 def host_broadcast(tree: PyTree, mesh: Mesh) -> PyTree:

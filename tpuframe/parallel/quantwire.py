@@ -1,0 +1,328 @@
+"""Block-quantized int8 wire formats for the gradient-path collectives.
+
+*EQuARX: Efficient Quantized AllReduce in XLA* (arXiv:2506.17615,
+PAPERS.md) shows a block-quantized int8 all-reduce cuts wire bytes ~4x
+with bounded accuracy cost — the lineage optimization for this repo's
+Horovod-parity DP strategies.  XLA owns the ring's internals, so
+EQuARX's per-hop requantization is not reachable from program level;
+the reachable sound formulation decomposes the all-reduce into the two
+phases whose payload dtype IS program-visible:
+
+  reduce-scatter(mean)  →  all-to-all of (s8 payload, f32 block scales)
+                           + local dequantize/sum/divide
+  all-gather            →  all_gather_invariant of (s8 payload, scales)
+                           + local dequantize
+  all-reduce(mean)      =  the two composed
+
+Quantization is symmetric per-block (``DEFAULT_BLOCK`` elements share
+one f32 max-abs/127 scale, ~1.6% scale overhead at 256), accumulation
+is f32 and local, so there is no integer-overflow ceiling on the world
+size — the s8 payload only ever crosses the wire, never a psum.  Error
+per element is one quantization step per phase: |err| <= blockmax/254
+for each of the scatter and gather stages (pinned by tests).
+
+This module is a *wire format*, not a call-site choice: ``make_train_step``
+and the ZeRO-1 seam resolve the wire per strategy via :func:`resolve`
+(env ``TPUFRAME_WIRE_FORMAT`` > generation-gated tune DB > full
+precision) and emit the decision as a typed ``wire_format`` obs event.
+The format is registered with ``shardflow.register_wire_format`` so the
+f32-under-bf16 wire detector knows s8 payloads are intentional, and a
+TF115 lint rule keeps raw ``lax.p*`` collectives in ``parallel/step.py``
+/ ``parallel/zero1.py`` from bypassing this seam.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpuframe.parallel import collectives
+
+AxisName = str | Sequence[str]
+PyTree = Any
+
+FORMATS = ("fp", "int8-block")
+ENV_VAR = "TPUFRAME_WIRE_FORMAT"
+
+# Elements per shared f32 scale: 4/256 = 1.6% wire overhead, small
+# enough that the budget ratio tests treat it as the documented slack.
+DEFAULT_BLOCK = 256
+# Leaves smaller than this stay full precision: a 4x cut on a sub-KiB
+# bias is noise on the wire but doubles its collective count (payload +
+# scales), and the derived-budget floors are sized to ignore fp strays.
+MIN_QUANT_ELEMS = 1024
+_QMAX = 127.0
+
+# Pre-vma jax (< 0.6, legacy shard_map with check_rep=False) tracks no
+# replication state: every leaf inside the map is local, so treat all
+# bound axes as varying and skip the pcast/clear bookkeeping entirely.
+_HAS_VMA = hasattr(jax, "typeof") and hasattr(lax, "pcast")
+
+
+# ---------------------------------------------------------------------------
+# Format selection: env > tuning DB > default (zero1.resolve's chain).
+# ---------------------------------------------------------------------------
+
+
+def validate_format(fmt: str) -> str:
+    fmt = (fmt or "fp").strip().lower()
+    if fmt not in FORMATS:
+        raise ValueError(f"unknown wire format {fmt!r}; "
+                         f"expected one of {FORMATS} ({ENV_VAR})")
+    return fmt
+
+
+def format_from_env(env=os.environ) -> str | None:
+    """The explicit ``TPUFRAME_WIRE_FORMAT`` override, or None."""
+    raw = env.get(ENV_VAR, "").strip()
+    return validate_format(raw) if raw else None
+
+
+def resolve(program: str | None = None, family: str | None = None,
+            default: str = "fp") -> tuple:
+    """``(format, source)`` for a step program: env override > tuning-DB
+    winner (generation-gated; family ``wire_format_*`` persisted by
+    ``python -m tpuframe.tune sweep --wire``) > ``default``.  ``source``
+    is ``env``/``tune_db``/``default`` — emitted in the ``wire_format``
+    run event so wire provenance is always on record."""
+    env_val = format_from_env()
+    if env_val is not None:
+        return env_val, "env"
+    if program or family:
+        from tpuframe.tune import db as tune_db
+
+        db_val = tune_db.resolve_wire_format(program or "", family=family)
+        if db_val is not None:
+            try:
+                return validate_format(str(db_val)), "tune_db"
+            except ValueError:
+                pass  # a stale DB row must never break a run
+    return validate_format(default), "default"
+
+
+# ---------------------------------------------------------------------------
+# Block quantize / dequantize (local, f32 <-> s8 + f32 scales).
+# ---------------------------------------------------------------------------
+
+
+def quantize_blocks(flat: jax.Array, block: int = DEFAULT_BLOCK):
+    """Symmetric per-block s8 quantization of a flat f32 array whose size
+    is a multiple of ``block``: returns ``(q s8 [m/block, block],
+    scales f32 [m/block])`` with ``scale = max|row|/127`` (an all-zero
+    block keeps scale 0 and dequantizes to exact zeros)."""
+    rows = flat.reshape(-1, block)
+    scales = jnp.max(jnp.abs(rows), axis=1) / _QMAX
+    safe = jnp.where(scales == 0.0, 1.0, scales)
+    q = jnp.clip(jnp.round(rows / safe[:, None]), -_QMAX, _QMAX)
+    return q.astype(jnp.int8), scales.astype(jnp.float32)
+
+
+def dequantize_blocks(q: jax.Array, scales: jax.Array) -> jax.Array:
+    """Inverse of :func:`quantize_blocks` (same ``[rows, block]`` shape)."""
+    return q.astype(jnp.float32) * scales[..., None]
+
+
+def _pad_to(flat: jax.Array, multiple: int) -> jax.Array:
+    pad = (-flat.size) % multiple
+    return jnp.pad(flat, (0, pad)) if pad else flat
+
+
+def _axis_prod(names: tuple[str, ...]) -> int:
+    n = 1
+    for a in names:
+        n *= lax.axis_size(a)
+    return n
+
+
+def _require_flat(x: jax.Array, who: str) -> None:
+    if x.ndim != 1:
+        raise ValueError(f"{who} takes a flat 1-D operand (the zero1 "
+                         f"pad-to-multiple layout), got shape "
+                         f"{tuple(x.shape)}; reshape(-1) first")
+
+
+# ---------------------------------------------------------------------------
+# The three quantized collectives.
+# ---------------------------------------------------------------------------
+
+
+def _rs_mean_flat(flat: jax.Array, axes: tuple[str, ...], n: int,
+                  block: int) -> jax.Array:
+    """Quantized reduce-scatter(mean) of an f32 ``(n*c,)`` operand over
+    ``axes`` (member count ``n``): returns this replica's ``(c,)`` mean
+    shard in f32.  Chunk ownership matches ``lax.psum_scatter(tiled=True)``
+    — contiguous chunk *i* to linearized member *i* — so zero1's
+    dynamic-slice/regather index math is unchanged by the wire swap."""
+    c = flat.size // n
+    rows = flat.reshape(n, c)
+    nb = -(-c // block)
+    if nb * block != c:
+        rows = jnp.pad(rows, ((0, 0), (0, nb * block - c)))
+    q, scales = quantize_blocks(rows.reshape(-1), block)
+    q = q.reshape(n, nb, block)
+    scales = scales.reshape(n, nb)
+    # The exchange: member i keeps row i of every source — each source's
+    # scales travel with its payload, so dequantization is per-source.
+    q = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=True)
+    scales = lax.all_to_all(scales, axes, split_axis=0, concat_axis=0,
+                            tiled=True)
+    total = jnp.sum(dequantize_blocks(q, scales), axis=0)  # f32 accumulate
+    return total.reshape(-1)[:c] / n
+
+
+def _gather_flat(shard: jax.Array, axes: tuple[str, ...],
+                 block: int) -> jax.Array:
+    """Quantized tiled all-gather of an f32 ``(c,)`` shard over ``axes``:
+    returns the replication-invariant ``(n*c,)`` full vector in f32
+    (per-source block padding stripped after the gather)."""
+    c = shard.size
+    nb = -(-c // block)
+    q, scales = quantize_blocks(_pad_to(shard, block), block)
+    gq = collectives.allgather_invariant(q, axes, gather_axis=0)
+    gs = collectives.allgather_invariant(scales, axes, gather_axis=0)
+    n = gq.shape[0] // nb
+    full = dequantize_blocks(gq, gs).reshape(n, nb * block)
+    return full[:, :c].reshape(-1)
+
+
+def reduce_scatter_mean(x: jax.Array, axis: AxisName = "data", *,
+                        block: int = DEFAULT_BLOCK) -> jax.Array:
+    """Block-quantized twin of ``collectives.reduce_scatter(average=True)``
+    on a flat operand: s8 payload + f32 scales over all-to-all, f32
+    accumulation locally (no integer psum, so no world-size overflow
+    ceiling).  Same divisibility contract and chunk ownership as
+    psum_scatter; result dtype matches the input.  Unmapped or world of
+    1: the full-precision path (nothing on the wire to shrink)."""
+    bound = collectives._bound_axes(axis)
+    if not bound:
+        return x
+    _require_flat(x, "quantwire.reduce_scatter_mean")
+    n = _axis_prod(bound)
+    if x.size % n:
+        raise ValueError(
+            f"quantwire.reduce_scatter_mean: size {x.size} is not "
+            f"divisible by the {n}-member axis {bound}; pad to a "
+            f"multiple of {n} first (zero1's pad-to-multiple layout)")
+    if n == 1:
+        return collectives.reduce_scatter(x, bound, average=True)
+    flat = x.astype(jnp.float32)
+    if _HAS_VMA:
+        flat = collectives._vary_over(flat, collectives._sized_axes(bound))
+    return _rs_mean_flat(flat, bound, n, block).astype(x.dtype)
+
+
+def all_gather(x: jax.Array, axis: AxisName = "data", *,
+               block: int = DEFAULT_BLOCK) -> jax.Array:
+    """Block-quantized twin of the tiled invariant all-gather on a flat
+    shard: every replica reconstructs the identical (invariant) full
+    vector from s8 payloads + scales.  Result dtype matches the input.
+    Unmapped or world of 1: plain invariant gather."""
+    bound = collectives._bound_axes(axis)
+    if not bound:
+        return x
+    _require_flat(x, "quantwire.all_gather")
+    if _axis_prod(bound) == 1:
+        return collectives.allgather_invariant(x, bound)
+    return _gather_flat(x.astype(jnp.float32), bound, block).astype(x.dtype)
+
+
+def all_reduce_mean(tree: PyTree, axis: AxisName = "data", *,
+                    block: int = DEFAULT_BLOCK,
+                    min_elems: int = MIN_QUANT_ELEMS) -> PyTree:
+    """Block-quantized cross-replica gradient mean — the ``int8-block``
+    wire for the plain-DP grad all-reduce, composed from the scatter and
+    gather phases above (each phase moves ~1/4 the f32 bytes).
+
+    Keeps ``average_gradients``' vma contract: varying leaves take the
+    quantized reduce, bound-but-unvarying (presummed) leaves are divided
+    by their axis size, size-1 axes are cleared so results come back
+    invariant over ALL bound axes.  Leaves under ``min_elems`` (and any
+    world-of-1 reduction) stay full precision via ``lax.pmean``.  Error
+    per element: one quantization step per phase, <= 2·blockmax/254.
+    """
+    names = collectives._bound_axes(axis)
+    if not names:
+        return tree
+
+    def _qmean(g):
+        vma = jax.typeof(g).vma if _HAS_VMA else frozenset(names)
+        varying = tuple(a for a in names if a in vma)
+        size_presummed = _axis_prod(tuple(a for a in names if a not in vma))
+        if not varying:
+            return g / size_presummed if size_presummed > 1 else g
+        sized = collectives._sized_axes(varying)
+        n = _axis_prod(sized)
+        if n == 1 or g.size < max(min_elems, 1):
+            out = lax.pmean(g, varying)
+        else:
+            flat = _pad_to(g.astype(jnp.float32).reshape(-1), n)
+            if _HAS_VMA:
+                flat = collectives._vary_over(flat, sized)
+            shard = _rs_mean_flat(flat, sized, n, block)
+            full = _gather_flat(shard, sized, block)
+            out = full[:g.size].reshape(g.shape)
+            if _HAS_VMA:
+                out = collectives._clear_unit_axes(out, names)
+        if size_presummed > 1:
+            out = out / size_presummed
+        return out.astype(g.dtype)
+
+    return jax.tree.map(_qmean, tree)
+
+
+# ---------------------------------------------------------------------------
+# Analysis-gate self-check.
+# ---------------------------------------------------------------------------
+
+# Files whose gradient-path collectives must route through this wire
+# seam — TF115's scope, self-linted so the gate fails closed if a raw
+# lax.psum/all_gather/psum_scatter/ppermute sneaks past the resolved
+# format (the dual of zero1's TF110 optimizer-seam self-lint).
+_TF115_SELF_LINT = (
+    os.path.join("parallel", "step.py"),
+    os.path.join("parallel", "zero1.py"),
+)
+
+
+def check() -> list:
+    """Self-check for the ``python -m tpuframe.analysis`` CI gate.
+    Returns problem strings; [] means healthy."""
+    problems: list[str] = []
+    # 1. the format registry and env parsing agree
+    for f in FORMATS:
+        try:
+            validate_format(f)
+        except Exception as e:  # noqa: BLE001 — report, don't crash CI
+            problems.append(f"format {f!r} failed validation: {e}")
+    try:
+        format_from_env()
+    except ValueError as e:
+        problems.append(f"{ENV_VAR} is set to an invalid format: {e}")
+    # 2. quantize/dequantize round-trip honors the per-block error bound
+    x = jnp.linspace(-3.0, 3.0, 2 * DEFAULT_BLOCK, dtype=jnp.float32)
+    q, s = quantize_blocks(x, DEFAULT_BLOCK)
+    err = float(jnp.max(jnp.abs(dequantize_blocks(q, s).reshape(-1) - x)))
+    bound = float(jnp.max(jnp.abs(x))) / (2 * _QMAX) * 1.001
+    if err > bound:
+        problems.append(f"round-trip error {err:.3e} exceeds the "
+                        f"blockmax/254 bound {bound:.3e}")
+    # 3. the wire format is declared to the shardflow dtype detector
+    from tpuframe.analysis import shardflow
+
+    if "int8-block" not in shardflow.registered_wire_formats():
+        problems.append("'int8-block' is not registered with "
+                        "shardflow.register_wire_format — an s8 payload "
+                        "under a float wire would read as undeclared")
+    # 4. TF115 self-lint: gradient-path collectives stay at the seam
+    from tpuframe.analysis.source_lint import lint_paths
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.join(pkg_root, p) for p in _TF115_SELF_LINT]
+    for f in lint_paths([p for p in paths if os.path.exists(p)]):
+        if f.rule == "TF115":
+            problems.append(f"self-lint: {f}")
+    return problems

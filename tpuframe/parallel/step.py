@@ -83,6 +83,7 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
                accum_steps: int,
                grad_reduce: str,
                weight_update: str,
+               wire_format: str,
                state: TrainState, batch: PyTree):
     """Shared body for both modes. ``axes`` bound ⇒ explicit collectives."""
     step_rng = jax.random.fold_in(state.rng, state.step)
@@ -94,7 +95,7 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     if accum_steps > 1:
         return _accum_grad_step(loss_fn, tx, axes, fusion_threshold,
                                 accum_steps, grad_reduce, weight_update,
-                                state, batch, step_rng)
+                                wire_format, state, batch, step_rng)
 
     # The reference's raison d'être: synchronous gradient averaging.
     # Horovod: per-tensor async NCCL ring-allreduce with fusion buffer.
@@ -124,18 +125,26 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     # run.  On new jax the params are pcast varying like the explicit
     # path; on legacy shard_map local grads come free (below).
     zero1 = bool(axes) and weight_update == "zero1"
+    # A quantized wire on the plain-DP path ALSO needs LOCAL grads: the
+    # per-replica gradients are what gets block-quantized before the
+    # exchange (tpuframe.parallel.quantwire), so the implicit
+    # pmean-of-loss transpose (which would pre-reduce in f32) must not
+    # run.  The zero1 tail already takes local grads; its wire choice
+    # lives inside sharded_update.
+    wire_local = bool(axes) and wire_format != "fp" and not zero1
     # Legacy shard_map (check_rep=False) has no psum-transpose rewrite:
     # differentiating the pmean-ed loss there yields LOCAL grads with no
     # implicit reduction, so the reduction must be explicit.
     legacy_local = bool(axes) and _LEGACY_SHARD_MAP and not explicit
     diff_params = state.params
-    if explicit or (zero1 and not _LEGACY_SHARD_MAP):
+    if explicit or ((zero1 or wire_local) and not _LEGACY_SHARD_MAP):
         diff_params = jax.tree.map(
             lambda p: lax.pcast(p, axes, to="varying"), state.params)
 
     def global_loss(params, model_state, batch, rng):
         loss, aux = loss_fn(params, model_state, batch, rng)
-        if axes and not explicit and not legacy_local and not zero1:
+        if (axes and not explicit and not legacy_local and not zero1
+                and not wire_local):
             loss = lax.pmean(loss, axes)
         return loss, aux
 
@@ -143,20 +152,21 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
         global_loss, has_aux=True)(diff_params, state.model_state, batch, step_rng)
 
     return _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce,
-                             weight_update, state,
+                             weight_update, wire_format, state,
                              grads, loss, metrics, model_state,
-                             reduce_grads=explicit or legacy_local or zero1)
+                             reduce_grads=(explicit or legacy_local or zero1
+                                           or wire_local))
 
 
 def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, weight_update,
-                      state, grads,
+                      wire_format, state, grads,
                       loss, metrics, model_state, *, reduce_grads: bool):
     """Shared step tail: cross-replica reductions + optimizer update.
 
     ``reduce_grads``: True when ``grads``/``loss`` are still per-replica
-    (explicit-fusion, adasum, zero1 and accumulation paths); False when
-    the pmean-of-loss transpose already reduced them (the implicit
-    default)."""
+    (explicit-fusion, adasum, zero1, quantized-wire and accumulation
+    paths); False when the pmean-of-loss transpose already reduced them
+    (the implicit default)."""
     if weight_update == "zero1" and axes:
         # ZeRO-1 tail: NO gradient all-reduce — the grads stay local and
         # zero1.sharded_update's reduce-scatter performs the one and only
@@ -171,7 +181,8 @@ def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, weight_update,
         grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads,
                              state.params)
         params, opt_state, grad_norm = zero1_lib.sharded_update(
-            tx, axes, state.params, state.opt_state, grads)
+            tx, axes, state.params, state.opt_state, grads,
+            wire_format=wire_format)
         metrics = dict(metrics)
         metrics["loss"] = loss
         metrics["grad_norm"] = grad_norm
@@ -188,6 +199,10 @@ def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, weight_update,
 
             grads = fusion.fused_pmean(grads, axes,
                                        threshold_bytes=fusion_threshold)
+        elif wire_format == "int8-block":
+            from tpuframe.parallel import quantwire
+
+            grads = quantwire.all_reduce_mean(grads, axes)
         else:
             grads = jax.tree.map(lambda g: lax.pmean(g, axes), grads)
         loss = lax.pmean(loss, axes)
@@ -213,7 +228,8 @@ def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, weight_update,
 
 
 def _accum_grad_step(loss_fn, tx, axes, fusion_threshold, accum_steps,
-                     grad_reduce, weight_update, state, batch, step_rng):
+                     grad_reduce, weight_update, wire_format, state, batch,
+                     step_rng):
     """Gradient accumulation — Horovod's ``backward_passes_per_step``
     (DistributedOptimizer option; the reference's recipe for batches that
     exceed device memory).  The local batch is split into ``accum_steps``
@@ -278,7 +294,7 @@ def _accum_grad_step(loss_fn, tx, axes, fusion_threshold, accum_steps,
     metrics = jax.tree.map(lambda m: m / accum_steps, metrics)
 
     return _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce,
-                             weight_update, state,
+                             weight_update, wire_format, state,
                              grads, loss, metrics, model_state,
                              reduce_grads=True)
 
@@ -299,6 +315,7 @@ def make_train_step(
     compiler_options: dict | None = None,
     remat_policy: str | None = None,
     weight_update: str = "replicated",
+    wire_format: str = "fp",
 ):
     """Build the compiled train step.
 
@@ -361,7 +378,36 @@ def make_train_step(
     already shards the update).  Resolution (env
     ``TPUFRAME_WEIGHT_UPDATE`` > tuning DB > default) is the caller's job
     via ``zero1.resolve``.
+
+    ``wire_format``: ``"fp"`` (default — gradient-path collectives move
+    full-precision payloads) or ``"int8-block"``
+    (:mod:`tpuframe.parallel.quantwire`, arXiv:2506.17615): per-replica
+    gradients are block-quantized (s8 payload + per-256-element f32
+    scales, ~4x fewer wire bytes) before the cross-replica exchange; on
+    the zero1 path both the gradient reduce-scatter and the param-delta
+    all-gather take the quantized wire.  shard_map mode with a mesh only
+    (auto-SPMD inserts its own collectives; ``mesh=None`` has no wire,
+    so the format is ignored — the world-of-1 no-op contract); does not
+    compose with ``fusion_threshold``/``adasum`` (each is its own wire
+    pattern).  Resolution (env ``TPUFRAME_WIRE_FORMAT`` > tuning DB >
+    default) is the caller's job via ``quantwire.resolve``.
     """
+    from tpuframe.parallel import quantwire
+
+    wire_format = quantwire.validate_format(wire_format)
+    if wire_format != "fp":
+        if state_shardings is not None or mode != "shard_map":
+            raise ValueError(f"wire_format={wire_format!r} needs shard_map "
+                             f"mode — auto-SPMD programs have no explicit "
+                             f"collectives to quantize")
+        if grad_reduce == "adasum":
+            raise ValueError(f"wire_format={wire_format!r} does not compose "
+                             f"with adasum — the butterfly is its own wire "
+                             f"pattern")
+        if fusion_threshold is not None:
+            raise ValueError(f"wire_format={wire_format!r} does not compose "
+                             f"with fusion_threshold — the fusion buffers "
+                             f"pack full-precision payloads")
     weight_update = (weight_update or "replicated").strip().lower()
     if weight_update not in ("replicated", "zero1"):
         raise ValueError(f"unknown weight_update {weight_update!r}; "
@@ -398,9 +444,10 @@ def make_train_step(
                          "fusion_threshold — the butterfly is its own wire "
                          "pattern")
     if mesh is None:
-        # World of 1: adasum degrades to identity like every collective.
+        # World of 1: adasum degrades to identity like every collective,
+        # and there is no wire for a format to shrink.
         body = functools.partial(_grad_step, loss_fn, tx, None, None,
-                                 accum_steps, "mean", "replicated")
+                                 accum_steps, "mean", "replicated", "fp")
         return jax.jit(body, donate_argnums=(0,) if donate else (),
                        compiler_options=compiler_options)
 
@@ -428,7 +475,7 @@ def make_train_step(
                              "auto-SPMD has no per-replica grads to combine")
         # Auto-SPMD: annotate shardings, let the partitioner insert collectives.
         body = functools.partial(_grad_step, loss_fn, tx, None, None,
-                                 accum_steps, "mean", "replicated")
+                                 accum_steps, "mean", "replicated", "fp")
         state_sh = repl if state_shardings is None else state_shardings
         return jax.jit(
             body,
@@ -442,7 +489,8 @@ def make_train_step(
         raise ValueError(f"unknown step mode {mode!r}")
 
     body = functools.partial(_grad_step, loss_fn, tx, axes, fusion_threshold,
-                             accum_steps, grad_reduce, weight_update)
+                             accum_steps, grad_reduce, weight_update,
+                             wire_format)
     if weight_update == "zero1":
         from tpuframe.parallel import zero1 as zero1_lib
 

@@ -305,22 +305,21 @@ def _psum_marked(x, bound: tuple[str, ...]):
         ax = tuple(a for a in bound if a in jax.typeof(x).vma)
     else:
         ax = collectives._sized_axes(bound)
-    return lax.psum(x, ax) if ax else x
+    # Scalar grad-norm reduction: always under every wire's size floor.
+    return lax.psum(x, ax) if ax else x  # tf-lint: ok[TF115] scalar reduce
 
 
 def _gather_full(shard: jax.Array, bound: tuple[str, ...]) -> jax.Array:
     """Tiled all-gather of the updated param shard, marked replication-
     invariant where this jax can express it (every replica gathers the
     identical full vector)."""
-    gather = getattr(lax, "all_gather_invariant", None)
-    if gather is not None and _HAS_VMA:
-        return gather(shard, bound, axis=0, tiled=True)
-    return lax.all_gather(shard, bound, axis=0, tiled=True)
+    return collectives.allgather_invariant(shard, bound)
 
 
 def sharded_update(tx: optax.GradientTransformation, axes,
                    params: PyTree, opt_state: PyTree,
-                   grads: PyTree) -> tuple[PyTree, PyTree, jax.Array]:
+                   grads: PyTree, *,
+                   wire_format: str = "fp") -> tuple[PyTree, PyTree, jax.Array]:
     """reduce-scatter → 1/n optimizer update → all-gather.
 
     Called from the step tail with LOCAL per-replica gradients (the step
@@ -328,7 +327,21 @@ def sharded_update(tx: optax.GradientTransformation, axes,
     ``(new_params, new_opt_state, grad_norm)``; ``opt_state`` is the
     per-replica shard view (``[padded/n]`` moments) and comes back in the
     same layout.  The reduce-scatter averages, so the update consumes the
-    same global mean gradient as the replicated path."""
+    same global mean gradient as the replicated path.
+
+    ``wire_format="int8-block"`` (tpuframe.parallel.quantwire,
+    arXiv:2506.17615) swaps both gradient-sized collectives for their
+    block-quantized twins.  The scatter quantizes the local gradient —
+    ordinary gradient noise.  The gather CANNOT quantize the raw params:
+    the gathered vector overwrites the replicated master copy, so 8-bit
+    re-gridding there would quantize the *weights* themselves every
+    step.  Instead it gathers the quantized update DELTA
+    (``new_shard - shard``) and adds it to the replicated old params —
+    masters keep full-precision accumulation, the per-step wire error is
+    bounded by one quantization step of the (small) update, and the
+    invariant-old + invariant-gather sum stays replication-invariant.
+    Leaves under ``quantwire.MIN_QUANT_ELEMS`` keep the fp wire on both
+    sides (the derived-budget floors are sized to ignore them)."""
     bound = collectives._bound_axes(axes)
     if not bound:
         # World of 1 (unmapped): the sharded path degenerates to the
@@ -346,12 +359,22 @@ def sharded_update(tx: optax.GradientTransformation, axes,
         pad = _padded(flat.size, n) - flat.size
         return jnp.pad(flat, (0, pad)) if pad else flat
 
+    from tpuframe.parallel import quantwire
+
+    def quantized(g):
+        return (wire_format == "int8-block"
+                and _padded(_size(g), n) >= quantwire.MIN_QUANT_ELEMS)
+
     # Grads in: ONE reduce-scatter per leaf (operand = padded grad bytes
     # — the wire cost the dp-zero1 CommBudget declares), averaging over
-    # the world.  Zero padding reduces to zero.
-    gshard = jax.tree.map(
-        lambda g: collectives.reduce_scatter(flat_pad(g), bound,
-                                             average=True), grads)
+    # the world.  Zero padding reduces to zero.  On the int8 wire the
+    # operand is the s8 payload + scales instead (~1/4 the bytes).
+    def scatter(g):
+        if quantized(g):
+            return quantwire.reduce_scatter_mean(flat_pad(g), bound)
+        return collectives.reduce_scatter(flat_pad(g), bound, average=True)
+
+    gshard = jax.tree.map(scatter, grads)
     # Params are replicated, so each replica's shard is a free local
     # slice at the same row-major linear index the scatter used.
     def param_shard(t):
@@ -370,12 +393,18 @@ def sharded_update(tx: optax.GradientTransformation, axes,
     grad_norm = jnp.sqrt(_psum_marked(sq, bound))
 
     # Params out: tiled all-gather (result = padded param bytes), then
-    # un-pad and fold back to the original shapes.
-    def regather(shard, like):
-        full = _gather_full(shard, bound)
+    # un-pad and fold back to the original shapes.  On the int8 wire the
+    # update DELTA is gathered quantized and added to the replicated old
+    # params (see docstring — masters never lose precision).
+    def regather(old_shard, shard, like):
+        if quantized(like):
+            delta = quantwire.all_gather(shard - old_shard, bound)
+            full = flat_pad(like) + delta.astype(like.dtype)
+        else:
+            full = _gather_full(shard, bound)
         return full[:_size(like)].reshape(like.shape)
 
-    new_params = jax.tree.map(regather, new_pshard, params)
+    new_params = jax.tree.map(regather, pshard, new_pshard, params)
     return new_params, new_opt, grad_norm
 
 

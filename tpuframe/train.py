@@ -140,6 +140,9 @@ class Harness:
     # (mode, resolution source) from tpuframe.parallel.zero1.resolve —
     # ("replicated", "default") when nothing elected weight-update sharding.
     weight_update: tuple = ("replicated", "default")
+    # (format, resolution source) from tpuframe.parallel.quantwire.resolve
+    # — ("fp", "default") when nothing elected a quantized wire.
+    wire_format: tuple = ("fp", "default")
 
 
 def build_harness(cfg: TrainConfig) -> Harness:
@@ -246,6 +249,23 @@ def build_harness(cfg: TrainConfig) -> Harness:
                  or cfg.grad_reduce == "adasum")):
         weight_update, wu_source = "replicated", "default"
 
+    # Gradient-path wire format (int8-block quantized collectives): same
+    # resolution shape — TPUFRAME_WIRE_FORMAT env wins, else the DB's
+    # offline wire_format_* sweep winner (generation-gated), else full
+    # precision.  Same fallback discipline too: on configs the quantized
+    # wire cannot serve (pp, auto-SPMD sharded state, no mesh, adasum) a
+    # DB-elected format falls back silently while an explicit env ask
+    # gets make_train_step's specific error.
+    from tpuframe.parallel import quantwire
+
+    wire_format, wf_source = quantwire.resolve(
+        program=f"train_{model_tag}_b{cfg.global_batch}",
+        family=f"wire_format_{model_tag}")
+    if (wire_format != "fp" and wf_source != "env"
+            and (use_pp or use_sharded_state or mesh is None
+                 or cfg.grad_reduce == "adasum")):
+        wire_format, wf_source = "fp", "default"
+
     if use_pp:
         # Pipeline parallelism: ScanBlockLM blocks + opt state sharded over
         # the pipe axis, GPipe microbatching (tpuframe.parallel.pp_lm).
@@ -271,6 +291,10 @@ def build_harness(cfg: TrainConfig) -> Harness:
             raise ValueError("TPUFRAME_WEIGHT_UPDATE=zero1 is the plain-DP "
                              "shard_map path; the pipeline step owns its "
                              "own stage-sharded update")
+        if wire_format != "fp":
+            raise ValueError("TPUFRAME_WIRE_FORMAT=int8-block is the "
+                             "plain-DP shard_map path; the pipeline step "
+                             "owns its own cross-stage communication")
         from tpuframe.parallel import pp_lm
 
         factory, place_state, _ = pp_lm.make_pp_lm_step(
@@ -318,15 +342,23 @@ def build_harness(cfg: TrainConfig) -> Harness:
         if xla_opts is None:
             xla_opts = tune_db.resolve_xla_opts(cfg.name,
                                                 family="train_step")
+        fusion_threshold = tuning.step_threshold()
+        if (wire_format != "fp" and wf_source != "env"
+                and (fusion_threshold or cfg.grad_reduce == "adasum")):
+            # Explicit-fusion mode reduces bucket-by-bucket inside the
+            # step; the quantized wire only serves the implicit/zero1
+            # paths.  A DB-elected format demotes silently here too.
+            wire_format, wf_source = "fp", "default"
         train_step = step_lib.make_train_step(
             loss_fn, tx, mesh, batch_partition=step_part,
             reduce_axes=reduce_axes, state_shardings=state_shardings,
-            fusion_threshold=tuning.step_threshold(),
+            fusion_threshold=fusion_threshold,
             accum_steps=cfg.accum_steps,
             grad_reduce=cfg.grad_reduce,
             compiler_options=xla_opts,
             remat_policy=step_policy,
-            weight_update=weight_update)
+            weight_update=weight_update,
+            wire_format=wire_format)
         eval_step = step_lib.make_eval_step(
             make_metric_fn(cfg, model), mesh, batch_partition=step_part,
             reduce_axes=reduce_axes, state_shardings=state_shardings)
@@ -359,7 +391,8 @@ def build_harness(cfg: TrainConfig) -> Harness:
                    train_loader=train_loader, eval_loader=eval_loader,
                    manager=manager, start_step=start_step,
                    remat_policy=(remat_policy, remat_source),
-                   weight_update=(weight_update, wu_source))
+                   weight_update=(weight_update, wu_source),
+                   wire_format=(wire_format, wf_source))
 
 
 def _lm_reduce_axis(cfg: TrainConfig, *, for_grad: bool):
@@ -926,6 +959,12 @@ def _train_impl(cfg: TrainConfig, *, trace_dir: str | None = None,
             source=h.weight_update[1],
             n_shards=(zero1_lib.world_size(h.mesh)
                       if h.mesh is not None else 1))
+        # Wire-format provenance, same contract: which gradient-path
+        # wire the run actually compiled with and who elected it — the
+        # analyzer joins this with the roofline's comm model to check
+        # the predicted byte drop landed.
+        events_lib.emit("wire_format", format=h.wire_format[0],
+                        source=h.wire_format[1])
         run_info["devmem"] = devmem_lib.DevmemSampler(
             interval_s=float(os.environ.get("TPUFRAME_DEVMEM_INTERVAL_S",
                                             "30"))).start()

@@ -4,6 +4,7 @@
     python -m tpuframe.tune sweep --remat               # remat policy search
     python -m tpuframe.tune sweep --serve               # serving decode grid
     python -m tpuframe.tune sweep --zero1               # weight-update sharding
+    python -m tpuframe.tune sweep --wire                # wire-format search
     python -m tpuframe.tune show                        # ranked DB contents
     python -m tpuframe.tune check                       # CI self-check
 
@@ -63,6 +64,11 @@ def _cmd_sweep(args) -> int:
         search.zero1_sweep(args.topology, db_path=args.db,
                            report_path=args.report,
                            batch=args.zero1_batch)
+        return 0
+    if args.wire:
+        search.wire_sweep(args.topology, db_path=args.db,
+                          report_path=args.report,
+                          batch=args.wire_batch)
         return 0
     search.sweep(args.topology, db_path=args.db, report_path=args.report,
                  seq=args.seq, head_dim=args.head_dim,
@@ -138,6 +144,12 @@ def main(argv=None) -> int:
                          "ZeRO-1) over the donated ResNet-50 + BERT train "
                          "steps (weight_update_* families)")
     sw.add_argument("--zero1-batch", type=int, default=512)
+    sw.add_argument("--wire", action="store_true",
+                    help="sweep gradient-path wire formats (fp vs "
+                         "int8-block quantized collectives) over the "
+                         "donated ResNet-50 DP + BERT ZeRO-1 train steps "
+                         "(wire_format_* families)")
+    sw.add_argument("--wire-batch", type=int, default=512)
     sw.add_argument("--remat-policies", nargs="+", default=None,
                     metavar="POLICY")
     sw.set_defaults(fn=_cmd_sweep)

@@ -142,6 +142,8 @@ class Record:
             env["TPUFRAME_REMAT_POLICY"] = str(cfg["remat_policy"])
         if "weight_update" in cfg:
             env["TPUFRAME_WEIGHT_UPDATE"] = str(cfg["weight_update"])
+        if "wire_format" in cfg:
+            env["TPUFRAME_WIRE_FORMAT"] = str(cfg["wire_format"])
         if "decode_block" in cfg:
             env["TPUFRAME_DECODE_BLOCK"] = str(cfg["decode_block"])
         if cfg.get("prompt_buckets"):
@@ -405,6 +407,31 @@ def resolve_weight_update(program: str,
         return None
     mode = rec.config.get("weight_update")
     return str(mode) if mode else None
+
+
+def resolve_wire_format(program: str,
+                        family: str | None = None) -> str | None:
+    """Gradient-path collective wire format for ``program``: None unless
+    the DB has a swept ``wire_format_*`` winner for the target
+    generation.  Callers apply ``TPUFRAME_WIRE_FORMAT`` themselves FIRST
+    via :func:`tpuframe.parallel.quantwire.resolve` — when the env var is
+    set this returns None so the override is unambiguous."""
+    if os.environ.get("TPUFRAME_WIRE_FORMAT", "").strip():
+        return None
+    gen = target_generation()
+    if gen is None:
+        return None
+    db = _open_for_resolution()
+    if db is None:
+        return None
+    rec = db.best(program=program, generation=gen)
+    if (rec is None or "wire_format" not in rec.config) \
+            and family is not None:
+        rec = db.best(family=family, generation=gen)
+    if rec is None:
+        return None
+    fmt = rec.config.get("wire_format")
+    return str(fmt) if fmt else None
 
 
 def resolve_decode_block(default: int = 128) -> int:

@@ -36,6 +36,7 @@ class Hardware:
     bf16_flops: float      # peak bf16 FLOPs/s (MXU)
     hbm_bytes_per_s: float  # peak HBM bandwidth, bytes/s
     hbm_capacity_bytes: float  # usable HBM per chip, bytes
+    ici_bytes_per_s: float = 0.0  # aggregate ICI bandwidth per chip, bytes/s
 
 
 # Sources: v5e column = PERF.md §2 (197e12 / 0.81e12 / 15.75 GB, the values
@@ -43,11 +44,13 @@ class Hardware:
 # column for v4/v5p/v6e = bench.py BF16_PEAK_FLOPS.  v4 HBM = 1.23 TB/s /
 # 32 GB, v5p = 2.76 TB/s / 95 GB, v6e = 1.64 TB/s / 32 GB (public TPU
 # system specs; only the v5e row is pinned by recorded measurements here).
+# ICI column: aggregate interchip bandwidth per chip from the same public
+# specs — v4 2400 Gbps, v5e 1600 Gbps, v5p 4800 Gbps, v6e 3584 Gbps.
 HARDWARE = {
-    "v4": Hardware("v4", 275e12, 1.23e12, 32.0 * 1e9),
-    "v5e": Hardware("v5e", 197e12, 0.81e12, 15.75 * 1e9),
-    "v5p": Hardware("v5p", 459e12, 2.76e12, 95.0 * 1e9),
-    "v6e": Hardware("v6e", 918e12, 1.64e12, 32.0 * 1e9),
+    "v4": Hardware("v4", 275e12, 1.23e12, 32.0 * 1e9, 300e9),
+    "v5e": Hardware("v5e", 197e12, 0.81e12, 15.75 * 1e9, 200e9),
+    "v5p": Hardware("v5p", 459e12, 2.76e12, 95.0 * 1e9, 600e9),
+    "v6e": Hardware("v6e", 918e12, 1.64e12, 32.0 * 1e9, 448e9),
 }
 
 
@@ -160,6 +163,59 @@ def decode_score(*, param_bytes: float, kv_bytes_per_token: float,
     )
 
 
+# Ring-algorithm wire multipliers on (n-1)/n * bytes: an all-reduce moves
+# every byte twice (reduce-scatter phase + all-gather phase); the one-phase
+# collectives move it once.  collective-permute is a single neighbor hop.
+_COMM_RING_FACTORS = {
+    "all-reduce": 2.0,
+    "all-gather": 1.0,
+    "reduce-scatter": 1.0,
+    "all-to-all": 1.0,
+    "collective-permute": 1.0,
+}
+
+
+def comm_ms(generation: str, kind: str, nbytes: float,
+            n_devices: int) -> float:
+    """Predicted ICI milliseconds for one collective: ring model,
+    ``factor * (n-1)/n * bytes / ici_bw``.  ``nbytes`` must be the op's
+    bytes as PARSED FROM THE COMPILED HLO (``hlo_audit``'s ruler — an s8
+    payload counts 1 byte/element), never re-derived from the program's
+    accumulation dtype: a quantized wire moves a quarter of the f32
+    bytes and the prediction has to see that."""
+    hw = get_hardware(generation)
+    if hw.ici_bytes_per_s <= 0 or n_devices <= 1:
+        return 0.0
+    factor = _COMM_RING_FACTORS.get(kind, 1.0)
+    scale = (n_devices - 1) / n_devices
+    return factor * scale * float(nbytes) / hw.ici_bytes_per_s * 1e3
+
+
+def comm_score(generation: str, report, n_devices: int) -> dict:
+    """Per-kind predicted comm rows for one program's collectives.
+
+    ``report`` is an ``hlo_audit.CollectiveReport`` (or anything with
+    ``bytes_by_kind()``).  Wire-dtype awareness comes from the report
+    itself: its byte totals were counted off the optimized HLO's result
+    shapes, so an int8-block program's a2a/all-gather rows carry ~1/4
+    the bytes of the f32 all-reduce they replaced.  ``t_ici_ms`` totals
+    are a LOWER bound (assumes zero overlap loss, full ring bandwidth).
+    """
+    by_kind = report.bytes_by_kind()
+    rows = [
+        {"kind": k, "bytes": int(b),
+         "t_ici_ms": round(comm_ms(generation, k, b, n_devices), 4)}
+        for k, b in sorted(by_kind.items())
+    ]
+    return {
+        "generation": get_hardware(generation).generation,
+        "n_devices": int(n_devices),
+        "rows": rows,
+        "comm_bytes": int(sum(r["bytes"] for r in rows)),
+        "t_ici_ms": round(sum(r["t_ici_ms"] for r in rows), 4),
+    }
+
+
 def contains_scan(hlo_text: str) -> bool:
     """§8 detector: a lowered-to-TPU ``lax.scan`` shows up as an HLO while
     loop.  (Interpret-mode pallas also lowers as a while loop — one more
@@ -217,4 +273,18 @@ def check_tables() -> list:
         problems.append(f"v5e HBM anchor drifted: {s['t_hbm_ms']} != 177.2 ms")
     if s["bound"] != "hbm":
         problems.append("v5e ResNet-50 anchor must be bandwidth-bound")
+    for gen, hw in sorted(HARDWARE.items()):
+        if not hw.ici_bytes_per_s > 0:
+            problems.append(f"hardware table {gen}: non-positive ICI peak")
+    # Comm-model anchor: ResNet-50's 102.23 MB f32 grad all-reduce on a
+    # v5e 2x2 ring is 2 * 3/4 * 1.0223e8 / 200e9 = 0.767 ms, and the same
+    # gradient on the int8-block wire (bytes/4 by the HLO ruler) predicts
+    # exactly a quarter of that — the wire-dtype awareness is the invariant.
+    t_f32 = comm_ms("v5e", "all-reduce", 1.0223e8, 4)
+    t_s8 = comm_ms("v5e", "all-reduce", 1.0223e8 / 4, 4)
+    if abs(t_f32 - 0.767) > 0.005:
+        problems.append(f"v5e comm anchor drifted: {t_f32:.4f} != 0.767 ms")
+    if abs(t_s8 * 4 - t_f32) > 1e-9:
+        problems.append("comm model is not linear in wire bytes — "
+                        "int8 prediction must be f32/4")
     return problems

@@ -439,7 +439,7 @@ def remat_sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
 
 
 def _zero1_step_compile(topo_devices, program: str, batch: int,
-                        weight_update: str):
+                        weight_update: str, wire_format: str = "fp"):
     """AOT-compile one donated train step over the FULL topology under one
     weight-update mode.  Unlike the remat sweep's single-chip rig, the
     collective swap is the whole point here — the reduce-scatter /
@@ -536,11 +536,12 @@ def _zero1_step_compile(topo_devices, program: str, batch: int,
                         for s in jax.tree.leaves(state.opt_state))
 
     step = step_lib.make_train_step(loss_fn, tx, mesh, donate=True,
-                                    weight_update=weight_update)
+                                    weight_update=weight_update,
+                                    wire_format=wire_format)
     compiled = step.lower(state, batch_structs).compile()
     desc = {"program": f"train_{program}_b{batch}", "n_chips": n,
             "global_batch": batch, "donate": True,
-            "weight_update": weight_update}
+            "weight_update": weight_update, "wire_format": wire_format}
     return compiled, desc, opt_bytes, census
 
 
@@ -643,6 +644,115 @@ def zero1_sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
         tag = topology.replace(":", "_").replace("x", "")
         report_path = os.path.join(tune_db.repo_root(), "perf", "results",
                                    f"zero1_report_{tag}.json")
+    os.makedirs(os.path.dirname(report_path), exist_ok=True)
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    _log(f"report: {report_path}", log)
+    return report
+
+
+def wire_sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
+               report_path: str | None = None, batch: int = 512,
+               bert_batch: int = 256, log=None) -> dict:
+    """Offline wire-format search: AOT-compile the donated ResNet-50
+    (plain DP) and BERT (ZeRO-1) train steps once per
+    ``tpuframe.parallel.quantwire`` format over the full topology, rank
+    on the roofline's predicted step time PLUS the ICI comm model's
+    predicted collective time, and persist every candidate to the
+    ``wire_format_*`` DB families.  The comm bytes per row come from the
+    compiled HLO itself (``hlo_audit`` — an s8 payload counts one byte
+    per element), which is what makes the int8-block rows honest: the
+    quantized wire's ~4x byte drop shows up exactly where the program
+    put it (dp's grad all-reduce; ZeRO-1's param all-gather, the +9%
+    BERT leg of PERF §18)."""
+    import jax  # noqa: F401 — fail fast before holding the lock
+    from jax.experimental import topologies
+
+    from tpuframe.analysis import hlo_audit
+
+    hold_aot_lock()
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    gen = roofline.generation_from_topology(topology)
+    topo = topologies.get_topology_desc(topology, platform="tpu")
+    n = len(topo.devices)
+    # dp exercises the all-reduce -> quantized a2a+ag swap; dp-zero1
+    # exercises the rs+ag -> quantized a2a + s8 delta-gather swap.
+    configs = (("resnet50", batch, "replicated"),
+               ("bert", bert_batch, "zero1"))
+    _log(f"wire sweep on {topology} ({n} chips): "
+         f"{[(p, m) for p, _, m in configs]} x ('fp', 'int8-block')", log)
+
+    db_path = db_path or tune_db.default_db_path()
+    db = tune_db.TuningDB.open(db_path) if os.path.exists(db_path) \
+        else tune_db.TuningDB(db_path)
+    report = {"topology": topology, "generation": gen, "n_chips": n,
+              "objective": "predicted_ms + t_ici_ms (comm model on "
+                           "HLO-parsed wire bytes)",
+              "wire_format": {"rows": [], "compile_errors": []}}
+
+    for program, b, mode in configs:
+        baseline = {}
+        for fmt in ("fp", "int8-block"):
+            try:
+                compiled, desc, _opt_bytes, _census = _zero1_step_compile(
+                    topo.devices, program, b, mode, wire_format=fmt)
+            except Exception as e:  # noqa: BLE001 — record, keep sweeping
+                row = {"program": program, "wire_format": fmt,
+                       "weight_update": mode,
+                       "error": f"{type(e).__name__}: {e}"[:300]}
+                report["wire_format"]["compile_errors"].append(row)
+                _log(f"  {program}/{fmt}: COMPILE ERROR "
+                     f"{row['error'][:80]}", log)
+                continue
+            pred = roofline.score_compiled(compiled, gen)
+            pred["source"] = "compiled"
+            coll = hlo_audit.parse_collectives(compiled.as_text())
+            comm = roofline.comm_score(gen, coll.filter(1024), n)
+            pred["comm"] = comm
+            total_ms = round(pred["predicted_ms"] + comm["t_ici_ms"], 3)
+            pred["predicted_total_ms"] = total_ms
+            row = {"program": program, "wire_format": fmt,
+                   "weight_update": mode, "global_batch": b,
+                   "predicted_ms": pred["predicted_ms"],
+                   "t_ici_ms": comm["t_ici_ms"],
+                   "predicted_total_ms": total_ms,
+                   "comm_bytes": comm["comm_bytes"],
+                   "comm_rows": comm["rows"], "bound": pred["bound"]}
+            if fmt == "fp":
+                baseline = {"comm_bytes": comm["comm_bytes"],
+                            "total_ms": total_ms}
+            if baseline.get("comm_bytes"):
+                row["wire_bytes_ratio_vs_fp"] = round(
+                    comm["comm_bytes"] / baseline["comm_bytes"], 3)
+            db.add({"program": desc["program"],
+                    "family": f"wire_format_{program}",
+                    "fingerprint": tune_db.fingerprint(desc),
+                    "topology": topology, "generation": gen,
+                    "config": {"wire_format": fmt, "batch": b,
+                               "weight_update": mode},
+                    "predicted": pred})
+            report["wire_format"]["rows"].append(row)
+            _log(f"  {program}/{fmt}: {row['predicted_total_ms']} ms "
+                 f"total ({row['predicted_ms']} step + {row['t_ici_ms']} "
+                 f"ICI), {comm['comm_bytes'] / 1e6:.2f} MB on the wire",
+                 log)
+
+    rows = report["wire_format"]["rows"]
+    winners = {}
+    for program, _, _ in configs:
+        prog_rows = [r for r in rows if r["program"] == program]
+        prog_rows.sort(
+            key=lambda r: r.get("predicted_total_ms") or float("inf"))
+        if prog_rows:
+            winners[program] = prog_rows[0]
+    report["winners"] = winners
+    db.save()
+    _log(f"tuning DB: {db.path} ({len(db.data['records'])} records)", log)
+    if report_path is None:
+        tag = topology.replace(":", "_").replace("x", "")
+        report_path = os.path.join(tune_db.repo_root(), "perf", "results",
+                                   f"wire_report_{tag}.json")
     os.makedirs(os.path.dirname(report_path), exist_ok=True)
     with open(report_path, "w") as f:
         json.dump(report, f, indent=1, sort_keys=True)
