@@ -214,14 +214,20 @@ def run(batch_per_chip: int, warmup: int, measure: int) -> float:
     from tpuframe.parallel import mesh as mesh_lib
     from tpuframe.parallel import step as step_lib
 
-    n_chips = jax.device_count()
+    # World resolution through the elastic resolver — the single source
+    # of truth shared with train.build_harness, read at call time (never
+    # cached at module level; TF116 enforces the discipline).
+    from tpuframe import elastic
+
+    world = elastic.current_world()
+    n_chips = world.n_devices
+    mesh = world.mesh
     _RESULT["n_chips"] = n_chips
     _RESULT["backend"] = jax.default_backend()
     _RESULT["stage"] = "build"
     _log(f"devices: {n_chips} x {jax.devices()[0].device_kind} "
          f"(backend={jax.default_backend()})")
 
-    mesh = mesh_lib.make_mesh() if n_chips > 1 else None
     global_batch = batch_per_chip * n_chips
 
     # TPUFRAME_BENCH_STEM=space_to_depth A/Bs the MXU-friendly stem

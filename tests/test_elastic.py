@@ -1,0 +1,418 @@
+"""Elastic world-size training: unit coverage for the pieces the 8→4→8
+chaos run composes (tests/test_chaos.py::TestElasticResize).
+
+  * the resharding map — truncate-or-zero-pad exactness, shrink/grow
+    round-trip, movement interval arithmetic;
+  * the membership policy — schedule grammar, attempt clamping, rescale
+    policies and their provenance;
+  * checkpoint world provenance — ``committed_world`` peeks, restore at
+    a different world size reshards, and a torn shard at a mismatched
+    world STILL quarantines-and-walks-back (resharding must not weaken
+    commit-or-quarantine);
+  * the supervisor's progress probe tolerating a mixed-world ckpt dir;
+  * the launcher consuming the ``TPUFRAME_ELASTIC`` schedule;
+  * ``partial_sigterm`` (reclaim k of n hosts) rank semantics;
+  * the TF116 cached-world-size lint.
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from tpuframe import ckpt, elastic
+from tpuframe.analysis import shardflow
+from tpuframe.analysis.source_lint import lint_source
+from tpuframe.ckpt.checkpoint import committed_world, latest_step
+from tpuframe.elastic import resharding
+from tpuframe.launch import launcher as launcher_mod
+from tpuframe.obs import goodput
+from tpuframe.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_elastic_env(monkeypatch):
+    monkeypatch.delenv(elastic.ENV_SCHEDULE, raising=False)
+    monkeypatch.delenv(elastic.ENV_RESCALE, raising=False)
+    monkeypatch.delenv("TPUFRAME_FAULTS", raising=False)
+    monkeypatch.delenv("TPUFRAME_PROCESS_ID", raising=False)
+    faults.reset_from_env()
+    yield
+    faults.reset_from_env({})
+
+
+# ---------------------------------------------------------------------------
+# Membership schedule + rescale policy.
+# ---------------------------------------------------------------------------
+
+
+class TestMembership:
+    def test_schedule_grammar(self):
+        assert elastic.parse_schedule("8,4,8") == (8, 4, 8)
+        assert elastic.parse_schedule(" 8 , 4 ") == (8, 4)
+        assert elastic.parse_schedule("") == ()
+        with pytest.raises(ValueError, match="must be integers"):
+            elastic.parse_schedule("8,four")
+        with pytest.raises(ValueError, match="must be positive"):
+            elastic.parse_schedule("8,0")
+
+    def test_world_for_attempt_clamps_to_last_leg(self):
+        sched = (8, 4, 8)
+        assert [elastic.world_for_attempt(a, sched)
+                for a in (0, 1, 2, 3, 99)] == [8, 4, 8, 8, 8]
+        with pytest.raises(ValueError, match="empty schedule"):
+            elastic.world_for_attempt(0, ())
+
+    def test_schedule_from_env(self, monkeypatch):
+        assert elastic.schedule_from_env() == ()
+        monkeypatch.setenv(elastic.ENV_SCHEDULE, "4,2")
+        assert elastic.schedule_from_env() == (4, 2)
+
+    def test_rescale_hold_is_identity(self):
+        assert elastic.rescale(32, 0.1, 8, 4, "hold") == (32, 0.1)
+        # n unchanged: every policy is the identity.
+        assert elastic.rescale(32, 0.1, 8, 8, "linear") == (32, 0.1)
+
+    def test_rescale_linear_and_sqrt(self):
+        b, lr = elastic.rescale(32, 0.1, 8, 4, "linear")
+        assert (b, lr) == (16, pytest.approx(0.05))
+        b, lr = elastic.rescale(32, 0.1, 4, 8, "sqrt")
+        assert b == 64
+        assert lr == pytest.approx(0.1 * np.sqrt(2.0))
+
+    def test_rescale_keeps_batch_a_multiple_of_n_to(self):
+        # 10 * (3/4) = 7.5 → rounds to 8, floors to a multiple of 3 → 6.
+        b, _ = elastic.rescale(10, 0.1, 4, 3, "linear")
+        assert b % 3 == 0 and b > 0
+        # Extreme shrink never drops below one example per replica.
+        b, _ = elastic.rescale(4, 0.1, 64, 2, "linear")
+        assert b >= 2 and b % 2 == 0
+
+    def test_resolve_rescale_provenance(self, monkeypatch):
+        assert elastic.resolve_rescale() == ("hold", "default")
+        monkeypatch.setenv(elastic.ENV_RESCALE, "sqrt")
+        assert elastic.resolve_rescale() == ("sqrt", "env")
+        monkeypatch.setenv(elastic.ENV_RESCALE, "exponential")
+        with pytest.raises(ValueError, match="unknown elastic rescale"):
+            elastic.resolve_rescale()
+
+
+# ---------------------------------------------------------------------------
+# The resharding map.
+# ---------------------------------------------------------------------------
+
+
+class TestResharding:
+    def test_reshard_flat_shrink_drops_only_pad(self):
+        # True size 10, saved at n=8 (padded 16): rows 10..15 are zero.
+        vec = np.zeros(16, np.float32)
+        vec[:10] = np.arange(10, dtype=np.float32) + 1
+        out = resharding.reshard_flat(vec, 12)  # n=4 layout
+        np.testing.assert_array_equal(out[:10], vec[:10])
+        np.testing.assert_array_equal(out[10:], 0)
+
+    def test_reshard_flat_roundtrip_is_identity(self):
+        vec = np.zeros(16, np.float32)
+        vec[:10] = np.random.default_rng(0).normal(size=10)
+        back = resharding.reshard_flat(
+            resharding.reshard_flat(vec, 12), 16)
+        np.testing.assert_array_equal(back, vec)
+
+    def test_reshard_flat_rejects_non_flat(self):
+        with pytest.raises(ValueError, match="flat 1-D"):
+            resharding.reshard_flat(np.zeros((2, 3)), 4)
+
+    def test_moved_elems_identity_and_bounds(self):
+        assert resharding.moved_elems(100, 8, 8) == 0
+        assert resharding.moved_elems(0, 8, 4) == 0
+        for size in (1, 7, 10, 100, 4097):
+            for nf, nt in ((8, 4), (4, 8), (8, 3), (3, 8)):
+                m = resharding.moved_elems(size, nf, nt)
+                assert 0 <= m <= size
+
+    def test_moved_elems_matches_bruteforce(self):
+        # Exactness against the O(size) definition: owner = i // chunk.
+        for size, nf, nt in ((10, 8, 4), (10, 4, 8), (100, 8, 3),
+                             (17, 2, 5), (64, 8, 4)):
+            cf = resharding.padded_len(size, nf) // nf
+            ct = resharding.padded_len(size, nt) // nt
+            brute = sum(1 for i in range(size) if i // cf != i // ct)
+            assert resharding.moved_elems(size, nf, nt) == brute
+
+    def test_resize_movement_totals(self):
+        leaves = [("w", 10, 4), ("b", 3, 4)]
+        mv = resharding.resize_movement(leaves, 8, 4, moment_vectors=2)
+        assert mv["n_leaves"] == 2
+        assert mv["state_bytes"] == (12 + 4) * 4 * 2
+        assert mv["moved_bytes"] == sum(
+            r["moved_bytes"] for r in mv["leaves"])
+        assert 0.0 <= mv["moved_frac"] <= 1.0
+
+    def test_gate_self_check_is_clean(self):
+        assert elastic.check() == []
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint world provenance + restore-at-a-different-world.
+# ---------------------------------------------------------------------------
+
+
+def _flat_state(n_shards):
+    """A ZeRO-1-shaped host tree: replicated params + flat padded
+    moments for a true size of 10 (padded 16 at n=8, 12 at n=4)."""
+    pad = resharding.padded_len(10, n_shards)
+    mu = np.zeros(pad, np.float32)
+    mu[:10] = np.arange(10, dtype=np.float32) + 1
+    return {"params": {"w": np.arange(10.0, dtype=np.float32)},
+            "opt_state": {"mu": mu, "nu": mu * 2.0}}
+
+
+class TestElasticRestore:
+    def test_committed_world_peeks_newest_manifest(self, tmp_path):
+        assert committed_world(str(tmp_path)) is None
+        mgr = ckpt.CheckpointManager(str(tmp_path), async_write=False)
+        mgr.save(3, _flat_state(8))
+        world = committed_world(str(tmp_path))
+        import jax
+
+        assert world == {"step": 3, "processes": jax.process_count(),
+                         "devices": jax.device_count()}
+
+    def test_committed_world_none_for_pre_elastic_manifest(self, tmp_path):
+        mgr = ckpt.CheckpointManager(str(tmp_path), async_write=False)
+        mgr.save(1, _flat_state(8))
+        mpath = tmp_path / "step_00000001" / "manifest.json"
+        manifest = json.loads(mpath.read_text())
+        del manifest["world"]
+        mpath.write_text(json.dumps(manifest))
+        assert committed_world(str(tmp_path)) is None
+        # ...and the peek never quarantines, even on a garbled manifest.
+        mpath.write_text("{torn")
+        assert committed_world(str(tmp_path)) is None
+        assert not (tmp_path / "step_00000001.corrupt").exists()
+        assert latest_step(str(tmp_path)) == 1
+
+    def test_restore_latest_reshards_to_new_world(self, tmp_path):
+        mgr = ckpt.CheckpointManager(str(tmp_path), async_write=False)
+        mgr.save(5, _flat_state(8))
+        step, tree = mgr.restore_latest(target=_flat_state(4))
+        assert step == 5
+        saved = _flat_state(8)
+        # Params (replicated; shapes match) restore unchanged; moments
+        # reshard 16 → 12, dropping only provably-zero pad rows.
+        np.testing.assert_array_equal(np.asarray(tree["params"]["w"]),
+                                      saved["params"]["w"])
+        for key in ("mu", "nu"):
+            got = np.asarray(tree["opt_state"][key])
+            assert got.shape == (12,)
+            np.testing.assert_array_equal(got[:10],
+                                          saved["opt_state"][key][:10])
+            np.testing.assert_array_equal(got[10:], 0)
+        # Grow direction: 16-target from a 12-length save.
+        mgr2 = ckpt.CheckpointManager(str(tmp_path / "grow"),
+                                      async_write=False)
+        mgr2.save(5, _flat_state(4))
+        _, tree = mgr2.restore_latest(target=_flat_state(8))
+        got = np.asarray(tree["opt_state"]["mu"])
+        assert got.shape == (16,)
+        np.testing.assert_array_equal(got[10:], 0)
+
+    def test_restore_mismatch_outside_opt_state_still_raises(self, tmp_path):
+        mgr = ckpt.CheckpointManager(str(tmp_path), async_write=False)
+        mgr.save(1, _flat_state(8))
+        target = _flat_state(8)
+        target["params"]["w"] = np.zeros(7, np.float32)  # not opt state
+        with pytest.raises(ValueError, match="no resharding map"):
+            mgr.restore_latest(target=target)
+
+    def test_torn_shard_at_new_world_quarantines_and_walks_back(
+            self, tmp_path, capsys):
+        """Resharding must not weaken commit-or-quarantine: a corrupt
+        newest checkpoint read at a DIFFERENT world size is quarantined
+        and resume walks back to the previous committed step — which is
+        then itself resharded."""
+        mgr = ckpt.CheckpointManager(str(tmp_path), async_write=False)
+        mgr.save(3, _flat_state(8))
+        mgr.save(6, _flat_state(8))
+        shard = next((tmp_path / "step_00000006").glob(
+            "opt_state.mu.shard_*.npy"))
+        shard.write_bytes(b"\x00" * 64)  # CRC mismatch on reassembly
+        step, tree = mgr.restore_latest(target=_flat_state(4))
+        assert step == 3
+        assert np.asarray(tree["opt_state"]["mu"]).shape == (12,)
+        assert (tmp_path / "step_00000006.corrupt").is_dir()
+        assert not (tmp_path / "step_00000006").exists()
+        assert "quarantin" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: mixed-world progress probe + schedule consumption.
+# ---------------------------------------------------------------------------
+
+
+def _fake_committed(ck, step, devices):
+    d = ck / f"step_{step:08d}"
+    os.makedirs(d)
+    (d / "manifest.json").write_text(json.dumps(
+        {"world": {"processes": 1, "devices": devices}}))
+    (d / "COMMIT").write_text("done")
+
+
+class TestSupervisorElastic:
+    def test_progress_probe_tolerates_world_resize(self, tmp_path, capsys):
+        """Satellite: a ckpt dir whose committed world differs from the
+        relaunch world must not confuse the probe — steps are world-size
+        invariant, so progress accounting is unchanged."""
+        probe = launcher_mod._progress_probe(
+            ["prog", "--ckpt-dir", str(tmp_path)])
+        _fake_committed(tmp_path, 10, devices=8)
+        assert probe() == 10
+        _fake_committed(tmp_path, 20, devices=4)  # shrank across relaunch
+        assert probe() == 20
+        out = capsys.readouterr().out
+        assert "resized 8" in out and "4 devices" in out
+        _fake_committed(tmp_path, 30, devices=4)  # steady state: no relog
+        assert probe() == 30
+        assert "resized" not in capsys.readouterr().out
+
+    def test_progress_probe_survives_pre_elastic_manifests(self, tmp_path):
+        d = tmp_path / "step_00000010"
+        os.makedirs(d)
+        (d / "manifest.json").write_text("{}")  # no world key
+        (d / "COMMIT").write_text("done")
+        probe = launcher_mod._progress_probe(
+            ["prog", "--ckpt-dir", str(tmp_path)])
+        assert probe() == 10
+
+    def test_launcher_sizes_attempts_from_schedule(self, monkeypatch):
+        """The launcher's elastic leg arithmetic: world_for_attempt
+        drives devices-per-process, and a world not divisible by the
+        process count is a config error, not a truncation."""
+        sched = elastic.parse_schedule("8,4,8")
+        for attempt, want in ((0, 8), (1, 4), (2, 8), (7, 8)):
+            n = elastic.world_for_attempt(attempt, sched)
+            assert n == want and n % 2 == 0  # 2 procs × n/2 devices
+        assert elastic.world_for_attempt(1, sched) % 3 != 0
+
+
+# ---------------------------------------------------------------------------
+# partial_sigterm: reclaim k of n hosts.
+# ---------------------------------------------------------------------------
+
+
+class TestPartialSigterm:
+    def test_parse_k_option(self):
+        f = faults.parse("host:step=4:kind=partial_sigterm:k=2")[0]
+        assert (f.seam, f.kind, f.step, f.k) == ("host",
+                                                 "partial_sigterm", 4, 2)
+        with pytest.raises(ValueError, match="k must be >= 1"):
+            faults.parse("host:kind=partial_sigterm:k=0")
+
+    def test_spares_hosts_at_or_beyond_k(self, monkeypatch, capsys):
+        monkeypatch.setenv("TPUFRAME_PROCESS_ID", "2")
+        reg = faults.FaultRegistry(
+            faults.parse("host:kind=partial_sigterm:k=2"))
+        reg.fire("host")  # rank 2 >= k=2: survives
+        assert "spared host 2" in capsys.readouterr().out
+
+    def test_signals_hosts_below_k(self, monkeypatch, capsys):
+        monkeypatch.setenv("TPUFRAME_PROCESS_ID", "1")
+        got = []
+        prev = signal.signal(signal.SIGTERM, lambda s, f: got.append(s))
+        try:
+            reg = faults.FaultRegistry(
+                faults.parse("host:kind=partial_sigterm:k=2"))
+            reg.fire("host")
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+        assert got == [signal.SIGTERM]
+        assert "raising SIGTERM on host 1" in capsys.readouterr().out
+
+    def test_budget_spent_once(self, monkeypatch, capsys):
+        monkeypatch.setenv("TPUFRAME_PROCESS_ID", "5")
+        reg = faults.FaultRegistry(
+            faults.parse("host:kind=partial_sigterm:times=1"))
+        reg.fire("host")
+        reg.fire("host")  # budget spent: no-op
+        assert capsys.readouterr().out.count("spared") == 1
+
+
+# ---------------------------------------------------------------------------
+# TF116: world size cached at module import.
+# ---------------------------------------------------------------------------
+
+
+class TestTF116:
+    def test_flags_module_level_cache(self):
+        src = "import jax\nN_DEVICES = jax.device_count()\n"
+        found = lint_source(src, "tpuframe/obs/widget.py")
+        assert [f.rule for f in found] == ["TF116"]
+        assert "current_world" in found[0].message
+
+    def test_allows_call_time_reads_and_sanctioned_seams(self):
+        in_fn = "import jax\ndef f():\n    return jax.device_count()\n"
+        assert lint_source(in_fn, "tpuframe/obs/widget.py") == []
+        cached = "import jax\nN = jax.process_count()\n"
+        assert lint_source(cached, "tpuframe/parallel/mesh2.py") == []
+        assert lint_source(cached, "tpuframe/elastic/thing.py") == []
+        assert lint_source(cached, "tpuframe/launch/thing.py") == []
+
+    def test_suppression(self):
+        src = ("import jax\n"
+               "# static probe, never survives a relaunch\n"
+               "N = jax.device_count()  # tf-lint: ok[TF116]\n")
+        assert lint_source(src, "tpuframe/obs/widget.py") == []
+
+
+# ---------------------------------------------------------------------------
+# Stitcher + budget surfacing.
+# ---------------------------------------------------------------------------
+
+
+class TestResizeAccounting:
+    def test_goodput_surfaces_transitions(self):
+        events = [
+            {"type": "step", "step": 1, "attempt": 0, "t": 1.0,
+             "wall_ms": 10.0},
+            {"type": "step", "step": 2, "attempt": 0, "t": 2.0,
+             "wall_ms": 10.0},
+            {"type": "elastic_resize", "attempt": 1, "t": 3.0,
+             "n_from": 8, "n_to": 4, "policy": "hold"},
+            {"type": "step", "step": 2, "attempt": 1, "t": 4.0,
+             "wall_ms": 10.0},  # the one replayed step
+            {"type": "step", "step": 3, "attempt": 1, "t": 5.0,
+             "wall_ms": 10.0},
+        ]
+        g = goodput.from_events(events)
+        assert g["attempts"] == 2
+        assert g["retrained_steps"] == 1
+        assert g["elastic_resizes"] == 1
+        assert g["elastic_transitions"] == ["8->4"]
+
+    def test_goodput_omits_keys_without_resizes(self):
+        g = goodput.from_events([{"type": "step", "step": 1, "attempt": 0,
+                                  "t": 1.0, "wall_ms": 10.0}])
+        assert "elastic_resizes" not in g
+
+    def test_resize_drift_gating(self):
+        # Missing entry is a finding only when the jax version matches.
+        stale = {"jax": "not-this-version", "strategies": {}}
+        assert shardflow.resize_drift(stale) == []
+        assert shardflow.resize_drift(None) == []
+        current = {"jax": shardflow._jax_version(), "strategies": {}}
+        problems = shardflow.resize_drift(current)
+        assert problems and "elastic-resize budget missing" in problems[0]
+
+    def test_resize_drift_detects_mismatch(self):
+        fresh = shardflow.derive_resize(8)
+        ok = {"jax": shardflow._jax_version(), "strategies": {},
+              "elastic_resize": fresh}
+        assert shardflow.resize_drift(ok, n_devices=8) == []
+        tampered = {k: dict(v) for k, v in fresh.items()}
+        next(iter(tampered.values()))["moved_bytes"] += 1
+        bad = {"jax": shardflow._jax_version(), "strategies": {},
+              "elastic_resize": tampered}
+        problems = shardflow.resize_drift(bad, n_devices=8)
+        assert problems and "drift" in problems[0]

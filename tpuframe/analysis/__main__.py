@@ -218,6 +218,16 @@ def _run_zero1_check() -> int:
     return len(problems)
 
 
+def _run_elastic_check() -> int:
+    from tpuframe import elastic
+
+    problems = elastic.check()
+    for p in problems:
+        print(f"ELASTIC {p}")
+    print(f"[analysis] elastic self-check: {len(problems)} problem(s)")
+    return len(problems)
+
+
 def _run_quantwire_check() -> int:
     from tpuframe.parallel import quantwire
 
@@ -290,6 +300,7 @@ def main(argv=None) -> int:
         n_findings += _run_mem_check()
         n_findings += _run_serve_check()
         n_findings += _run_zero1_check()
+        n_findings += _run_elastic_check()
         n_findings += _run_quantwire_check()
         n_findings += _run_obs_check()
         if args.json:

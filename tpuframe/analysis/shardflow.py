@@ -377,6 +377,68 @@ def derive_budget(report: hlo_audit.CollectiveReport,
     }
 
 
+def elastic_transitions(n_devices: int = 8) -> tuple[tuple[int, int], ...]:
+    """The membership transitions the gate pins: shrink to half the
+    world and grow back — the 8→4→8 chaos tier's legs."""
+    half = max(1, int(n_devices) // 2)
+    return ((int(n_devices), half), (half, int(n_devices)))
+
+
+def derive_resize(n_devices: int = 8) -> dict:
+    """Exact shard-movement bytes of the elastic n→n′ resharding map —
+    the resize priced like any other wire.
+
+    Census: the flagship tiny-LM param tree the strategy audits compile
+    (``strategies._lm_pieces``), under adamw's two flat moment vectors
+    per leaf.  Movement comes from ``elastic.resharding``'s interval
+    arithmetic over zero1's pad-to-multiple layout — pure shape math, no
+    compile — so the pinned numbers are byte-exact and deterministic."""
+    import jax
+    import numpy as np
+
+    from tpuframe.analysis import strategies
+    from tpuframe.elastic import resharding
+
+    _m, _l, _tx, (state, _b), _pb, _ab = strategies._lm_pieces()
+    flat, _ = jax.tree_util.tree_flatten_with_path(state.params)
+    leaves = [(jax.tree_util.keystr(path),
+               int(np.prod(leaf.shape)) if leaf.shape else 1,
+               np.dtype(leaf.dtype).itemsize)
+              for path, leaf in flat]
+    out = {}
+    for n_from, n_to in elastic_transitions(n_devices):
+        mv = resharding.resize_movement(leaves, n_from, n_to,
+                                        moment_vectors=2)
+        mv.pop("leaves")  # totals pin; per-leaf rows stay derivable
+        out[f"{n_from}->{n_to}"] = mv
+    return out
+
+
+def resize_drift(derived_file: dict | None, *,
+                 n_devices: int = 8) -> list[str]:
+    """Diff the fresh resize derivation against the checked-in record —
+    the same drift contract every collective budget lives under."""
+    if derived_file is None:
+        return []  # budget_drift already reports the missing file
+    if derived_file.get("jax") != _jax_version():
+        return []  # pinned to the emitting jax, like budget_drift
+    declared = derived_file.get("elastic_resize")
+    if declared is None:
+        return ["elastic-resize budget missing from derived_budgets.json "
+                "— run `python -m tpuframe.analysis --emit-budgets` to "
+                "declare the resharding-map movement bytes"]
+    fresh = derive_resize(n_devices)
+    problems = []
+    for key in sorted(set(fresh) | set(declared)):
+        if fresh.get(key) != declared.get(key):
+            problems.append(
+                f"elastic-resize drift on {key}: derived "
+                f"{fresh.get(key) or 'nothing'} but derived_budgets.json "
+                f"declares {declared.get(key) or 'nothing'} — fix the "
+                f"regression or re-emit with --emit-budgets")
+    return problems
+
+
 def load_derived(path: str = DERIVED_BUDGETS_PATH) -> dict | None:
     try:
         with open(path) as f:
@@ -396,6 +458,7 @@ def emit_derived(audits, *, n_devices: int, path: str =
         "schema": REPORT_SCHEMA,
         "jax": _jax_version(),
         "n_devices": int(n_devices),
+        "elastic_resize": derive_resize(n_devices),
         "strategies": {
             a.name: derive_budget(a.report, a.budget.ignore_below)
             for a in audits
@@ -504,6 +567,7 @@ def check(audits=None, *, n_devices: int = 8,
             continue
         problems.extend(audit_flow(audit, derived_file=derived_file)
                         ["problems"])
+    problems.extend(resize_drift(derived_file, n_devices=n_devices))
     return problems
 
 

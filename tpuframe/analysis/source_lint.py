@@ -74,6 +74,16 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          interleaving with the main loop's compiled steps); the
          sanctioned modules are the ones audited to never do that.
          Threads that provably never touch jax suppress with a reason.
+  TF116  world-size read cached at module import — a module-level
+         ``N = jax.device_count()`` (or ``process_count``/
+         ``local_device_count``/``process_index``) outside the
+         sanctioned seams (``elastic/``, ``launch/``, ``parallel/``)
+         snapshots the world before the run resolves it: under elastic
+         resizing the world changes across relaunch attempts, and the
+         import-time constant silently disagrees with the mesh the
+         attempt actually built.  Resolve per run via
+         ``tpuframe.elastic.current_world()``; provably-static uses
+         suppress with a reason.
   TF106  compiler-env mutation that can run after jax backend init —
          ``os.environ["XLA_FLAGS"] = ...`` (or ``LIBTPU_INIT_ARGS``,
          via assignment/setdefault/update/putenv) is snapshotted by the
@@ -159,6 +169,10 @@ RULES = {
     "TF115": "raw lax collective (psum/ppermute/all_gather/psum_scatter) "
              "in the wire-format seam (parallel/step.py, "
              "parallel/zero1.py) bypassing the resolved wire format",
+    "TF116": "world-size read (jax.process_count/device_count/"
+             "local_device_count/process_index) cached at module import "
+             "outside the elastic/launch/parallel seams — stale after an "
+             "elastic resize",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -250,6 +264,18 @@ _CTOR_METHODS = {"__init__", "__post_init__", "__new__"}
 # carry ``# tf-lint: ok[TF115]`` and a reason.
 _WIRE_SEAM_SUFFIXES = ("parallel/step.py", "parallel/zero1.py")
 _WIRE_RAW_TAILS = {"psum", "ppermute", "all_gather", "psum_scatter"}
+
+# TF116: the seams sanctioned to read the world size directly — the
+# elastic resolver itself, the launcher (sizes the cluster before jax
+# exists in the children) and parallel/ (mesh construction).  Everywhere
+# else a module-import-time world read is a constant baked before the
+# attempt resolved its world: under elastic resizing (TPUFRAME_ELASTIC)
+# the device count changes across relaunch attempts, so the cache
+# silently disagrees with the mesh the run actually built.  Per-run code
+# goes through ``tpuframe.elastic.current_world()``.
+_WORLD_SANCTIONED_PARTS = ("elastic/", "launch/", "parallel/")
+_WORLD_READ_TAILS = {"process_count", "device_count",
+                     "local_device_count", "process_index"}
 
 # TF105a: google.cloud.storage blob/bucket methods — allowed only inside
 # the retry-wrapped data/gcs.py layer.
@@ -459,6 +485,8 @@ class FileContext:
         self.http_scope = not norm.endswith(_HTTP_EXEMPT_SUFFIX)
         self.lock_scope = any(p in norm for p in _LOCK_DISCIPLINE_PARTS)
         self.wire_scope = norm.endswith(_WIRE_SEAM_SUFFIXES)
+        self.world_scope = not any(p in norm
+                                   for p in _WORLD_SANCTIONED_PARTS)
         # TF106: a module-level compiler-env write is safe only BEFORE
         # the module-level jax import (the conftest/bootstrap pattern).
         self.jax_import_line = None
@@ -760,6 +788,35 @@ def _tf115_wire_seam(ctx: FileContext, node, fn):
                  f"resolved wire format — route through the wire "
                  f"dispatch (quantwire/collectives helpers) or suppress "
                  f"with tf-lint: ok[TF115] and a reason", fn)
+
+
+@_node_rule
+def _tf116_cached_world(ctx: FileContext, node, fn):
+    """Module-level (fn is None) assignment whose value reads the world
+    size from jax.  Reads inside functions are fine — they run when the
+    attempt does, after the world is resolved."""
+    if fn is not None or not ctx.world_scope:
+        return
+    if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        return
+    if node.value is None:
+        return
+    for sub in ast.walk(node.value):
+        if not isinstance(sub, ast.Call):
+            continue
+        callee = _dotted(sub.func)
+        tail = callee.rsplit(".", 1)[-1]
+        if tail in _WORLD_READ_TAILS and callee == f"jax.{tail}":
+            ctx.emit("TF116", node,
+                     f"{callee}() cached in a module-level binding — "
+                     f"the value is snapshotted at import, before the "
+                     f"attempt resolves its world, and goes stale when "
+                     f"an elastic resize (TPUFRAME_ELASTIC) changes the "
+                     f"device count across relaunches; resolve per run "
+                     f"via tpuframe.elastic.current_world(), or "
+                     f"suppress with tf-lint: ok[TF116] and a reason "
+                     f"if the binding is provably world-invariant", fn)
+            return
 
 
 @_fn_rule

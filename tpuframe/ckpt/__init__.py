@@ -10,6 +10,7 @@ with *resharding*, so an 8-chip checkpoint restores onto 32 chips and back
 
 from tpuframe.ckpt.checkpoint import (  # noqa: F401
     CheckpointManager,
+    committed_world,
     latest_step,
     restore,
     save,

@@ -128,6 +128,12 @@ def _prepare_save(directory: str, step: int, tree: PyTree, *, sink=None):
         # Algorithm versioning: absent == legacy zlib crc32; restore verifies
         # with whatever the writer recorded.
         "crc_algo": "crc32c",
+        # Elastic provenance: the world this checkpoint was written at.
+        # restore() reshards n→n′ from shapes alone; train.py peeks it
+        # (committed_world) to pick the batch/LR rescale and stamp the
+        # elastic_resize event's n_from.  Absent in pre-elastic manifests.
+        "world": {"processes": jax.process_count(),
+                  "devices": jax.device_count()},
     }
     owned_files: list[tuple[str, np.ndarray]] = []
     for name, leaf in zip(names, leaves):
@@ -329,6 +335,34 @@ def restore(directory: str, step: int, *, mesh: Mesh | None = None,
     def _placed(name: str, tgt) -> Any:
         entry = manifest["leaves"][name]
         tgt_sharding = getattr(tgt, "sharding", None)
+        tgt_shape = getattr(tgt, "shape", None)
+        if (tgt is not None and tgt_shape is not None
+                and "prng_impl" not in entry
+                and tuple(entry["shape"]) != tuple(tgt_shape)):
+            # Elastic n→n′ reshard: a ZeRO-1 flat opt-state vector whose
+            # pad-to-multiple length changed with the world size.  The map
+            # is truncate-or-zero-pad and provably exact (the pad region
+            # is zero forever — see tpuframe/elastic/resharding.py), so no
+            # layout metadata is consulted: fully reassemble (CRC-verified
+            # — a torn shard still raises into restore_latest's
+            # quarantine, never a half-reshard), remap, place per target.
+            from tpuframe.elastic import resharding
+            from tpuframe.parallel.mesh import host_device_put
+
+            if (len(entry["shape"]) == 1 and len(tgt_shape) == 1
+                    and name.split("/", 1)[0] == "opt_state"):
+                arr = _assemble(path, entry, manifest["crc"], verify_crc,
+                                crc_algo)
+                arr = arr.astype(np.dtype(entry["dtype"]), copy=False)
+                arr = resharding.reshard_flat(arr, int(tgt_shape[0]))
+                if tgt_sharding is not None:
+                    return host_device_put(arr, tgt_sharding)
+                return arr
+            raise ValueError(
+                f"checkpoint leaf {name!r} shape {tuple(entry['shape'])} "
+                f"does not match target shape {tuple(tgt_shape)} and is "
+                f"not a flat ZeRO-1 opt-state vector — no resharding map "
+                f"applies")
         if (tgt_sharding is not None and "prng_impl" not in entry
                 and not tgt_sharding.is_fully_replicated
                 and isinstance(tgt_sharding, NamedSharding)):
@@ -566,6 +600,27 @@ def _committed_steps(directory: str) -> list[int]:
 def latest_step(directory: str) -> int | None:
     steps = _committed_steps(directory)
     return steps[-1] if steps else None
+
+
+def committed_world(directory: str) -> dict | None:
+    """World metadata of the NEWEST committed checkpoint —
+    ``{"step", "processes", "devices"}`` — or None (no checkpoint,
+    pre-elastic manifest without the ``world`` key, or unreadable
+    manifest).  A peek, not a restore: best-effort and read-only, it
+    never quarantines — the elastic resize decision must not mutate the
+    checkpoint directory before restore_latest gets its turn."""
+    try:
+        steps = _committed_steps(directory)
+        if not steps:
+            return None
+        manifest = json.loads(gcs.read_bytes(
+            gcs.join(directory, f"step_{steps[-1]:08d}", _MANIFEST)))
+        world = manifest.get("world")
+        if isinstance(world, dict) and "devices" in world:
+            return {"step": steps[-1], **world}
+    except (OSError, EOFError, KeyError, ValueError):
+        return None
+    return None
 
 
 def in_flight_step(directory: str) -> int | None:

@@ -1,0 +1,138 @@
+"""Elastic world-size training: resize the mesh mid-run, lose ≤1 step.
+
+On a preemptible fleet the world size is a *variable*, not a constant: a
+spot reclaim takes k of n hosts and the economically sane response is to
+continue at n′ = n−k now, then grow back when capacity returns — not to
+idle until an identical slice reappears.  Horovod's elastic mode
+(arXiv:1802.05799, PAPERS.md) and the goodput accounting of
+arXiv:2011.03641 both frame membership change as a *bounded-cost event*;
+this package supplies the bound.
+
+Every ingredient already exists in-repo; elastic composes them:
+
+  - the supervisor relaunch loop (``launch/launcher.py``, PR 2) replays
+    the run command after a crash — here it additionally consults the
+    :data:`ENV_SCHEDULE` membership plan and rebuilds the local cluster
+    at the new world size per attempt;
+  - the commit-or-quarantine async checkpoint (``ckpt/checkpoint.py``,
+    PR 8) drains in-flight saves via ``flush(deadline)`` on SIGTERM, so
+    the surviving hosts always leave a committed step behind;
+  - ZeRO-1's flat pad-to-multiple layout (``parallel/zero1.py``, PR 7)
+    makes the n→n′ optimizer-state reshard *trivially deterministic* —
+    see :func:`resharding.reshard_flat` for why truncate-or-zero-pad is
+    exact, not approximate;
+  - the obs attempt stitcher (``obs/goodput.py``, PR 4/9) prices the
+    boundary: ``retrained_steps`` across a resize must stay ≤1.
+
+The contract, in order: **drain → relaunch → reshard → rescale.**
+Global batch and LR react to n→n′ by a declared policy
+(:data:`POLICIES`: ``hold``/``linear``/``sqrt``) resolved from
+:data:`ENV_RESCALE`, and the whole transition is emitted as a typed
+``elastic_resize`` run event with full provenance (n_from/n_to, policy,
+old/new batch and LR, policy source).
+
+Like every other wire in the repo, the resharding map is budgeted:
+``analysis/shardflow.py`` derives the exact shard-movement bytes for an
+n→n′ transition (from :func:`resharding.moved_elems` interval
+arithmetic over the flagship param census) and pins them in
+``derived_budgets.json`` — drift fails the gate.
+
+This module is import-light on purpose (no jax at import time): the
+supervisor consumes the membership schedule before any backend exists.
+"""
+
+from __future__ import annotations
+
+from tpuframe.elastic.membership import (  # noqa: F401
+    ENV_RESCALE,
+    ENV_SCHEDULE,
+    POLICIES,
+    World,
+    current_world,
+    parse_schedule,
+    rescale,
+    resolve_rescale,
+    schedule_from_env,
+    world_for_attempt,
+)
+from tpuframe.elastic.resharding import (  # noqa: F401
+    moved_elems,
+    reshard_flat,
+    resize_movement,
+)
+
+
+# ---------------------------------------------------------------------------
+# Analysis-gate self-check.
+# ---------------------------------------------------------------------------
+
+# Files that consume world size at runtime and must NOT cache it at
+# module import (TF116's scope) — a stale module-level capture is the
+# classic elastic-training bug: the value survives the relaunch and the
+# run silently computes at the dead world size.
+_TF116_SELF_LINT = (
+    "tpuframe/train.py",
+    "tpuframe/data",
+    "tpuframe/ckpt",
+    "tpuframe/obs",
+    "bench.py",
+)
+
+
+def check() -> list:
+    """Self-check for the ``python -m tpuframe.analysis`` CI gate.
+    Returns problem strings; [] means healthy."""
+    import os
+
+    problems: list[str] = []
+    # 1. schedule grammar round-trips and clamps
+    try:
+        sched = parse_schedule("8,4,8")
+        if sched != (8, 4, 8):
+            problems.append(f"parse_schedule('8,4,8') -> {sched!r}")
+        if world_for_attempt(0, sched) != 8 or world_for_attempt(1, sched) != 4:
+            problems.append("world_for_attempt indexes the wrong leg")
+        if world_for_attempt(99, sched) != 8:
+            problems.append("world_for_attempt does not clamp to the last leg")
+    except Exception as e:  # noqa: BLE001 — report, don't crash CI
+        problems.append(f"schedule grammar: {e}")
+    try:
+        schedule_from_env()
+    except ValueError as e:
+        problems.append(f"{ENV_SCHEDULE} is set to an invalid schedule: {e}")
+    # 2. rescale policies: hold is identity, linear/sqrt scale as declared
+    b, lr = rescale(32, 0.1, 8, 4, "hold")
+    if (b, lr) != (32, 0.1):
+        problems.append(f"hold rescale is not identity: {(b, lr)}")
+    b, lr = rescale(32, 0.1, 8, 4, "linear")
+    if b != 16 or abs(lr - 0.05) > 1e-12:
+        problems.append(f"linear rescale wrong: {(b, lr)}")
+    try:
+        resolve_rescale()
+    except ValueError as e:
+        problems.append(f"{ENV_RESCALE} is set to an invalid policy: {e}")
+    # 3. reshard arithmetic: conservation + identity properties, and the
+    #    local padded_len mirror must agree with zero1's layout
+    if moved_elems(100, 8, 8) != 0:
+        problems.append("moved_elems(n==n') must be 0")
+    if not (0 <= moved_elems(100, 8, 4) <= 100):
+        problems.append("moved_elems out of [0, size]")
+    from tpuframe.elastic.resharding import padded_len
+    from tpuframe.parallel import zero1
+
+    for size in (0, 1, 7, 8, 100, 144, 4097):
+        for n in (1, 2, 4, 8):
+            if padded_len(size, n) != zero1.padded_len(size, n):
+                problems.append(
+                    f"padded_len({size}, {n}) diverged from zero1's layout")
+    # 4. TF116 self-lint: no module-level world-size captures outside the
+    #    sanctioned elastic/launch/parallel seams
+    from tpuframe.analysis.source_lint import lint_paths
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo_root = os.path.dirname(pkg_root)
+    paths = [os.path.join(repo_root, p) for p in _TF116_SELF_LINT]
+    for f in lint_paths([p for p in paths if os.path.exists(p)]):
+        if f.rule == "TF116":
+            problems.append(f"self-lint: {f}")
+    return problems

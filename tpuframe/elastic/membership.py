@@ -1,0 +1,164 @@
+"""Membership policy: who is in the world, and how the run reacts.
+
+Three concerns live here, all deliberately jax-free at import time so
+the supervisor (which runs before any backend exists) can consume them:
+
+**The membership schedule** (:data:`ENV_SCHEDULE`).  Real elastic
+training gets its membership changes from the resource manager; the
+in-repo simulation declares them up front as a comma-separated list of
+total device counts, one per supervisor attempt::
+
+    TPUFRAME_ELASTIC="8,4,8"   # attempt 0 at 8, attempt 1 at 4, then 8
+
+:func:`world_for_attempt` clamps past the end (the last leg is the
+steady state), so a schedule shorter than the relaunch budget is fine.
+
+**The rescale policy** (:data:`ENV_RESCALE`).  When the world resizes
+n→n′ the run must decide what happens to global batch and LR.  The
+policy is *declared*, not inferred — it lands in the ``elastic_resize``
+run event so every resize carries its provenance:
+
+  - ``hold``   — keep batch and LR (default).  Data order is world-size
+    independent (``ShardedLoader``'s permutation is seeded globally), so
+    ``hold`` gives golden-loss-equivalent continuation — the property
+    the chaos tier pins.
+  - ``linear`` — batch and LR scale by n′/n (the classic linear-scaling
+    rule, arXiv:1706.02677 regime).
+  - ``sqrt``   — batch scales linearly, LR by sqrt(n′/n) (the
+    conservative rule for adaptive optimizers).
+
+**The world resolver** (:func:`current_world`).  train.py and bench.py
+used to derive mesh shape + device counts independently; the resize
+path needs a single source of truth, so both now route here.  The
+resolver reads the world *at call time* — never cache its result at
+module level (TF116 enforces this outside the elastic/launch/parallel
+seams).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+from typing import Any
+
+ENV_SCHEDULE = "TPUFRAME_ELASTIC"
+ENV_RESCALE = "TPUFRAME_ELASTIC_RESCALE"
+
+POLICIES = ("hold", "linear", "sqrt")
+
+
+# ---------------------------------------------------------------------------
+# Membership schedule.
+# ---------------------------------------------------------------------------
+
+
+def parse_schedule(text: str) -> tuple[int, ...]:
+    """``"8,4,8"`` → ``(8, 4, 8)``.  Empty/blank → ``()`` (not elastic)."""
+    out = []
+    for tok in (text or "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        try:
+            n = int(tok)
+        except ValueError:
+            raise ValueError(
+                f"{ENV_SCHEDULE} entries must be integers, got {tok!r}")
+        if n <= 0:
+            raise ValueError(
+                f"{ENV_SCHEDULE} entries must be positive, got {n}")
+        out.append(n)
+    return tuple(out)
+
+
+def schedule_from_env(env=os.environ) -> tuple[int, ...]:
+    """The declared membership plan, or ``()`` when the run is rigid."""
+    return parse_schedule(env.get(ENV_SCHEDULE, ""))
+
+
+def world_for_attempt(attempt: int, schedule: tuple[int, ...]) -> int:
+    """Total device count for supervisor attempt ``attempt`` (0-based).
+    Clamps to the last leg — the schedule's tail is the steady state."""
+    if not schedule:
+        raise ValueError("world_for_attempt called with an empty schedule")
+    return schedule[min(max(int(attempt), 0), len(schedule) - 1)]
+
+
+# ---------------------------------------------------------------------------
+# Rescale policy.
+# ---------------------------------------------------------------------------
+
+
+def validate_policy(policy: str) -> str:
+    policy = (policy or "hold").strip().lower()
+    if policy not in POLICIES:
+        raise ValueError(f"unknown elastic rescale policy {policy!r}; "
+                         f"expected one of {POLICIES} ({ENV_RESCALE})")
+    return policy
+
+
+def resolve_rescale(env=os.environ) -> tuple[str, str]:
+    """``(policy, source)`` — env override > ``hold`` default.  ``source``
+    is ``env``/``default``, emitted in the ``elastic_resize`` event."""
+    raw = env.get(ENV_RESCALE, "").strip()
+    if raw:
+        return validate_policy(raw), "env"
+    return "hold", "default"
+
+
+def rescale(global_batch: int, base_lr: float, n_from: int, n_to: int,
+            policy: str) -> tuple[int, float]:
+    """Apply ``policy`` to ``(global_batch, base_lr)`` for an n→n′
+    resize.  The returned batch is kept a positive multiple of ``n_to``
+    so per-replica and per-host divisibility survive the transition."""
+    policy = validate_policy(policy)
+    if policy == "hold" or n_from == n_to or n_from <= 0 or n_to <= 0:
+        return int(global_batch), float(base_lr)
+    ratio = n_to / n_from
+    batch = int(round(global_batch * ratio))
+    batch = max(n_to, (batch // n_to) * n_to)
+    if policy == "linear":
+        lr = float(base_lr) * ratio
+    else:  # sqrt
+        lr = float(base_lr) * math.sqrt(ratio)
+    return batch, lr
+
+
+# ---------------------------------------------------------------------------
+# The world resolver (single source of truth for train.py AND bench.py).
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class World:
+    """A point-in-time snapshot of the visible world.  Snapshots are for
+    *immediate* use — hold one across a relaunch boundary and it lies."""
+
+    n_devices: int
+    n_processes: int
+    process_index: int
+    mesh: Any  # jax.sharding.Mesh | None
+
+
+def current_world(spec=None, *, distributed: bool | None = None) -> World:
+    """Resolve device/process counts and (optionally) build the mesh.
+
+    ``distributed=True`` always builds the mesh from ``spec`` (train.py's
+    contract), ``False`` never does, and ``None`` builds one only when
+    more than one device is visible (bench.py's contract).  Reads jax at
+    call time — the post-relaunch world, never a cached one.
+    """
+    import jax
+
+    from tpuframe.parallel import mesh as mesh_lib
+
+    n_devices = jax.device_count()
+    want_mesh = distributed if distributed is not None else n_devices > 1
+    mesh = mesh_lib.make_mesh(spec) if want_mesh else None
+    return World(
+        n_devices=n_devices,
+        n_processes=jax.process_count(),
+        process_index=jax.process_index(),
+        mesh=mesh,
+    )

@@ -1,0 +1,132 @@
+"""The deterministic n→n′ resharding map for ZeRO-1 flat state.
+
+Why truncate-or-zero-pad is EXACT, not approximate
+--------------------------------------------------
+zero1 stores every optimizer moment as a flat 1-D vector zero-padded to
+``padded_len(size, n)`` and sharded over the n weight-update replicas.
+The pad region is zero *forever*, by construction:
+
+  - ``init_opt_state`` runs ``tx.init`` over zero templates — element-
+    wise optimizers initialize moments to zeros;
+  - every step pads gradients with zeros (``flat_pad``), and the
+    reduce-scatter mean of zeros is zero;
+  - element-wise transforms (sgd/momentum/adam(w)) keep a zero moment
+    zero under a zero gradient, so the pad rows never drift.
+
+Therefore resharding a saved ``[padded_len(size, n)]`` vector to the
+target ``[padded_len(size, n′)]`` layout needs no metadata at all:
+
+  - shrink (target shorter): ``vec[:target]`` — target ≥ true size, so
+    only provably-zero pad rows are dropped;
+  - grow (target longer): zero-pad — exactly what a fresh layout at n′
+    would contain in those rows.
+
+Params are replicated in the ZeRO-1 layout (only the *update* is
+sharded), so they restore through the ordinary full-reassembly path
+unchanged; the resharding map touches optimizer moments only.  Both
+directions compose to the identity, which is why the 8→4→8 chaos run
+can demand golden-loss-equivalent continuation rather than "close".
+
+Movement accounting
+-------------------
+:func:`moved_elems` prices the transition the same way shardflow prices
+a collective: walk the element index space in O(n+n′) segments and sum
+the elements whose owning shard changes between the n- and n′-layouts.
+``analysis/shardflow.py`` rolls this up over the flagship param census
+into ``derived_budgets.json`` — the resharding map is a wire like any
+other, and drift fails the gate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def padded_len(size: int, n: int) -> int:
+    """zero1's pad-to-multiple layout length (mirrors ``zero1._padded``;
+    elastic.check() cross-checks the two stay identical)."""
+    return -(-int(size) // int(n)) * int(n)
+
+
+def reshard_flat(vec, target_len: int):
+    """Truncate or zero-pad a flat 1-D moment vector to ``target_len``.
+
+    ``vec`` is any 1-D array-like (the restore path hands in the fully
+    reassembled host array).  See the module docstring for why this is
+    the *exact* n→n′ map for ZeRO-1 state, shrink and grow alike.
+    """
+    vec = np.asarray(vec)
+    if vec.ndim != 1:
+        raise ValueError(f"reshard_flat wants a flat 1-D vector, "
+                         f"got shape {vec.shape}")
+    target_len = int(target_len)
+    if vec.shape[0] == target_len:
+        return vec
+    if vec.shape[0] > target_len:
+        return vec[:target_len]
+    out = np.zeros((target_len,), dtype=vec.dtype)
+    out[: vec.shape[0]] = vec
+    return out
+
+
+def moved_elems(size: int, n_from: int, n_to: int) -> int:
+    """Elements of a true-size-``size`` vector whose owning shard index
+    changes when the flat layout re-pads from ``n_from`` to ``n_to``
+    shards.  Exact, O(n_from + n_to): owner is constant on the overlap
+    segments of the two chunk grids, so walk segment boundaries instead
+    of elements.  Pad rows are excluded — they carry no state."""
+    size, n_from, n_to = int(size), int(n_from), int(n_to)
+    if size <= 0 or n_from == n_to:
+        return 0
+    chunk_f = padded_len(size, n_from) // n_from
+    chunk_t = padded_len(size, n_to) // n_to
+    moved = 0
+    i = 0
+    while i < size:
+        owner_f = i // chunk_f
+        owner_t = i // chunk_t
+        nxt = min((owner_f + 1) * chunk_f, (owner_t + 1) * chunk_t, size)
+        if owner_f != owner_t:
+            moved += nxt - i
+        i = nxt
+    return moved
+
+
+def resize_movement(leaves, n_from: int, n_to: int, *,
+                    moment_vectors: int = 2) -> dict:
+    """Roll :func:`moved_elems` up over a param census.
+
+    ``leaves`` is an iterable of ``(name, size, itemsize)`` rows (one per
+    param leaf); ``moment_vectors`` is how many flat state vectors the
+    optimizer keeps per leaf (2 for adam(w): mu and nu).  Returns the
+    audit dict shardflow pins in ``derived_budgets.json``:
+    ``moved_bytes`` (state bytes that change owner), ``state_bytes``
+    (total sharded-state bytes in the n′ layout) and ``moved_frac``.
+    """
+    rows = []
+    moved_b = 0
+    state_b = 0
+    for name, size, itemsize in leaves:
+        me = moved_elems(size, n_from, n_to)
+        mb = int(me) * int(itemsize) * int(moment_vectors)
+        tb = padded_len(size, n_to) * int(itemsize) * int(moment_vectors)
+        rows.append({
+            "name": str(name),
+            "size": int(size),
+            "padded_from": padded_len(size, n_from),
+            "padded_to": padded_len(size, n_to),
+            "moved_elems": int(me),
+            "moved_bytes": mb,
+        })
+        moved_b += mb
+        state_b += tb
+    return {
+        "n_from": int(n_from),
+        "n_to": int(n_to),
+        "moment_vectors": int(moment_vectors),
+        "n_leaves": len(rows),
+        "moved_bytes": moved_b,
+        "state_bytes": state_b,
+        "moved_frac": moved_b / max(state_b, 1),
+        "leaves": rows,
+    }

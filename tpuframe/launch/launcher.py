@@ -32,6 +32,7 @@ import sys
 import time
 from dataclasses import dataclass, field
 
+from tpuframe import elastic
 from tpuframe.launch.provision import SliceConfig
 from tpuframe.obs import exporter as exporter_lib
 from tpuframe.resilience.preempt import RC_PREEMPTED
@@ -245,11 +246,21 @@ def run_with_relaunch(run_once, relaunches: int, *, log=print,
         delay = min(backoff_max_s, delay * 2.0)
 
 
-def _progress_probe(cmd: list[str]):
+def _progress_probe(cmd: list[str], *, log=print):
     """A ``progress()`` callable for :func:`run_with_relaunch`, watching the
     job's checkpoint directory when one is discoverable from its argv
     (``--ckpt-dir X`` or ``--ckpt-dir=X``).  None when there isn't one —
-    crash-loop detection simply stays off."""
+    crash-loop detection simply stays off.
+
+    Elastic tolerance: under a ``TPUFRAME_ELASTIC`` schedule consecutive
+    attempts run at DIFFERENT world sizes, so the directory accumulates
+    committed checkpoints written at several n.  Progress is measured in
+    steps, which are world-size invariant — a commit from any n counts,
+    and a manifest whose ``world`` metadata is absent (pre-elastic),
+    foreign, or unreadable must never zero the budget refresh.  The world
+    peek below is therefore strictly best-effort visibility: it logs the
+    n→n′ transition supervisor-side and feeds nothing into the progress
+    value."""
     ckpt_dir = None
     for i, arg in enumerate(cmd):
         if arg == "--ckpt-dir" and i + 1 < len(cmd):
@@ -258,9 +269,11 @@ def _progress_probe(cmd: list[str]):
             ckpt_dir = arg.split("=", 1)[1]
     if not ckpt_dir:
         return None
+    seen_world: list[int] = []
 
     def probe():
-        from tpuframe.ckpt.checkpoint import in_flight_step, latest_step
+        from tpuframe.ckpt.checkpoint import (committed_world,
+                                              in_flight_step, latest_step)
 
         try:
             # In-flight saves count: a job preempted mid-upload advanced
@@ -271,6 +284,16 @@ def _progress_probe(cmd: list[str]):
             marks = [s for s in (latest_step(ckpt_dir),
                                  in_flight_step(ckpt_dir))
                      if s is not None]
+            world = committed_world(ckpt_dir)
+            devices = int(world["devices"]) if world else 0
+            if devices > 0:
+                if seen_world and seen_world[-1] != devices:
+                    log(f"[tpuframe.launch] checkpoint world resized "
+                        f"{seen_world[-1]}→{devices} devices (committed "
+                        f"step {world.get('step')}) — progress accounting "
+                        f"unaffected, steps are world-size invariant")
+                if not seen_world or seen_world[-1] != devices:
+                    seen_world.append(devices)
             return max(marks) if marks else None
         except Exception:  # noqa: BLE001 — a flaky probe must not kill the
             # supervisor; "unknown" just means no budget refresh this round.
@@ -324,10 +347,30 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.mode == "local":
         cmd = [c for c in args.cmd if c != "--"]
+        schedule = elastic.schedule_from_env()
 
         def run_once() -> int:
+            # Elastic membership plan: each supervisor attempt may run at
+            # a different TOTAL device count (TPUFRAME_ELASTIC="8,4,8" —
+            # shrink after the first membership change, grow back after
+            # the second).  The cluster is rebuilt per attempt, so the
+            # relaunch IS the re-rendezvous; restore reshards the state.
+            devices = args.devices
+            if schedule:
+                attempt = int(os.environ.get("TPUFRAME_ATTEMPT", "0")
+                              or "0")
+                n_total = elastic.world_for_attempt(attempt, schedule)
+                if n_total % args.nprocs:
+                    print(f"[tpuframe.launch] TPUFRAME_ELASTIC leg "
+                          f"{n_total} is not divisible by --nprocs "
+                          f"{args.nprocs}")
+                    return 2
+                devices = n_total // args.nprocs
+                print(f"[tpuframe.launch] elastic attempt {attempt}: "
+                      f"world {n_total} devices ({args.nprocs} proc × "
+                      f"{devices} dev)")
             try:
-                results = LocalCluster(args.nprocs, args.devices).launch(cmd)
+                results = LocalCluster(args.nprocs, devices).launch(cmd)
             except RuntimeError as e:
                 print(f"[tpuframe.launch] {e}")
                 # preserve the failure model's exit codes (13 = stall

@@ -15,20 +15,27 @@ import jax.numpy as jnp
 class ConvNet(nn.Module):
     num_classes: int = 10
     dtype: jnp.dtype = jnp.float32
+    # None keeps the reference rates (0.25 conv / 0.5 dense).  Per-replica
+    # dropout streams are decorrelated by axis index (parallel/step.py), so
+    # masks are world-size dependent; proofs that need bit-for-bit loss
+    # equivalence across a mesh resize set this to 0.0.
+    dropout: float | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
         # x: [B, 28, 28, 1] NHWC
+        d1 = 0.25 if self.dropout is None else self.dropout
+        d2 = 0.5 if self.dropout is None else self.dropout
         x = x.astype(self.dtype)
         x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = nn.Dropout(d1, deterministic=not train)(x)
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(128, dtype=self.dtype)(x)
         x = nn.relu(x)
-        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dropout(d2, deterministic=not train)(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
         return x.astype(jnp.float32)  # logits in f32 for a stable softmax

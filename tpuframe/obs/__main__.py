@@ -158,6 +158,9 @@ def cmd_summarize(directory: str, generation: str | None) -> int:
         print(f"  restart-lost {summary['restart_lost_s']:.2f}s across "
               f"{summary['attempts']} attempts "
               f"({summary['retrained_steps']} steps retrained)")
+    if summary.get("elastic_resizes"):
+        print(f"  elastic resizes: "
+              f"{', '.join(summary['elastic_transitions'])} devices")
 
     times = sorted(goodput_lib.step_times_ms(merged))
     if times:

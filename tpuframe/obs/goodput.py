@@ -210,6 +210,16 @@ def from_events(events: list[dict], *,
                     if r.get("type") == "step" and int(r["step"]) <= prev_hi]
         out["retrained_steps"] += len(replayed)
 
+    # Elastic resizes are attempt-boundary facts like restart-lost time:
+    # surface them so ``summarize`` shows which attempts changed world
+    # size (retrained_steps is the ≤1-lost-step check's numerator).
+    resizes = [r for r in events if r.get("type") == "elastic_resize"]
+    if resizes:
+        out["elastic_resizes"] = len(resizes)
+        out["elastic_transitions"] = [
+            f"{int(r.get('n_from', 0))}->{int(r.get('n_to', 0))}"
+            for r in resizes]
+
     # Per-attempt buckets, summed.
     buckets = {b: 0.0 for b in BUCKETS}
     wall = 0.0

@@ -139,6 +139,22 @@ def _padded(size: int, n: int) -> int:
     return -(-size // n) * n
 
 
+def padded_len(size: int, n: int) -> int:
+    """Public face of the pad-to-multiple layout: the flat length a
+    ``size``-element leaf occupies when sharded ``n`` ways.
+
+    This is also the elastic-resize contract (:mod:`tpuframe.elastic`):
+    the pad region is zero at init (``tx.init`` over zero templates) and
+    stays zero forever (``flat_pad`` pads grads with zeros; the mean of
+    zeros reduce-scatters to zero; element-wise optimizers keep zero
+    moments on zero grads), so resharding a flat moment vector n→n′ is
+    EXACTLY truncate-or-zero-pad to ``padded_len(size, n')`` — no data
+    beyond the true ``size`` ever carries state.  ``elastic.check()``
+    cross-checks its own mirror of this arithmetic against this function
+    so the two layouts can never drift apart."""
+    return _padded(int(size), int(n))
+
+
 def world_size(mesh: Mesh, axes=mesh_lib.BATCH_AXES) -> int:
     """Number of weight-update shards: the product of ``axes`` sizes."""
     return int(np.prod([mesh.shape[a] for a in axes if a in mesh.shape]))

@@ -6,7 +6,7 @@ Spec grammar (``TPUFRAME_FAULTS``, comma-separated entries)::
     TPUFRAME_FAULTS="gcs_read:step=13:kind=ioerror,ckpt_shard:kind=corrupt,
                      host:step=20:kind=sigterm"
 
-    <seam>[:step=N][:kind=K][:times=T][:rank=R][:once=1][:delay_s=X]
+    <seam>[:step=N][:kind=K][:times=T][:rank=R][:k=K][:once=1][:delay_s=X]
 
 Seams are named injection points the framework calls into:
 
@@ -37,13 +37,18 @@ Kinds: ``ioerror`` (raise a retryable :class:`InjectedFault`), ``slow``
 (sleep ``delay_s``), ``corrupt`` (flip bytes), ``torn`` (truncate),
 ``crash`` (``os._exit(42)``, no cleanup — the hard-kill model),
 ``sigterm``/``sigint`` (deliver the real signal to this process — drives
-the preemption contract), ``hang`` (sleep forever — the stall class).
+the preemption contract), ``hang`` (sleep forever — the stall class),
+``partial_sigterm`` (deliver SIGTERM only on the first ``k`` of n
+simulated hosts — the membership-change model: a spot reclaim takes k
+hosts, the survivors drain and the supervisor relaunches at n−k; the
+elastic resize chaos tier drives on this kind).
 
 Matching: ``step=N`` gates on the training step (the harness calls
 :func:`set_step`); ``times=T`` caps firings (default 1); ``rank=R``
-restricts to one process; ``once=1`` drops the fault on a *resumed* run
-(start_step > 0) so relaunch tests survive the step that killed them —
-the old ``TPUFRAME_FAULT_ONCE`` semantics.
+restricts to one process; ``k=K`` (``partial_sigterm`` only, default 1)
+selects how many of the n hosts take the signal; ``once=1`` drops the
+fault on a *resumed* run (start_step > 0) so relaunch tests survive the
+step that killed them — the old ``TPUFRAME_FAULT_ONCE`` semantics.
 
 Back-compat: ``TPUFRAME_FAULT_STEP=N`` (+ ``TPUFRAME_FAULT_ONCE=1``)
 still works — it compiles into ``host:step=N:kind=crash[:once=1]`` with
@@ -60,7 +65,7 @@ import time
 from dataclasses import dataclass
 
 _KINDS = ("ioerror", "slow", "corrupt", "torn", "crash", "sigterm",
-          "sigint", "hang")
+          "sigint", "hang", "partial_sigterm")
 _SEAMS = ("gcs_read", "gcs_write", "gcs_list", "gcs_stat", "gcs_delete",
           "ckpt_shard", "host", "slow_gcs", "crash_during_upload",
           "sigterm_pending_upload")
@@ -86,6 +91,9 @@ class Fault:
     rank: int | None = None
     once: bool = False
     delay_s: float = 1.0
+    # partial_sigterm only: how many of the n simulated hosts take the
+    # signal (processes with index < k).
+    k: int = 1
 
 
 def parse(spec: str) -> list[Fault]:
@@ -118,6 +126,11 @@ def parse(spec: str) -> list[Fault]:
                 f.times = int(val)
             elif key == "rank":
                 f.rank = int(val)
+            elif key == "k":
+                f.k = int(val)
+                if f.k < 1:
+                    raise ValueError(f"fault option k must be >= 1 "
+                                     f"(in {entry!r})")
             elif key == "once":
                 f.once = val not in ("0", "false", "")
             elif key == "delay_s":
@@ -188,7 +201,7 @@ class FaultRegistry:
         """Run any control-flow fault armed at ``seam`` (everything except
         the data-mangling kinds, which go through :meth:`mangle`)."""
         f = self._take(seam, ("ioerror", "slow", "crash", "sigterm",
-                              "sigint", "hang"))
+                              "sigint", "hang", "partial_sigterm"))
         if f is None:
             return
         _emit_fault(f, self.step)
@@ -216,6 +229,22 @@ class FaultRegistry:
             except Exception:  # noqa: BLE001 — dying anyway
                 pass
             os._exit(_CRASH_RC)
+        if f.kind == "partial_sigterm":
+            # Membership change: only the first k of n simulated hosts are
+            # reclaimed.  The registry is per-process, so each process
+            # decides from its OWN rank; survivors print and continue —
+            # they learn about the shrink from the coordinator dying, not
+            # from the signal.
+            if _process_index() < f.k:
+                print(f"[tpuframe] FAULT INJECTION: raising SIGTERM on "
+                      f"host {_process_index()} (partial, k={f.k}) at "
+                      f"step {self.step}", flush=True)
+                os.kill(os.getpid(), signal.SIGTERM)
+            else:
+                print(f"[tpuframe] FAULT INJECTION: partial_sigterm "
+                      f"spared host {_process_index()} (k={f.k}) at "
+                      f"step {self.step}", flush=True)
+            return
         if f.kind in ("sigterm", "sigint"):
             sig = signal.SIGTERM if f.kind == "sigterm" else signal.SIGINT
             print(f"[tpuframe] FAULT INJECTION: raising {f.kind.upper()} "

@@ -143,11 +143,59 @@ class Harness:
     # (format, resolution source) from tpuframe.parallel.quantwire.resolve
     # — ("fp", "default") when nothing elected a quantized wire.
     wire_format: tuple = ("fp", "default")
+    # Full provenance of an elastic n→n′ resize detected at build time
+    # (committed checkpoint world ≠ current world), or None.  Emitted as
+    # the typed ``elastic_resize`` run event.
+    elastic_resize: dict | None = None
 
 
 def build_harness(cfg: TrainConfig) -> Harness:
     bootstrap.initialize()
-    mesh = mesh_lib.make_mesh(cfg.mesh) if cfg.distributed else None
+    # World resolution goes through the elastic resolver — the single
+    # source of truth train.py and bench.py share, read at call time so a
+    # relaunch at a new world size can never see a stale capture.
+    from tpuframe import elastic as elastic_lib
+
+    world = elastic_lib.current_world(cfg.mesh, distributed=cfg.distributed)
+    mesh = world.mesh
+    # Elastic resize detection: resuming onto a different world size than
+    # the latest committed checkpoint was written at.  The declared
+    # policy (TPUFRAME_ELASTIC_RESCALE: hold/linear/sqrt) rescales global
+    # batch + LR HERE, before loaders and optimizer are built, so the
+    # whole harness sees the post-resize config; restore then reshards
+    # the ZeRO-1 state n→n′ from shapes alone (ckpt/checkpoint.py).
+    elastic_resize = None
+    if cfg.ckpt_dir is not None and cfg.resume:
+        prev = ckpt_lib.committed_world(cfg.ckpt_dir)
+        if prev and int(prev.get("devices", 0)) not in (0, world.n_devices):
+            n_from = int(prev["devices"])
+            policy, policy_src = elastic_lib.resolve_rescale()
+            new_batch, new_lr = elastic_lib.rescale(
+                cfg.global_batch, cfg.base_lr, n_from, world.n_devices,
+                policy)
+            elastic_resize = {
+                "n_from": n_from,
+                "n_to": world.n_devices,
+                "processes_from": int(prev.get("processes", 0)) or None,
+                "at_step": int(prev.get("step", 0)),
+                "policy": policy,
+                "policy_source": policy_src,
+                "global_batch_from": cfg.global_batch,
+                "global_batch_to": new_batch,
+                "base_lr_from": cfg.base_lr,
+                "base_lr_to": new_lr,
+            }
+            if (new_batch, new_lr) != (cfg.global_batch, cfg.base_lr):
+                cfg = cfg.with_overrides(global_batch=new_batch,
+                                         base_lr=new_lr)
+            if bootstrap.is_primary():
+                print(f"[tpuframe] elastic resize: {n_from}→"
+                      f"{world.n_devices} devices at committed step "
+                      f"{elastic_resize['at_step']} (policy={policy}, "
+                      f"batch {elastic_resize['global_batch_from']}→"
+                      f"{new_batch}, lr "
+                      f"{elastic_resize['base_lr_from']:g}→{new_lr:g})",
+                      flush=True)
     # Sharded-state (auto-SPMD) mode: ZeRO/FSDP over the fsdp axis and/or
     # Megatron-style TP over the model axis — both are placement decisions
     # living on the Auto-typed mesh twin (tpuframe.parallel.fsdp.auto_mesh).
@@ -392,7 +440,8 @@ def build_harness(cfg: TrainConfig) -> Harness:
                    manager=manager, start_step=start_step,
                    remat_policy=(remat_policy, remat_source),
                    weight_update=(weight_update, wu_source),
-                   wire_format=(wire_format, wf_source))
+                   wire_format=(wire_format, wf_source),
+                   elastic_resize=elastic_resize)
 
 
 def _lm_reduce_axis(cfg: TrainConfig, *, for_grad: bool):
@@ -751,6 +800,10 @@ def _train_impl(cfg: TrainConfig, *, trace_dir: str | None = None,
     # inside build_harness already pass through the seams.
     faults_lib.reset_from_env()
     h = build_harness(cfg)
+    # An elastic resize may have rescaled global_batch/base_lr inside
+    # build_harness — everything below reads the config the harness was
+    # actually built with.
+    cfg = h.cfg
     # In distributed mode build_harness ran jax.distributed.initialize,
     # whose preemption notifier steals SIGTERM (it only logs the signal);
     # take it back so rc-14 preemption works under the supervisor too.
@@ -965,6 +1018,13 @@ def _train_impl(cfg: TrainConfig, *, trace_dir: str | None = None,
         # the predicted byte drop landed.
         events_lib.emit("wire_format", format=h.wire_format[0],
                         source=h.wire_format[1])
+        # Elastic resize provenance: the world changed across the attempt
+        # boundary.  n_from/n_to, the declared rescale policy and the
+        # exact batch/LR transition, as one typed record — the obs
+        # stitcher joins this with the per-attempt step high-water marks
+        # to prove the ≤1-lost-step invariant across the resize.
+        if h.elastic_resize is not None:
+            events_lib.emit("elastic_resize", **h.elastic_resize)
         run_info["devmem"] = devmem_lib.DevmemSampler(
             interval_s=float(os.environ.get("TPUFRAME_DEVMEM_INTERVAL_S",
                                             "30"))).start()
