@@ -38,7 +38,7 @@ def main() -> int:
     sys.path.insert(0, REPO)
     import jax
 
-    from tpuframe.analysis import strategies
+    from tpuframe.analysis import shardflow, strategies
     from tpuframe.analysis.collective_graph import graph_of_compiled
 
     os.makedirs(OUT, exist_ok=True)
@@ -59,6 +59,11 @@ def main() -> int:
             "mesh_shape": list(list(p) for p in audit.meta.mesh_shape),
             "wire_dtype": audit.meta.wire_dtype,
             "n_declared_leaves": len(audit.meta.declared_leaves),
+            # analysis v3: the integer schedule/liveness record — must
+            # stay byte-identical to the strategy's derived_schedule.json
+            # entry (tests cross-check the two files against each other).
+            "schedule": shardflow.derive_schedule_entry(
+                graph, ignore_below=audit.budget.ignore_below),
         }
         print(f"wrote {fname}: {graph.summary()}")
     with open(os.path.join(OUT, "goldens.json"), "w") as f:

@@ -688,6 +688,76 @@ def test_tf114_suppression():
     assert source_lint.lint_source(src, "tpuframe/obs/flight.py") == []
 
 
+def test_tf117_sync_barrier_in_traced_hot_path():
+    # A block_until_ready inside a traced function in parallel/ serializes
+    # the very overlap the schedule auditor scores — fires on both the
+    # module-level and method spellings.
+    src = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            y = x * 2
+            jax.block_until_ready(y)
+            return y.block_until_ready()
+    """)
+    findings = source_lint.lint_source(src, "tpuframe/parallel/step.py")
+    assert [f.rule for f in findings] == ["TF117", "TF117"]
+    # serve/engine.py is the other declared hot path.
+    findings = source_lint.lint_source(src, "tpuframe/serve/engine.py")
+    assert [f.rule for f in findings] == ["TF117", "TF117"]
+
+
+def test_tf117_device_get_in_traced_hot_path():
+    src = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def decode(tok):
+            return jax.device_get(tok)
+    """)
+    findings = source_lint.lint_source(src, "tpuframe/serve/engine.py")
+    assert [f.rule for f in findings] == ["TF117"]
+
+
+def test_tf117_untraced_and_out_of_scope_are_clean():
+    # The same barriers in an UNtraced driver loop are the legitimate
+    # spelling (that's where obs timing is supposed to sync)...
+    untraced = textwrap.dedent("""
+        import jax
+
+        def drive(step, x):
+            out = step(x)
+            jax.block_until_ready(out)
+            return jax.device_get(out)
+    """)
+    assert source_lint.lint_source(
+        untraced, "tpuframe/parallel/step.py") == []
+    # ...and traced code outside the declared hot paths is not this
+    # rule's business (TF101/TF107 own the general cases).
+    traced = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def bench(x):
+            jax.block_until_ready(x)
+            return x
+    """)
+    assert source_lint.lint_source(traced, "tpuframe/obs/bench.py") == []
+
+
+def test_tf117_suppression():
+    src = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            jax.block_until_ready(x)  # tf-lint: ok[TF117]
+            return x
+    """)
+    assert source_lint.lint_source(src, "tpuframe/parallel/step.py") == []
+
+
 def test_shipped_tree_self_lints_clean():
     import tpuframe
 

@@ -34,6 +34,11 @@ Four layers, all offline:
      pairs, wire-dtype violations, accidental replication and
      replica-group/mesh inconsistency, and per-strategy derived budgets
      drift-checked against the checked-in ``derived_budgets.json``.
+     Analysis v3 adds the *schedule* plane on the same graph: async
+     start/done overlap windows, an exposed-communication detector, a
+     buffer-liveness peak-HBM estimator pinned in
+     ``derived_schedule.json``, and a roofline overlap-potential score
+     per strategy.
 
 CLI: ``python -m tpuframe.analysis`` (see ``__main__.py``) runs all
 four layers CPU-only and exits non-zero on any finding — the CI gate.
@@ -50,10 +55,15 @@ from tpuframe.analysis.budgets import (  # noqa: F401
 )
 from tpuframe.analysis.collective_graph import (  # noqa: F401
     CollectiveGraph,
+    CollectiveWindow,
     Computation,
+    LivenessReport,
     Node,
+    ScheduleView,
     graph_of_compiled,
+    liveness,
     parse_graph,
+    schedule_view,
 )
 from tpuframe.analysis.hlo_audit import (  # noqa: F401
     CollectiveOp,
@@ -75,7 +85,9 @@ from tpuframe.analysis.shardflow import (  # noqa: F401
     compare_reports,
     derive_budget,
     derived_for,
+    overlap_score,
     register_wire_format,
+    schedule_for,
 )
 from tpuframe.analysis.source_lint import (  # noqa: F401
     LintFinding,

@@ -25,16 +25,24 @@ non-zero on any finding:
      (``tpuframe.mem.check``);
   7. shardflow — the structural detectors of
      :mod:`tpuframe.analysis.shardflow` (redundant collective pairs,
-     wire-dtype, accidental replication, replica-group consistency) run
-     over the collective-flow graph of every compiled strategy, and the
-     auto-derived per-kind budgets are drift-checked against the
-     checked-in ``derived_budgets.json`` (regenerate with
-     ``--emit-budgets``).
+     wire-dtype, accidental replication, replica-group consistency,
+     exposed communication) run over the collective-flow graph of every
+     compiled strategy; the auto-derived per-kind budgets are
+     drift-checked against the checked-in ``derived_budgets.json``
+     (regenerate with ``--emit-budgets``) and the schedule/liveness
+     records against ``derived_schedule.json`` (regenerate with
+     ``--emit-schedule``);
+  8. compare selfcheck — the jax-free golden compare pair under
+     ``docs/samples/analysis_compare/`` must keep exercising the whole
+     ``--compare`` contract (schema keys, rc codes, the schedule
+     section), so a report-schema change that strands the differ fails
+     CI before it ships.
 
 ``--json PATH`` writes the whole gate outcome as a schema-pinned report;
 ``--compare A.json B.json`` diffs two such reports for structural
 collective regressions (rc 1 regression / 0 clean / 2 no overlap — the
-``obs compare`` contract) without touching jax at all.
+``obs compare`` contract) without touching jax at all; ``--selfcheck``
+runs only leg 8 (also jax-free).
 
 Strategies this interpreter cannot express (see
 :class:`~tpuframe.analysis.strategies.Unavailable`) print as SKIP and do
@@ -95,6 +103,14 @@ def _parse(argv):
                     help="regenerate tpuframe/analysis/"
                          "derived_budgets.json from the compiled "
                          "strategies (the drift check's declarations)")
+    ap.add_argument("--emit-schedule", action="store_true",
+                    help="regenerate tpuframe/analysis/"
+                         "derived_schedule.json (per-strategy "
+                         "liveness/overlap-window records) from the "
+                         "compiled strategies")
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="validate the golden --compare pair and the "
+                         "pinned report schema (no jax), then exit")
     ap.add_argument("--compare", nargs=2, metavar=("A", "B"),
                     default=None,
                     help="diff two --json reports for structural "
@@ -135,12 +151,16 @@ def _run_strategies(names, n_devices) -> tuple[int, list]:
     return failures, audits
 
 
-def _run_shardflow(audits, n_devices, *, emit: bool) -> int:
+def _run_shardflow(audits, n_devices, *, emit: bool,
+                   emit_schedule: bool) -> int:
     from tpuframe.analysis import shardflow
 
     if emit:
         shardflow.emit_derived(audits, n_devices=n_devices)
         print(f"[analysis] wrote {shardflow.DERIVED_BUDGETS_PATH}")
+    if emit_schedule:
+        shardflow.emit_schedule(audits, n_devices=n_devices)
+        print(f"[analysis] wrote {shardflow.DERIVED_SCHEDULE_PATH}")
     problems = shardflow.check(audits, n_devices=n_devices)
     for p in problems:
         print(f"FLOW {p}")
@@ -248,6 +268,17 @@ def _run_obs_check() -> int:
     return 1 if rc else 0
 
 
+def _run_flow_selfcheck() -> int:
+    # Jax-free: pure JSON over the checked-in golden compare pair.
+    from tpuframe.analysis import shardflow
+
+    problems = shardflow.selfcheck()
+    for p in problems:
+        print(f"SELFCHECK {p}")
+    print(f"[analysis] compare selfcheck: {len(problems)} problem(s)")
+    return len(problems)
+
+
 def _run_registry_checks() -> int:
     from tpuframe.analysis.budgets import check_known_exclusions
 
@@ -268,9 +299,14 @@ def main(argv=None) -> int:
         return _run_compare(args.compare[0], args.compare[1],
                             args.bytes_tol)
 
-    if args.emit_budgets and args.strategy:
-        print("[analysis] --emit-budgets regenerates the whole "
-              "declaration file and cannot be combined with --strategy")
+    if args.selfcheck:
+        # Also jax-free: golden-pair + schema validation only.
+        return 1 if _run_flow_selfcheck() else 0
+
+    if (args.emit_budgets or args.emit_schedule) and args.strategy:
+        print("[analysis] --emit-budgets/--emit-schedule regenerate the "
+              "whole declaration file and cannot be combined with "
+              "--strategy")
         return 2
 
     if not args.lint_only and os.environ.get(_CHILD_FLAG) != "1":
@@ -284,6 +320,8 @@ def main(argv=None) -> int:
             cmd += ["--json", args.json]
         if args.emit_budgets:
             cmd += ["--emit-budgets"]
+        if args.emit_schedule:
+            cmd += ["--emit-schedule"]
         cmd += args.paths or []
         return subprocess.call(cmd, env=_scrubbed_cpu_env(args.devices))
 
@@ -294,7 +332,9 @@ def main(argv=None) -> int:
             tuple(args.strategy) if args.strategy else None, args.devices)
         n_findings += strat_failures
         n_findings += _run_shardflow(audits, args.devices,
-                                     emit=args.emit_budgets)
+                                     emit=args.emit_budgets,
+                                     emit_schedule=args.emit_schedule)
+        n_findings += _run_flow_selfcheck()
         n_findings += _run_registry_checks()
         n_findings += _run_tune_check()
         n_findings += _run_mem_check()

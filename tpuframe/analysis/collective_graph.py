@@ -22,6 +22,28 @@ not the wire-traffic proxy — budget derivation stays on
 ``hlo_audit.parse_collectives`` so the derived budgets and the audit
 ceilings are measured by the same ruler; the graph cross-checks the
 census by collective *count*, where the two parsers must agree exactly.
+
+Analysis v3 adds the *schedule* view on top of the def-use view.  The
+optimized HLO the strategies audit is post-scheduling text
+(``is_scheduled=true`` in the module header), so a computation's
+instruction order IS the linear schedule the backend will execute.  From
+that order this module derives, per computation:
+
+  * async collective ``-start``/``-done`` pairing
+    (:func:`Computation.pair_async`), chased through intervening
+    ``copy``/``bitcast``/``get-tuple-element`` chains — an unpaired
+    start is a *parser* problem surfaced loudly, never skipped;
+  * per-collective overlap windows (:func:`schedule_view`): the ops
+    scheduled inside each start→done window (actually overlapped), and
+    the set of compute ops *legally interleavable* with the collective —
+    ops that are neither ancestors nor descendants of it in the def-use
+    graph, i.e. what a fusion/overlap pass could move into the window;
+  * a buffer-liveness peak-HBM estimate (:func:`liveness`): each
+    value's buffer is live from its def to its last use in schedule
+    order (the root escapes to the end), aliasing ops own no bytes, and
+    donated entry parameters (``input_output_alias`` in the module
+    header) are recognized so an UN-donated input whose full-shape
+    update coexists with it — doubled residency — is flagged.
 """
 
 from __future__ import annotations
@@ -69,6 +91,35 @@ PASSTHROUGH_OPS = frozenset({
     "reduce-scatter-done", "collective-permute-done", "all-to-all-done",
 })
 
+#: ops the async ``-done`` chase looks through when pairing a done with
+#: its ``-start`` — the compiler routinely threads the in-flight token
+#: through copies/bitcasts/tuple plumbing between the two.
+ASYNC_CHASE_OPS = frozenset({
+    "copy", "bitcast", "get-tuple-element", "optimization-barrier",
+})
+
+#: opcodes whose result aliases (a slice of) an operand buffer — they
+#: own zero bytes in the liveness model.  ``tuple`` is composite (its
+#: components own their own buffers); the ``-done`` of an async
+#: collective returns the buffer the ``-start`` already allocated.
+LIVENESS_ALIAS_OPS = frozenset({
+    "bitcast", "get-tuple-element", "tuple", "optimization-barrier",
+    "all-reduce-done", "all-gather-done", "reduce-scatter-done",
+    "collective-permute-done", "all-to-all-done",
+})
+
+#: opcodes that represent real device compute for the overlap windows —
+#: what a scheduler can actually hide a collective behind.  Post-fusion
+#: HLO packs nearly all element-wise work into ``fusion`` ops; ``while``
+#: covers scan bodies, ``custom-call`` covers pallas kernels.
+COMPUTE_OPS = frozenset({
+    "dot", "convolution", "fusion", "custom-call", "while",
+    "conditional", "reduce", "reduce-window", "scatter", "gather",
+    "sort", "select-and-scatter", "triangular-solve", "cholesky",
+})
+
+_ALIAS_ENTRY_RE = re.compile(r"\(\s*(\d+)\s*,")
+
 _COLLECTIVE_OPS = {}
 for _k in COLLECTIVE_KINDS:
     _COLLECTIVE_OPS[_k] = _k
@@ -87,6 +138,31 @@ def _span_paren(line: str, start: int) -> int:
             if depth == 0:
                 return i + 1
     return len(line)
+
+
+def _parse_alias_params(line: str) -> frozenset:
+    """Parameter numbers the module aliases to an output (donation), from
+    the ``input_output_alias={ {0}: (0, {}, may-alias), ... }`` table in
+    the HloModule header line.  Each entry's tuple leads with the
+    parameter number; nesting is bounded so a brace scan finds the block
+    end."""
+    key = "input_output_alias="
+    at = line.find(key)
+    if at < 0:
+        return frozenset()
+    tail = line[at + len(key):]
+    depth = 0
+    end = 0
+    for i, ch in enumerate(tail):
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                end = i
+                break
+    return frozenset(int(m.group(1))
+                     for m in _ALIAS_ENTRY_RE.finditer(tail[:end + 1]))
 
 
 def _parse_groups(txt: str) -> tuple[tuple[int, ...], ...]:
@@ -114,6 +190,7 @@ class Node:
     source_target_pairs: tuple[tuple[int, ...], ...] | None = None
     channel_id: int | None = None
     sharded: bool = False           # carries a sharding={...} annotation
+    param_number: int | None = None  # parameter(N) ordinal, else None
     line_no: int = 0
     line: str = ""                  # stripped, truncated source line
 
@@ -174,6 +251,76 @@ class Computation:
     def collectives(self) -> list[Node]:
         return [n for n in self.nodes.values() if n.kind is not None]
 
+    def schedule_order(self) -> dict[str, int]:
+        """name -> linear schedule position.  The parsed node order is
+        the printed instruction order, which for post-scheduling HLO
+        (``is_scheduled=true``) is the sequence the backend executes."""
+        return {name: i for i, name in enumerate(self.nodes)}
+
+    def pair_async(self) -> tuple[dict[str, str], list[str]]:
+        """Pair every async collective ``-start`` with its ``-done``.
+
+        The chase walks each ``-done``'s operand chain back through
+        ``copy``/``bitcast``/``get-tuple-element`` (and optimization
+        barriers) to the start that produced the in-flight token — the
+        compiler routinely threads plumbing ops between the two, and
+        pairing only direct operands silently drops those windows.
+        Returns ``(start_name -> done_name, problems)``; an unpaired
+        start (or a start claimed by two dones) is a problem string the
+        census check fails loudly on, never a silent skip."""
+        pairs: dict[str, str] = {}
+        problems: list[str] = []
+        starts = {n.name for n in self.nodes.values()
+                  if n.kind is not None and n.is_async_start}
+        for node in self.nodes.values():
+            if not node.op.endswith("-done") or not node.operands:
+                continue
+            name = node.operands[0]
+            seen: set = set()
+            while name in self.nodes and name not in seen:
+                seen.add(name)
+                src = self.nodes[name]
+                if src.name in starts:
+                    break
+                if src.op in ASYNC_CHASE_OPS and src.operands:
+                    name = src.operands[0]
+                    continue
+                break
+            if name in starts:
+                if name in pairs:
+                    problems.append(
+                        f"async start %{name} in %{self.name} is consumed "
+                        f"by two -done ops (%{pairs[name]}, %{node.name}) "
+                        f"— the pairing chase is confused")
+                else:
+                    pairs[name] = node.name
+        for sname in sorted(starts - set(pairs)):
+            problems.append(
+                f"unpaired async start %{sname} in %{self.name}: no "
+                f"matching -done found through the copy/bitcast/"
+                f"get-tuple-element chase — the schedule auditor would "
+                f"run blind on this collective")
+        return pairs, problems
+
+    def dependency_cone(self, name: str, *, forward: bool,
+                        users: dict[str, list[str]] | None = None) -> set:
+        """Transitive descendants (``forward=True``) or ancestors of a
+        node within this computation, excluding the node itself."""
+        if users is None:
+            users = self.users_of()
+        cone: set = set()
+        frontier = [name]
+        while frontier:
+            cur = frontier.pop()
+            nxt = (users.get(cur, []) if forward
+                   else list(self.nodes[cur].operands)
+                   if cur in self.nodes else [])
+            for other in nxt:
+                if other not in cone and other in self.nodes:
+                    cone.add(other)
+                    frontier.append(other)
+        return cone
+
 
 @dataclass
 class CollectiveGraph:
@@ -181,6 +328,9 @@ class CollectiveGraph:
 
     computations: dict[str, Computation] = field(default_factory=dict)
     entry: str | None = None
+    #: entry parameter numbers donated to an output
+    #: (``input_output_alias`` in the HloModule header)
+    aliased_params: frozenset = frozenset()
 
     @property
     def entry_computation(self) -> Computation | None:
@@ -219,6 +369,231 @@ class CollectiveGraph:
         }
 
 
+@dataclass
+class CollectiveWindow:
+    """One collective's slot in a computation's linear schedule.
+
+    ``start_pos``/``done_pos`` are schedule positions; for a sync
+    collective they coincide (the op blocks — zero window).  ``exposed``
+    means no compute op is scheduled inside the start→done window, i.e.
+    every microsecond of that transfer is on the critical path.  The
+    ``interleavable_*`` fields count compute ops that are neither
+    ancestors nor descendants of the collective in the def-use graph —
+    work a scheduling/fusion pass could legally move into the window."""
+
+    name: str                     # the start (or sync) instruction name
+    kind: str                     # canonical collective kind
+    bytes: int                    # result bytes of the collective node
+    is_async: bool
+    start_pos: int
+    done_pos: int
+    done_name: str | None
+    overlapped_compute: int       # compute ops actually in the window
+    overlapped_bytes: int
+    interleavable_compute: int    # compute ops legally movable into it
+    interleavable_bytes: int
+    exposed: bool
+
+    @property
+    def window_len(self) -> int:
+        """Def-use distance start→done in schedule positions."""
+        return self.done_pos - self.start_pos
+
+
+@dataclass
+class ScheduleView:
+    """All collective windows of one computation, schedule-ordered."""
+
+    computation: str
+    n_positions: int
+    windows: tuple[CollectiveWindow, ...] = ()
+    problems: tuple[str, ...] = ()   # unpaired/double-paired async starts
+
+
+def schedule_view(comp: Computation) -> ScheduleView:
+    """Derive the per-collective overlap windows of ``comp``.
+
+    Sync collectives (no ``-start``/``-done`` split) block the schedule
+    by construction: zero window, exposed by definition.  Async starts
+    get the ops scheduled strictly between start and done (the actually
+    overlapped set) and the legally interleavable compute set via the
+    dependency cones — both restricted to :data:`COMPUTE_OPS`, since
+    hiding a transfer behind a ``bitcast`` hides nothing."""
+    order = comp.schedule_order()
+    users = comp.users_of()
+    pairs, problems = comp.pair_async()
+    node_at = list(comp.nodes.values())
+    windows = []
+    for node in comp.collectives():
+        if node.is_async_start and node.name not in pairs:
+            continue   # already surfaced as an unpaired-start problem
+        start_pos = order[node.name]
+        done_name = pairs.get(node.name)
+        done_pos = order[done_name] if done_name else start_pos
+        over_n = over_b = 0
+        for pos in range(start_pos + 1, done_pos):
+            inside = node_at[pos]
+            if inside.op in COMPUTE_OPS:
+                over_n += 1
+                over_b += inside.result_bytes
+        # Everything data-dependent on the start (the -done and its
+        # consumers included) or feeding it cannot move into the window;
+        # the rest of the computation's compute ops can.
+        blocked = comp.dependency_cone(node.name, forward=True,
+                                       users=users)
+        blocked |= comp.dependency_cone(node.name, forward=False,
+                                        users=users)
+        blocked.add(node.name)
+        inter_n = inter_b = 0
+        for other in comp.nodes.values():
+            if other.op in COMPUTE_OPS and other.name not in blocked:
+                inter_n += 1
+                inter_b += other.result_bytes
+        windows.append(CollectiveWindow(
+            name=node.name,
+            kind=node.kind,
+            bytes=node.result_bytes,
+            is_async=node.is_async_start,
+            start_pos=start_pos,
+            done_pos=done_pos,
+            done_name=done_name,
+            overlapped_compute=over_n,
+            overlapped_bytes=over_b,
+            interleavable_compute=inter_n,
+            interleavable_bytes=inter_b,
+            exposed=(over_n == 0),
+        ))
+    windows.sort(key=lambda w: w.start_pos)
+    return ScheduleView(computation=comp.name,
+                        n_positions=len(comp.nodes),
+                        windows=tuple(windows),
+                        problems=tuple(problems))
+
+
+@dataclass
+class LivenessReport:
+    """Schedule-order buffer-liveness estimate for one computation.
+
+    A buffer is live from its def to its last use (through aliasing
+    ops); the root's buffers escape to the caller and live to the end.
+    ``peak_bytes`` is the sweep-line maximum of concurrently live bytes
+    — a static *lower bound* on peak HBM (no padding, no workspace),
+    but one whose drift tracks real residency changes.  ``undonated``
+    lists large entry parameters that are not in the module's
+    ``input_output_alias`` table yet shape-match a root output
+    component: the classic donate-forgotten input whose full-shape
+    update coexists with it, doubling residency."""
+
+    computation: str
+    peak_bytes: int
+    peak_pos: int
+    total_defined_bytes: int
+    undonated: tuple[str, ...] = ()
+
+
+def liveness(comp: Computation,
+             aliased_params: frozenset = frozenset(),
+             *, undonated_floor: int = 1 << 20) -> LivenessReport:
+    """Sweep-line peak-live-bytes over ``comp``'s linear schedule."""
+    order = comp.schedule_order()
+    n = len(comp.nodes)
+
+    # Buffer ownership: aliasing ops own zero bytes and forward liveness
+    # to the buffers of the value(s) they view.  ``tuple`` fans out to
+    # every component, so a use of the tuple keeps all of them alive.
+    roots_cache: dict[str, tuple] = {}
+
+    def roots(name: str) -> tuple:
+        if name not in comp.nodes:
+            return ()
+        cached = roots_cache.get(name)
+        if cached is not None:
+            return cached
+        roots_cache[name] = ()   # SSA has no cycles; guard regardless
+        node = comp.nodes[name]
+        if node.op in LIVENESS_ALIAS_OPS and node.operands:
+            if node.op == "tuple":
+                out: dict[str, None] = {}
+                for op_name in node.operands:
+                    for r in roots(op_name):
+                        out[r] = None
+                result = tuple(out)
+            else:
+                result = roots(node.operands[0])
+        else:
+            result = (name,)
+        roots_cache[name] = result
+        return result
+
+    last_use: dict[str, int] = {}
+    for node in comp.nodes.values():
+        pos = order[node.name]
+        for op_name in node.operands:
+            for owner in roots(op_name):
+                if last_use.get(owner, -1) < pos:
+                    last_use[owner] = pos
+    if comp.root is not None:
+        for owner in roots(comp.root):
+            last_use[owner] = n   # escapes to the caller
+    # paired async starts stay live through their -done even when the
+    # done is the only (chased) consumer recorded above
+    pairs, _ = comp.pair_async()
+    for start, done in pairs.items():
+        for owner in roots(start):
+            if last_use.get(owner, -1) < order[done]:
+                last_use[owner] = order[done]
+
+    events: dict[int, int] = {}
+    total = 0
+    for name, node in comp.nodes.items():
+        if roots(name) != (name,):
+            continue   # alias view — owns nothing
+        nbytes = node.result_bytes
+        if not nbytes:
+            continue
+        total += nbytes
+        start = order[name]
+        end = last_use.get(name, start)
+        events[start] = events.get(start, 0) + nbytes
+        events[end + 1] = events.get(end + 1, 0) - nbytes
+    live = peak = 0
+    peak_pos = 0
+    for pos in sorted(events):
+        live += events[pos]
+        if live > peak:
+            peak = live
+            peak_pos = pos
+
+    # Donation flag: large un-donated entry parameters whose exact
+    # (dtype, dims) recurs in the root output — full-shape update and
+    # original coexist at the peak.
+    undonated: list[str] = []
+    if comp.is_entry and comp.root is not None:
+        out_shapes = set()
+        root_node = comp.nodes.get(comp.root)
+        if root_node is not None:
+            sources = (root_node.operands if root_node.op == "tuple"
+                       else (comp.root,))
+            for src in sources:
+                src_node = comp.nodes.get(src)
+                if src_node is not None:
+                    out_shapes.update(src_node.shapes)
+        for param in comp.parameters():
+            if (param.param_number is not None
+                    and param.param_number not in aliased_params
+                    and param.result_bytes >= undonated_floor
+                    and any(s in out_shapes for s in param.shapes)):
+                undonated.append(param.name)
+
+    return LivenessReport(
+        computation=comp.name,
+        peak_bytes=peak,
+        peak_pos=peak_pos,
+        total_defined_bytes=total,
+        undonated=tuple(sorted(undonated)),
+    )
+
+
 def _parse_instruction(line: str, line_no: int,
                        m: re.Match) -> Node:
     is_root, name, result_txt, op = (bool(m.group(1)), m.group(2),
@@ -247,6 +622,8 @@ def _parse_instruction(line: str, line_no: int,
         source_target_pairs=_parse_groups(pm.group(1)) if pm else None,
         channel_id=int(cm.group(1)) if cm else None,
         sharded=bool(_SHARDING_RE.search(attrs_txt)),
+        param_number=(int(args_txt) if op == "parameter"
+                      and args_txt.strip().isdigit() else None),
         line_no=line_no,
         line=line.strip()[:200],
     )
@@ -259,6 +636,9 @@ def parse_graph(txt: str) -> CollectiveGraph:
     for line_no, raw in enumerate(txt.splitlines(), start=1):
         stripped = raw.strip()
         if current is None:
+            if stripped.startswith("HloModule"):
+                graph.aliased_params |= _parse_alias_params(stripped)
+                continue
             cm = _COMP_RE.match(stripped)
             if cm:
                 current = Computation(name=cm.group(2),

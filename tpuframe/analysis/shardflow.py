@@ -38,6 +38,28 @@ of truth.  ``budgets.py``'s hand-declared class ceilings stay as policy
 (which *kinds* may exist at what order of magnitude); the derived file
 is the byte-exact record of what the compiler actually emits today.
 
+Analysis v3 adds the *schedule* plane on top of the structural one:
+
+  (e) :func:`detect_exposed_comm` — async collective starts consumed
+      back-to-back (zero overlap window).  Pairing failures (a start
+      whose ``-done`` the chase cannot find) surface unconditionally;
+      the zero-window finding itself only FAILS strategies that declare
+      themselves overlapped (``StrategyMeta.declared_overlapped``) —
+      CPU-compiled audit programs have no async scheduler, so today's
+      strategies are reported exposed, not failed.
+  (f) the per-strategy schedule/liveness record
+      (:func:`derive_schedule_entry` — peak live bytes, un-donated
+      doubled-residency inputs, window census) is pinned in
+      ``derived_schedule.json`` under the exact ``--emit-budgets``
+      contract: jax-version-stamped, drift in either direction fails,
+      ``python -m tpuframe.analysis --emit-schedule`` regenerates it.
+  (g) :func:`overlap_score` — hideable-comm milliseconds (roofline ICI
+      model over each collective's wire bytes, capped by the HBM
+      roofline over the compute legally interleavable with it) as a
+      fraction of total comm: the ranked target list the bucketed-fusion
+      work (ROADMAP item 4, arXiv:1802.05799) starts from, and the
+      regression sentry it will be judged against.
+
 Stdlib-only at import time (the ``hlo_audit`` contract); jax is touched
 only inside the gate entry points that already run under the analysis
 CLI's scrubbed child process.
@@ -54,10 +76,21 @@ from tpuframe.analysis import collective_graph as cg
 from tpuframe.analysis import hlo_audit
 
 #: schema version of both the --json report and derived_budgets.json.
-REPORT_SCHEMA = 1
+#: v2: per-strategy "schedule" (liveness/window census), "overlap"
+#: (roofline overlap-potential score), and the exposed_comm detector.
+REPORT_SCHEMA = 2
 
 DERIVED_BUDGETS_PATH = os.path.join(os.path.dirname(
     os.path.abspath(__file__)), "derived_budgets.json")
+
+DERIVED_SCHEDULE_PATH = os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "derived_schedule.json")
+
+#: golden --compare pair the jax-free selfcheck validates (pins both the
+#: report schema and the schedule section of the differ).
+SAMPLES_COMPARE_DIR = os.path.normpath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "..", "..", "docs", "samples", "analysis_compare"))
 
 #: floating wire dtypes by width; integer/pred collectives are index
 #: bookkeeping and never wire-dtype findings.
@@ -351,6 +384,114 @@ def census_cross_check(graph: cg.CollectiveGraph,
             f"program contains"]
 
 
+def detect_exposed_comm(graph: cg.CollectiveGraph,
+                        declared_overlapped: bool,
+                        *, ignore_below: int = 0) -> list[str]:
+    """(e) exposed communication: collective starts with a zero overlap
+    window (or sync collectives, which block by construction).
+
+    Async pairing problems — a ``-start`` whose ``-done`` the chase
+    cannot find — are findings REGARDLESS of the declaration: a blind
+    window is a parser/schedule bug, not a policy choice.  The exposure
+    finding itself only fires on strategies that declare themselves
+    overlapped; everyone else gets the count in the schedule record and
+    the overlap score, not a gate failure."""
+    findings: list[str] = []
+    for comp in graph.computations.values():
+        view = cg.schedule_view(comp)
+        findings.extend(view.problems)
+        if not declared_overlapped:
+            continue
+        for w in view.windows:
+            if w.bytes < ignore_below or not w.exposed:
+                continue
+            what = ("consumed back-to-back (zero-op start->done window)"
+                    if w.is_async else
+                    "emitted synchronous (no start/done split at all)")
+            findings.append(
+                f"exposed communication in %{comp.name}: {w.kind} "
+                f"%{w.name} ({w.bytes} B) is {what} but the strategy "
+                f"declares its collectives overlapped — "
+                f"{w.interleavable_compute} compute op(s) "
+                f"({w.interleavable_bytes} B) were legally interleavable")
+    return findings
+
+
+# A minimal scheduled module whose async all-reduce is consumed
+# back-to-back — zero ops inside the start->done window — while an
+# independent fusion sits RIGHT THERE, legally interleavable.  The
+# exposed-comm detector must flag it under a declared-overlapped
+# strategy, and the liveness pass must reproduce its hand-computed peak.
+_SEEDED_EXPOSED_HLO = """\
+HloModule seeded_exposed_positive, is_scheduled=true
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %r = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main (p0: f32[65536], p1: f32[65536]) -> (f32[65536], f32[65536]) {
+  %p0 = f32[65536]{0} parameter(0)
+  %p1 = f32[65536]{0} parameter(1)
+  %ars = f32[65536]{0} all-reduce-start(f32[65536]{0} %p0), replica_groups={}, to_apply=%add
+  %ard = f32[65536]{0} all-reduce-done(f32[65536]{0} %ars)
+  %fus = f32[65536]{0} fusion(f32[65536]{0} %p1), kind=kLoop, calls=%add
+  ROOT %out = (f32[65536]{0}, f32[65536]{0}) tuple(%ard, %fus)
+}
+"""
+
+#: hand-computed liveness of ``_SEEDED_EXPOSED_HLO``'s entry: at the
+#: all-reduce-start, its input p0 is still live alongside p1 and the
+#: start's own 256 KiB result buffer (the done merely aliases it) — three
+#: buffers; p0 then dies, and the fusion's result brings it back to three
+#: (p1 + in-flight ars + fus, the latter two escaping through the root
+#: tuple).  Peak is 3 x 262144 bytes.
+_SEEDED_PEAK_BYTES = 3 * 262144
+
+
+def seeded_schedule_positive() -> list[str]:
+    """Self-test of the schedule plane — the gate refuses to run blind.
+
+    Three invariants over the seeded zero-overlap program: the
+    exposed-comm detector must flag it under a declared-overlapped
+    strategy (and stay quiet under an undeclared one), the liveness
+    estimator must reproduce the hand-computed peak, and the
+    schedule-drift differ must catch a tampered peak declaration."""
+    problems: list[str] = []
+    graph = cg.parse_graph(_SEEDED_EXPOSED_HLO)
+    found = detect_exposed_comm(graph, True)
+    if len(found) != 1 or "back-to-back" not in found[0]:
+        problems.append(
+            f"seeded exposed-comm positive: expected exactly 1 zero-window "
+            f"finding for a back-to-back all-reduce-start under a "
+            f"declared-overlapped strategy, got {found!r} — the detector "
+            f"is blind")
+    if detect_exposed_comm(graph, False):
+        problems.append(
+            "seeded exposed-comm positive: an UNdeclared strategy must "
+            "not fail on exposure (report-only contract broken)")
+    entry = graph.entry_computation
+    lv = cg.liveness(entry, graph.aliased_params)
+    if lv.peak_bytes != _SEEDED_PEAK_BYTES:
+        problems.append(
+            f"seeded liveness positive: hand-computed peak "
+            f"{_SEEDED_PEAK_BYTES} B but the estimator says "
+            f"{lv.peak_bytes} B — the sweep is mis-measuring")
+    fresh = derive_schedule_entry(graph, ignore_below=1024)
+    tampered = dict(fresh, peak_live_bytes=fresh["peak_live_bytes"] + 4096)
+    if not _schedule_entry_drift("seeded", fresh, tampered):
+        problems.append(
+            "seeded liveness-drift positive: a +4096 B tampered "
+            "peak_live_bytes declaration produced no drift finding — "
+            "the drift gate is blind")
+    if _schedule_entry_drift("seeded", fresh, dict(fresh)):
+        problems.append(
+            "seeded liveness-drift positive: an identical declaration "
+            "produced a drift finding — the differ is unstable")
+    return problems
+
+
 # ---------------------------------------------------------------------------
 # Derived budgets: the exact per-kind record, emitted and drift-checked.
 # ---------------------------------------------------------------------------
@@ -515,12 +656,193 @@ def derived_for(name: str, *, path: str = DERIVED_BUDGETS_PATH
 
 
 # ---------------------------------------------------------------------------
+# Derived schedule: liveness + window census, emitted and drift-checked
+# (the --emit-budgets idiom, one file per plane).
+# ---------------------------------------------------------------------------
+
+
+def derive_schedule_entry(graph: cg.CollectiveGraph, *,
+                          ignore_below: int) -> dict:
+    """Integer-exact schedule/liveness record of one compiled program —
+    what ``derived_schedule.json`` pins per strategy.
+
+    ``peak_live_bytes``/``undonated_doubles`` come from the entry
+    computation's liveness sweep (the floor for the donation flag is the
+    budget's ``ignore_below`` — one ruler per strategy); the window
+    census spans every computation, so collectives inside while bodies
+    count.  All values are ints, so emission is byte-exactly
+    reproducible."""
+    entry = graph.entry_computation
+    lv = (cg.liveness(entry, graph.aliased_params,
+                      undonated_floor=max(int(ignore_below), 1))
+          if entry is not None else None)
+    n_coll = n_pairs = n_exposed = inter_bytes = 0
+    for comp in graph.computations.values():
+        pairs, _ = comp.pair_async()
+        n_pairs += len(pairs)
+        n_coll += len(comp.collectives())
+        for w in cg.schedule_view(comp).windows:
+            if w.bytes < ignore_below:
+                continue
+            if w.exposed:
+                n_exposed += 1
+            inter_bytes += w.interleavable_bytes
+    return {
+        "ignore_below": int(ignore_below),
+        "peak_live_bytes": int(lv.peak_bytes) if lv else 0,
+        "undonated_doubles": len(lv.undonated) if lv else 0,
+        "collectives": int(n_coll),
+        "async_pairs": int(n_pairs),
+        "exposed_above_floor": int(n_exposed),
+        "interleavable_bytes": int(inter_bytes),
+    }
+
+
+def load_derived_schedule(path: str = DERIVED_SCHEDULE_PATH
+                          ) -> dict | None:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict) or "strategies" not in data:
+        return None
+    return data
+
+
+def emit_schedule(audits, *, n_devices: int,
+                  path: str = DERIVED_SCHEDULE_PATH) -> dict:
+    """Regenerate ``derived_schedule.json`` from fresh audits —
+    ``python -m tpuframe.analysis --emit-schedule``."""
+    data = {
+        "schema": REPORT_SCHEMA,
+        "jax": _jax_version(),
+        "n_devices": int(n_devices),
+        "strategies": {
+            a.name: derive_schedule_entry(
+                cg.parse_graph(a.compiled.as_text()),
+                ignore_below=a.budget.ignore_below)
+            for a in audits
+            if a.status in ("ok", "violation") and a.compiled is not None
+        },
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+def _schedule_entry_drift(name: str, fresh: dict,
+                          declared: dict) -> list[str]:
+    """Field-by-field diff of one strategy's schedule record — either
+    direction is a finding (a peak that *improved* silently is a stale
+    declaration, same as a regression)."""
+    problems = []
+    for key in sorted(set(fresh) | set(declared)):
+        if fresh.get(key) != declared.get(key):
+            problems.append(
+                f"[{name}] derived-schedule drift on {key}: compiled "
+                f"program has {fresh.get(key)!r} but "
+                f"derived_schedule.json declares {declared.get(key)!r} — "
+                f"fix the regression or re-emit with --emit-schedule")
+    return problems
+
+
+def schedule_drift(audit, schedule_file: dict | None, *,
+                   graph: cg.CollectiveGraph | None = None) -> list[str]:
+    """Diff a fresh schedule derivation against the checked-in record —
+    the budget_drift contract: missing file/entry is a finding, version
+    skew is a skip (pinned to the emitting jax), drift either way
+    fails."""
+    if schedule_file is None:
+        return ["derived_schedule.json missing/unreadable — run "
+                "`python -m tpuframe.analysis --emit-schedule`"]
+    if schedule_file.get("jax") != _jax_version():
+        return []  # another jax schedules differently; pinned to emitter
+    declared = schedule_file.get("strategies", {}).get(audit.name)
+    if declared is None:
+        return [f"[{audit.name}] compiles here but has no entry in "
+                f"derived_schedule.json — run `python -m tpuframe."
+                f"analysis --emit-schedule` to declare its schedule "
+                f"record"]
+    if graph is None:
+        graph = cg.parse_graph(audit.compiled.as_text())
+    fresh = derive_schedule_entry(graph,
+                                  ignore_below=audit.budget.ignore_below)
+    return _schedule_entry_drift(audit.name, fresh, declared)
+
+
+def schedule_for(name: str, *, path: str = DERIVED_SCHEDULE_PATH
+                 ) -> dict | None:
+    """Checked-in schedule record for one strategy (tests assert against
+    this instead of hand-copying byte constants)."""
+    data = load_derived_schedule(path)
+    if data is None:
+        return None
+    return data.get("strategies", {}).get(name)
+
+
+def overlap_score(graph: cg.CollectiveGraph, report, *,
+                  n_devices: int, ignore_below: int,
+                  generation: str = "v5e") -> dict:
+    """Overlap-potential score of one compiled program.
+
+    Per above-floor collective window: its wire milliseconds come from
+    the roofline ICI ring model over the bytes ``hlo_audit`` counted for
+    that instruction (matched by source line, so the wire ruler — s8
+    payloads, halved starts — carries over; result bytes are the
+    fallback for ops the census floor dropped), and the compute
+    *legally interleavable* with it is priced by the HBM roofline.  The
+    hideable share of each window is ``min(comm, interleavable)``;
+    ``overlap_potential`` is total hideable over total comm (1.0 when
+    there is no above-floor comm — nothing to hide).  Floats, report
+    plane only — the drift gate pins the integer schedule record, not
+    this score."""
+    from tpuframe.tune import roofline
+
+    line_bytes: dict[str, list] = {}
+    if report is not None:
+        for op in report.ops:
+            line_bytes.setdefault(op.line, []).append(int(op.bytes))
+    comm = inter = hide = 0.0
+    n_exposed = n_above = 0
+    for comp in graph.computations.values():
+        for w in cg.schedule_view(comp).windows:
+            node = comp.nodes[w.name]
+            matched = line_bytes.get(node.line)
+            nbytes = matched.pop(0) if matched else w.bytes
+            if nbytes < ignore_below:
+                continue
+            n_above += 1
+            c_ms = roofline.comm_ms(generation, w.kind, nbytes, n_devices)
+            i_ms = roofline.hbm_ms(generation, w.interleavable_bytes)
+            comm += c_ms
+            inter += i_ms
+            hide += min(c_ms, i_ms)
+            if w.exposed:
+                n_exposed += 1
+    return {
+        "generation": generation,
+        "comm_ms": round(comm, 6),
+        "interleavable_ms": round(inter, 6),
+        "hideable_ms": round(hide, 6),
+        "overlap_potential": round(hide / comm, 4) if comm else 1.0,
+        "exposed": int(n_exposed),
+        "collectives_above_floor": int(n_above),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Per-audit flow check + the gate entry point.
 # ---------------------------------------------------------------------------
 
 
 def audit_flow(audit, *, derived_file: dict | None = None,
-               graph: cg.CollectiveGraph | None = None) -> dict:
+               schedule_file: dict | None = None,
+               graph: cg.CollectiveGraph | None = None,
+               n_devices: int = 8) -> dict:
     """All structural detectors over one strategy audit.  Returns the
     per-strategy report fragment; ``problems`` is the flattened finding
     list the gate counts."""
@@ -537,36 +859,51 @@ def audit_flow(audit, *, derived_file: dict | None = None,
         "replica_groups": detect_replica_groups(
             graph, meta.mesh_dict if meta else {}),
         "census": census_cross_check(graph, audit.report),
+        "exposed_comm": detect_exposed_comm(
+            graph, bool(meta.declared_overlapped) if meta else False,
+            ignore_below=audit.budget.ignore_below),
     }
     drift = budget_drift(audit, derived_file)
-    problems = [f"[{audit.name}] {f}"
-                for fs in detectors.values() for f in fs] + drift
+    sched_drift = schedule_drift(audit, schedule_file, graph=graph)
+    problems = ([f"[{audit.name}] {f}"
+                 for fs in detectors.values() for f in fs]
+                + drift + sched_drift)
     return {
         "graph": graph.summary(),
         "detectors": detectors,
         "derived": derive_budget(audit.report, audit.budget.ignore_below),
         "drift": drift,
+        "schedule": derive_schedule_entry(
+            graph, ignore_below=audit.budget.ignore_below),
+        "schedule_drift": sched_drift,
+        "overlap": overlap_score(
+            graph, audit.report, n_devices=n_devices,
+            ignore_below=audit.budget.ignore_below),
         "problems": problems,
     }
 
 
 def check(audits=None, *, n_devices: int = 8,
-          derived_path: str = DERIVED_BUDGETS_PATH) -> list[str]:
-    """Gate entry point: structural detectors + derived-budget drift for
-    every strategy this environment can compile.  ``audits`` reuses the
-    CLI's already-compiled audit objects (one compile pays for both the
-    ceiling audit and the flow check)."""
+          derived_path: str = DERIVED_BUDGETS_PATH,
+          schedule_path: str = DERIVED_SCHEDULE_PATH) -> list[str]:
+    """Gate entry point: structural detectors + derived-budget and
+    derived-schedule drift for every strategy this environment can
+    compile.  ``audits`` reuses the CLI's already-compiled audit objects
+    (one compile pays for both the ceiling audit and the flow check)."""
     if audits is None:
         from tpuframe.analysis import strategies
 
         audits = strategies.audit_all(n_devices)
     derived_file = load_derived(derived_path)
+    schedule_file = load_derived_schedule(schedule_path)
     problems: list[str] = seeded_wire_positive()
+    problems.extend(seeded_schedule_positive())
     for audit in audits:
         if audit.status == "unavailable" or audit.compiled is None:
             continue
-        problems.extend(audit_flow(audit, derived_file=derived_file)
-                        ["problems"])
+        problems.extend(audit_flow(audit, derived_file=derived_file,
+                                   schedule_file=schedule_file,
+                                   n_devices=n_devices)["problems"])
     problems.extend(resize_drift(derived_file, n_devices=n_devices))
     return problems
 
@@ -577,10 +914,13 @@ def check(audits=None, *, n_devices: int = 8,
 
 
 def build_report(audits, *, lint_findings=(), n_devices: int = 8,
-                 derived_path: str = DERIVED_BUDGETS_PATH) -> dict:
-    """Machine-readable gate report (schema pinned by tests — a future
-    commit diffs two of these the way ``obs compare`` diffs step times)."""
+                 derived_path: str = DERIVED_BUDGETS_PATH,
+                 schedule_path: str = DERIVED_SCHEDULE_PATH) -> dict:
+    """Machine-readable gate report (schema pinned by tests — the
+    ``--compare`` differ diffs two of these the way ``obs compare``
+    diffs step times)."""
     derived_file = load_derived(derived_path)
+    schedule_file = load_derived_schedule(schedule_path)
     strategies_out = []
     for audit in audits:
         entry = {
@@ -590,7 +930,9 @@ def build_report(audits, *, lint_findings=(), n_devices: int = 8,
             "violations": list(audit.violations),
         }
         if audit.status != "unavailable" and audit.report is not None:
-            flow = audit_flow(audit, derived_file=derived_file)
+            flow = audit_flow(audit, derived_file=derived_file,
+                              schedule_file=schedule_file,
+                              n_devices=n_devices)
             entry.update({
                 "collectives": flow["derived"]["kinds"],
                 "total_bytes": flow["derived"]["total_bytes"],
@@ -599,6 +941,9 @@ def build_report(audits, *, lint_findings=(), n_devices: int = 8,
                 "detectors": {k: list(v)
                               for k, v in flow["detectors"].items()},
                 "graph": flow["graph"],
+                "schedule": flow["schedule"],
+                "schedule_drift": flow["schedule_drift"],
+                "overlap": flow["overlap"],
             })
         strategies_out.append(entry)
     return {
@@ -620,6 +965,12 @@ def compare_reports(a: dict, b: dict, *,
     Regression = a collective kind appears/disappears, a per-kind op
     count changes, per-kind bytes move more than ``bytes_tol``
     (relative), or a detector that was clean now finds something.
+
+    Schedule section (participates only when BOTH reports carry it, so
+    a schema-1 baseline still compares on the structural metrics): more
+    exposed above-floor collectives, peak live bytes moving more than
+    ``bytes_tol`` (relative), or overlap potential dropping by more
+    than 0.10 are regressions.
     """
     lines: list[str] = []
     a_s = {s["name"]: s for s in a.get("strategies", [])
@@ -667,9 +1018,91 @@ def compare_reports(a: dict, b: dict, *,
                 regression = True
                 lines.append(f"REGRESSION {name}: detector {det} findings "
                              f"{na} -> {nb}")
+        sa, sb = a_s[name].get("schedule"), b_s[name].get("schedule")
+        if sa and sb:
+            ea = int(sa.get("exposed_above_floor", 0))
+            eb = int(sb.get("exposed_above_floor", 0))
+            if eb > ea:
+                regression = True
+                lines.append(f"REGRESSION {name}: exposed above-floor "
+                             f"collectives {ea} -> {eb}")
+            pa = int(sa.get("peak_live_bytes", 0))
+            pb = int(sb.get("peak_live_bytes", 0))
+            if pa and abs(pb - pa) / pa > bytes_tol:
+                regression = True
+                lines.append(
+                    f"REGRESSION {name}: peak live bytes {pa} -> {pb} "
+                    f"({(pb - pa) / pa:+.1%} > ±{bytes_tol:.0%})")
+        oa, ob = a_s[name].get("overlap"), b_s[name].get("overlap")
+        if oa and ob:
+            va = float(oa.get("overlap_potential", 1.0))
+            vb = float(ob.get("overlap_potential", 1.0))
+            if va - vb > 0.10:
+                regression = True
+                lines.append(
+                    f"REGRESSION {name}: overlap potential "
+                    f"{va:.2f} -> {vb:.2f} (dropped > 0.10)")
         if not any(ln.startswith(f"REGRESSION {name}:") for ln in lines):
             lines.append(f"ok {name}: collective structure unchanged")
     return (1 if regression else 0), lines
+
+
+#: the keys every compiled strategy entry of a schema-2 report carries —
+#: pinned here once so the selfcheck and the tests share one spelling.
+STRATEGY_REPORT_KEYS = frozenset({
+    "name", "status", "reason", "violations", "collectives",
+    "total_bytes", "derived", "drift", "detectors", "graph",
+    "schedule", "schedule_drift", "overlap",
+})
+
+
+def selfcheck(samples_dir: str = SAMPLES_COMPARE_DIR) -> list[str]:
+    """Jax-free gate leg: the checked-in golden compare pair must keep
+    exercising the differ's whole contract — base vs. base is rc 0,
+    base vs. candidate is rc 1 *including a schedule-section line*, and
+    the base report carries every schema-2 strategy key.  A report
+    schema change that strands the differ fails CI before it ships."""
+    base_path = os.path.join(samples_dir, "base.json")
+    cand_path = os.path.join(samples_dir, "candidate.json")
+    try:
+        with open(base_path) as f:
+            base = json.load(f)
+        with open(cand_path) as f:
+            cand = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"compare selfcheck: golden pair unreadable "
+                f"({samples_dir}): {e}"]
+    problems: list[str] = []
+    if base.get("schema") != REPORT_SCHEMA:
+        problems.append(
+            f"compare selfcheck: golden base.json is schema "
+            f"{base.get('schema')!r}, differ is at {REPORT_SCHEMA} — "
+            f"regenerate the pair with --json")
+    for s in base.get("strategies", []):
+        if s.get("status") == "unavailable":
+            continue
+        missing = STRATEGY_REPORT_KEYS - set(s)
+        if missing:
+            problems.append(
+                f"compare selfcheck: golden base.json strategy "
+                f"{s.get('name')!r} lacks report keys {sorted(missing)}")
+    rc, _ = compare_reports(base, base)
+    if rc != 0:
+        problems.append(
+            f"compare selfcheck: base vs. base must be rc 0, got {rc}")
+    rc, lines = compare_reports(base, cand)
+    if rc != 1:
+        problems.append(
+            f"compare selfcheck: base vs. candidate must be rc 1 "
+            f"(seeded regression), got {rc}")
+    wanted = ("exposed above-floor", "peak live bytes",
+              "overlap potential")
+    if not any(any(w in ln for w in wanted) for ln in lines):
+        problems.append(
+            "compare selfcheck: base vs. candidate found no "
+            "schedule-section regression — the differ lost the "
+            "schedule plane")
+    return problems
 
 
 def _jax_version() -> str:

@@ -173,6 +173,9 @@ RULES = {
              "local_device_count/process_index) cached at module import "
              "outside the elastic/launch/parallel seams — stale after an "
              "elastic resize",
+    "TF117": "jax.block_until_ready()/jax.device_get() inside a traced "
+             "hot path (parallel/, serve/engine.py) — forces a schedule "
+             "barrier that destroys collective/compute overlap",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -276,6 +279,17 @@ _WIRE_RAW_TAILS = {"psum", "ppermute", "all_gather", "psum_scatter"}
 _WORLD_SANCTIONED_PARTS = ("elastic/", "launch/", "parallel/")
 _WORLD_READ_TAILS = {"process_count", "device_count",
                      "local_device_count", "process_index"}
+
+# TF117: the overlap-critical hot paths — the strategy step programs
+# (parallel/) and the serving engine.  A host sync inside TRACED code
+# there pins a schedule barrier into every compiled step: the collective
+# scheduler cannot move work across it, so the exposed-communication
+# windows the schedule auditor polices reappear at the source level.
+# Host-side synchronization (checkpoint flush, benchmark harness) is
+# untraced and untouched.
+_SYNC_SCOPE_PART = "parallel/"
+_SYNC_SCOPE_SUFFIX = "serve/engine.py"
+_SYNC_BARRIER_TAILS = {"block_until_ready", "device_get"}
 
 # TF105a: google.cloud.storage blob/bucket methods — allowed only inside
 # the retry-wrapped data/gcs.py layer.
@@ -487,6 +501,8 @@ class FileContext:
         self.wire_scope = norm.endswith(_WIRE_SEAM_SUFFIXES)
         self.world_scope = not any(p in norm
                                    for p in _WORLD_SANCTIONED_PARTS)
+        self.sync_scope = (_SYNC_SCOPE_PART in norm
+                           or norm.endswith(_SYNC_SCOPE_SUFFIX))
         # TF106: a module-level compiler-env write is safe only BEFORE
         # the module-level jax import (the conftest/bootstrap pattern).
         self.jax_import_line = None
@@ -817,6 +833,29 @@ def _tf116_cached_world(ctx: FileContext, node, fn):
                      f"suppress with tf-lint: ok[TF116] and a reason "
                      f"if the binding is provably world-invariant", fn)
             return
+
+
+@_node_rule
+def _tf117_traced_sync(ctx: FileContext, node, fn):
+    """A host synchronization point inside code that is itself traced:
+    ``jax.block_until_ready`` / ``.block_until_ready()`` /
+    ``jax.device_get`` under a jit/pmap/shard_map decorator in the
+    overlap-critical paths.  Untraced host functions (checkpoint sync,
+    bench harnesses) are exactly where these calls belong and are not
+    in scope."""
+    if not ctx.sync_scope or fn is None or not fn.traced:
+        return
+    if not isinstance(node, ast.Call):
+        return
+    callee = _dotted(node.func)
+    if callee.rsplit(".", 1)[-1] in _SYNC_BARRIER_TAILS:
+        ctx.emit("TF117", node,
+                 f"`{callee}()` inside traced hot-path code forces a "
+                 f"schedule barrier — the compiled step stalls until "
+                 f"every in-flight collective drains, so nothing can "
+                 f"overlap across this point; sync on the host after "
+                 f"the step returns, or suppress with tf-lint: "
+                 f"ok[TF117] and a reason", fn)
 
 
 @_fn_rule

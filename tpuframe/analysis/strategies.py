@@ -54,6 +54,14 @@ class StrategyMeta:
     #: the roofline comm model reads to pick payload bytes per element,
     #: instead of guessing from the accumulation dtype.
     wire_format: str = "fp"
+    #: the strategy claims its collectives overlap with compute (async
+    #: start/done windows with work inside).  The exposed-communication
+    #: detector FAILS a declared-overlapped strategy whose compiled
+    #: program consumes a collective start back-to-back; undeclared
+    #: strategies only get the exposure *reported* (CPU-compiled audits
+    #: have no async scheduler, so nothing today may declare this —
+    #: the future bucketed-fusion strategy is who the flag is for).
+    declared_overlapped: bool = False
 
     @property
     def mesh_dict(self) -> dict:
@@ -102,11 +110,13 @@ _HLO_DTYPES = {
 
 def _meta(mesh, *, wire_dtype: str = "f32",
           declared_leaves: tuple = (),
-          wire_format: str = "fp") -> StrategyMeta:
+          wire_format: str = "fp",
+          declared_overlapped: bool = False) -> StrategyMeta:
     return StrategyMeta(
         mesh_shape=tuple((str(a), int(s)) for a, s in mesh.shape.items()),
         wire_dtype=wire_dtype, declared_leaves=declared_leaves,
-        wire_format=wire_format)
+        wire_format=wire_format,
+        declared_overlapped=declared_overlapped)
 
 
 def _declared_leaves(tree, shardings) -> tuple:

@@ -191,6 +191,17 @@ def comm_ms(generation: str, kind: str, nbytes: float,
     return factor * scale * float(nbytes) / hw.ici_bytes_per_s * 1e3
 
 
+def hbm_ms(generation: str, nbytes: float) -> float:
+    """Predicted HBM milliseconds to stream ``nbytes`` on one chip — the
+    same bandwidth roofline as :func:`score`'s ``t_hbm_ms``, exposed per
+    byte count so the schedule auditor can price interleavable compute
+    (compute ops are overwhelmingly bandwidth-bound at audit scale, so
+    the byte roofline is the honest lower bound on how long they give a
+    scheduler to hide a collective behind)."""
+    hw = get_hardware(generation)
+    return float(nbytes) / hw.hbm_bytes_per_s * 1e3
+
+
 def comm_score(generation: str, report, n_devices: int) -> dict:
     """Per-kind predicted comm rows for one program's collectives.
 
@@ -287,4 +298,12 @@ def check_tables() -> list:
     if abs(t_s8 * 4 - t_f32) > 1e-9:
         problems.append("comm model is not linear in wire bytes — "
                         "int8 prediction must be f32/4")
+    # hbm_ms must be the same ruler as score()'s t_hbm_ms — the overlap
+    # scorer prices interleavable compute with it, and a divergence would
+    # let the two rooflines disagree about the identical byte count.
+    t_hbm = hbm_ms("v5e", 1.435e11)
+    if abs(t_hbm - score("v5e", flops=0.0,
+                         bytes_accessed=1.435e11)["t_hbm_ms"]) > 0.05:
+        problems.append(f"hbm_ms diverged from score()'s t_hbm_ms ruler: "
+                        f"{t_hbm:.2f} ms on the §2 anchor bytes")
     return problems
