@@ -758,6 +758,65 @@ def test_tf117_suppression():
     assert source_lint.lint_source(src, "tpuframe/parallel/step.py") == []
 
 
+def test_tf118_raw_network_call_outside_fleet_seams():
+    # Fleet traffic without a RetryPolicy is the raw-GCS bypass class at
+    # the serving boundary: no backoff, no deadline, no obs counters.
+    src = textwrap.dedent("""
+        import socket
+        import urllib.request
+
+        def probe(url):
+            with urllib.request.urlopen(url, timeout=1.0) as r:
+                return r.read()
+
+        def dial(host):
+            return socket.create_connection((host, 80))
+    """)
+    findings = source_lint.lint_source(src, "tpuframe/serve/scheduler.py")
+    assert [f.rule for f in findings] == ["TF118", "TF118"]
+    # The sanctioned seams: the router's transport and the exporter.
+    assert source_lint.lint_source(src, "tpuframe/serve/router.py") == []
+    assert source_lint.lint_source(src, "tpuframe/obs/exporter.py") == []
+
+
+def test_tf118_bare_and_http_client_shapes():
+    src = textwrap.dedent("""
+        from urllib.request import urlopen
+        import http.client
+
+        def fetch(url):
+            return urlopen(url).read()
+
+        def connect(host):
+            return http.client.HTTPConnection(host)
+    """)
+    findings = source_lint.lint_source(src, "tpuframe/resilience/policy.py")
+    assert [f.rule for f in findings] == ["TF118", "TF118"]
+
+
+def test_tf118_non_client_socket_use_is_clean():
+    # gethostname/socketpair are not fleet traffic — no finding.
+    src = textwrap.dedent("""
+        import socket
+
+        def host():
+            return socket.gethostname()
+    """)
+    assert source_lint.lint_source(src, "tpuframe/obs/events.py") == []
+
+
+def test_tf118_suppression():
+    src = textwrap.dedent("""
+        import socket
+
+        def free_port():
+            with socket.socket() as s:  # tf-lint: ok[TF118]
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+    """)
+    assert source_lint.lint_source(src, "tpuframe/launch/launcher.py") == []
+
+
 def test_shipped_tree_self_lints_clean():
     import tpuframe
 

@@ -479,3 +479,113 @@ class TestElasticResize:
                              / g_straight["steps"])
         assert per_step <= 3 * per_step_straight, (
             g["buckets"], g["steps"], g_straight["buckets"])
+
+
+# ---------------------------------------------------------------------------
+# Fleet chaos: kill 1 of 3 serving replicas mid-load, lose nothing.
+# ---------------------------------------------------------------------------
+
+
+class TestFleetChaos:
+    """The serving half of the fault-tolerance story (DESIGN.md "Serving
+    fleet & failure model"): a 3-replica fake-engine fleet under a
+    seeded burst load, with ``replica_crash`` scheduled on one replica —
+    deterministic via the fault registry's step pin, no wall-clock race.
+
+    Proven against the same-seed no-fault run:
+      * zero accepted-request loss — every admitted request retires
+        exactly once (rid-level, through the event stitcher);
+      * p99 TTFT of the faulted run stays <= 2x the no-fault run (burst
+        load makes both queueing-dominated, so the bound tracks the 3->2
+        capacity drop plus detection cost, not a noise floor);
+      * the drain/redispatch story is visible as typed router_* events
+        that validate_files, fleet_stats and obs compare all understand.
+    """
+
+    _N, _SEED = 36, 7
+    _FLEET = dict(replicas=3, n_requests=_N, seed=_SEED, slots=2,
+                  step_delay_ms=20.0, rate=1000.0,  # burst: all at t~0
+                  max_new_tokens=8, queue_limit=256, hedge_ms=5000.0,
+                  scrape_interval_s=0.05, timeout_s=90.0)
+
+    def _events_ok(self, events_dir):
+        files = events.event_files(str(events_dir))
+        assert files, "fleet run wrote no event files"
+        assert events.validate_files(files) == []
+        return events.merge(str(events_dir))
+
+    def test_replica_kill_loses_nothing_and_bounds_p99(self, tmp_path):
+        from tpuframe.serve import router as router_lib
+
+        base = router_lib.fleet_smoke(
+            events_dir=str(tmp_path / "a"), **self._FLEET)
+        kill = router_lib.fleet_smoke(
+            events_dir=str(tmp_path / "b"), kill_rank=1, kill_step=3,
+            **self._FLEET)
+
+        # Clean fleet first: everything admitted, retired, exited 0.
+        assert base["admitted"] == self._N and base["lost"] == 0
+        assert base["shed"] == 0 and not base["timed_out"]
+        assert base["exit_codes"] == [0, 0, 0]
+
+        # The kill is real (os._exit(42) from the fault registry) ...
+        assert kill["exit_codes"][1] == 42
+        assert kill["exit_codes"][0] == 0 and kill["exit_codes"][2] == 0
+        assert kill["drains"] >= 1
+        # ... and still: zero accepted-request loss, shed counted (none
+        # expected at this queue bound), nothing silently dropped.
+        assert kill["admitted"] == self._N
+        assert kill["lost"] == 0 and not kill["timed_out"]
+        assert kill["shed"] == 0
+        assert kill["requests"] + kill["shed"] == kill["admitted"]
+
+        # p99 TTFT: faulted <= 2x no-fault, same seed.  _pct at p99 over
+        # 36 samples is the max — this bounds the WORST request against
+        # the capacity drop, not an average.
+        p99_a = base["ttft_ms"]["p99"]
+        p99_b = kill["ttft_ms"]["p99"]
+        assert p99_a > 0
+        assert p99_b <= 2.0 * p99_a, (
+            f"p99 TTFT {p99_b:.1f}ms > 2x no-fault {p99_a:.1f}ms")
+
+        # rid-exactness through the stitcher: every admitted rid retired
+        # exactly once, across both the surviving replicas.
+        merged = self._events_ok(tmp_path / "b")
+        admits = [r["id"] for r in merged if r["type"] == "router_admit"]
+        dones = [r["id"] for r in merged
+                 if r["type"] == "router_request"]
+        assert sorted(admits) == sorted(set(admits))
+        assert sorted(dones) == sorted(admits)   # exactly once, all of them
+
+        # The drain and re-dispatch are typed, attributed events.
+        drains = [r for r in merged if r["type"] == "router_drain"]
+        assert any(d["replica"] == "r1" for d in drains)
+        assert all(d["reason"] for d in drains)
+        redispatched = [r for r in merged
+                        if r["type"] == "router_redispatch"]
+        assert len(redispatched) == kill["redispatched"]
+        # Dead replica's orphans landed on survivors.
+        assert {r["replica"] for r in redispatched} <= {"r0", "r2"}
+
+        # The offline analyzers see the same story.
+        fleet = goodput.fleet_stats(merged)
+        assert fleet["lost"] == 0 and fleet["requests"] == self._N
+        assert any(d["replica"] == "r1" for d in fleet["drains"])
+        assert set(fleet["by_replica"]) <= {"r0", "r2"}
+
+        base_merged = self._events_ok(tmp_path / "a")
+        cmp = goodput.compare_runs(base_merged, merged)
+        assert "router_ttft_p90_ms" in cmp["metrics"]
+        entry = cmp["metrics"]["router_ttft_p90_ms"]
+        assert entry["a"] > 0 and entry["b"] > 0
+
+    def test_replica_crash_seam_is_deterministic(self):
+        """The seam grammar: replica_crash defaults to kind=crash and
+        honors the step pin — the property the fleet test's kill_step
+        scheduling rests on."""
+        (f,) = faults.parse("replica_crash:step=3:rank=1")
+        assert f.kind == "crash" and f.step == 3 and f.rank == 1
+        for seam, kind in (("replica_hang", "hang"),
+                           ("replica_slow", "slow")):
+            (g,) = faults.parse(seam)
+            assert g.kind == kind

@@ -1,0 +1,366 @@
+"""The fleet router (serve/router.py): admission control, placement,
+drain/redispatch, hedged retries — all over an injectable transport, so
+the whole state machine runs without processes or sockets.  The
+subprocess fleet (real replicas, real kills) lives in tests/test_chaos.py
+and the real-HTTP 503-drain integration in tests/test_telemetry.py.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpuframe.resilience.policy import RetryPolicy
+from tpuframe.serve import router as router_lib
+from tpuframe.serve.router import Router, Shed
+
+
+def _no_sleep_policy(**kw):
+    kw.setdefault("max_attempts", 2)
+    kw.setdefault("base_delay_s", 0.001)
+    kw.setdefault("max_delay_s", 0.001)
+    kw.setdefault("attempt_timeout_s", 5.0)
+    kw.setdefault("deadline_s", 10.0)
+    return RetryPolicy(sleep=lambda s: None, **kw)
+
+
+def _drive(router, *, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while router.has_work() and time.monotonic() < deadline:
+        router.step()
+        time.sleep(0.002)
+    assert not router.has_work(), "router did not converge"
+
+
+def _ok_reply(url, payload, timeout_s):
+    """Transport stub: every /generate answers 200 from the named
+    replica; scrapes answer healthy with zero queue depth."""
+    if url.endswith("/generate"):
+        return 200, {"rid": payload["rid"], "tokens": [1, 2],
+                     "ttft_ms": 1.0}
+    if url.endswith("/healthz"):
+        return 200, "ok\n"
+    return 200, "tpuframe_serve_queue_depth 0\n# EOF\n"
+
+
+class TestAdmission:
+    def test_bounded_queue_sheds_at_limit(self):
+        r = Router(["http://a"], queue_limit=2, transport=_ok_reply)
+        assert r.submit(0, [1]) and r.submit(1, [1])
+        assert not r.submit(2, [1])          # explicit shed, not buffering
+        assert r.counters == {**r.counters, "admitted": 2, "shed": 1}
+        assert len(r.pending) == 2           # the bound held
+
+    def test_shed_can_raise(self):
+        r = Router(["http://a"], queue_limit=1, transport=_ok_reply)
+        assert r.submit(0, [1])
+        with pytest.raises(Shed, match="queue full"):
+            r.submit(1, [1], raise_on_shed=True)
+
+    def test_inflight_counts_against_the_bound(self):
+        """Dispatching must not free admission room: pending + in-flight
+        is the queue the bound guards."""
+        hold = threading.Event()
+
+        def slow(url, payload, timeout_s):
+            if url.endswith("/generate"):
+                hold.wait(5.0)
+            return _ok_reply(url, payload, timeout_s)
+
+        r = Router(["http://a"], queue_limit=1, transport=slow,
+                   hedge_ms=0)
+        assert r.submit(0, [1])
+        r.step()                              # 0 moves pending -> inflight
+        assert not r.submit(1, [1])           # still full
+        hold.set()
+        _drive(r)
+
+    def test_env_knob_resolution(self, monkeypatch):
+        monkeypatch.setenv(router_lib.ENV_QUEUE, "7")
+        monkeypatch.setenv(router_lib.ENV_HEDGE_MS, "250")
+        monkeypatch.setenv(router_lib.ENV_REPLICAS, "5")
+        assert router_lib.resolve_queue_limit() == 7
+        assert router_lib.resolve_hedge_ms() == 250.0
+        assert router_lib.resolve_replicas() == 5
+        monkeypatch.setenv(router_lib.ENV_QUEUE, "junk")
+        assert router_lib.resolve_queue_limit() == router_lib.DEFAULT_QUEUE
+
+
+class TestPlacement:
+    def test_least_loaded_dispatch_spreads_the_fleet(self):
+        seen = []
+        hold = threading.Event()
+
+        def record(url, payload, timeout_s):
+            if url.endswith("/generate"):
+                seen.append(url.rsplit("/", 1)[0])
+                hold.wait(5.0)
+            return _ok_reply(url, payload, timeout_s)
+
+        r = Router(["http://a", "http://b"], queue_limit=8,
+                   transport=record, hedge_ms=0,
+                   scrape_interval_s=1e9)  # placement by inflight only
+        r.submit(0, [1])
+        r.submit(1, [1])
+        r.step()
+        deadline = time.monotonic() + 2.0
+        while len(seen) < 2 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert sorted(seen) == ["http://a", "http://b"]
+        hold.set()
+        _drive(r)
+        assert r.counters["completed"] == 2
+
+    def test_scraped_queue_depth_breaks_ties(self):
+        def transport(url, payload, timeout_s):
+            if url.endswith("/metrics"):
+                depth = 5 if "//a" in url else 0
+                return 200, f"tpuframe_serve_queue_depth {depth}\n# EOF\n"
+            return _ok_reply(url, payload, timeout_s)
+
+        r = Router(["http://a", "http://b"], transport=transport,
+                   scrape_interval_s=0.0)
+        r._scrape_due(r._clock())
+        assert r._replica("r0").queue_depth == 5.0
+        assert r._pick().name == "r1"        # deeper queue loses the tie
+
+
+class TestDrainRedispatch:
+    def test_dead_replica_redispatches_exactly_once(self):
+        """r0 refuses its dispatch (OSError through the RetryPolicy,
+        scrapes still healthy); the router marks it draining and the
+        request retires exactly once on r1 — the zero-loss contract at
+        unit scale."""
+        def transport(url, payload, timeout_s):
+            if "//a" in url and url.endswith("/generate"):
+                raise OSError("connection refused")
+            return _ok_reply(url, payload, timeout_s)
+
+        r = Router(["http://a", "http://b"], transport=transport,
+                   hedge_ms=0, scrape_interval_s=1e9,
+                   dispatch_policy=_no_sleep_policy())
+        r.submit(0, [1])
+        _drive(r)
+        s = r.summary()
+        assert s["requests"] == 1 and s["lost"] == 0
+        assert s["drains"] == 1 and s["redispatched"] == 1
+        assert s["dispatch_errors"] >= 1
+        assert r._replica("r0").state == "draining"
+        assert r.completed[0].replica == "r1"
+        # retired exactly once: one rid, one completion record
+        assert [q.rid for q in r.completed] == [0]
+
+    def test_generate_503_drains_the_replica(self):
+        """A draining replica answers /generate with 503 — an answer,
+        not a transport failure: no retry burn, but the router must
+        stop dispatching there and re-route."""
+        def transport(url, payload, timeout_s):
+            if "//a" in url and url.endswith("/generate"):
+                return 503, {"error": "draining"}
+            return _ok_reply(url, payload, timeout_s)
+
+        r = Router(["http://a", "http://b"], transport=transport,
+                   hedge_ms=0, scrape_interval_s=1e9)
+        r.submit(0, [1])
+        _drive(r)
+        assert r._replica("r0").state == "draining"
+        assert r.summary()["lost"] == 0
+        assert r.completed[0].replica == "r1"
+
+    def test_healthz_503_scrape_drains_without_traffic(self):
+        def transport(url, payload, timeout_s):
+            if "//a" in url and url.endswith("/healthz"):
+                return 503, "unhealthy\n"
+            return _ok_reply(url, payload, timeout_s)
+
+        r = Router(["http://a", "http://b"], transport=transport,
+                   scrape_interval_s=0.0)
+        r._scrape_due(r._clock())
+        assert r._replica("r0").state == "draining"
+        assert r._replica("r1").state == "ok"
+        assert r.counters["drains"] == 1
+
+    def test_scrape_timeout_drains(self):
+        def transport(url, payload, timeout_s):
+            if "//a" in url:
+                raise OSError("timed out")
+            return _ok_reply(url, payload, timeout_s)
+
+        r = Router(["http://a", "http://b"], transport=transport,
+                   scrape_interval_s=0.0,
+                   scrape_policy=_no_sleep_policy())
+        r._scrape_due(r._clock())
+        assert r._replica("r0").state == "draining"
+
+    def test_all_replicas_down_keeps_request_queued(self):
+        """No healthy replica: the admitted request stays pending (and
+        counted as not-lost-yet) rather than being dropped."""
+        def transport(url, payload, timeout_s):
+            raise OSError("down")
+
+        r = Router(["http://a"], transport=transport, hedge_ms=0,
+                   scrape_interval_s=1e9,
+                   dispatch_policy=_no_sleep_policy())
+        r.submit(0, [1])
+        deadline = time.monotonic() + 2.0
+        while r.counters["drains"] < 1 and time.monotonic() < deadline:
+            r.step()
+            time.sleep(0.002)
+        r.step()
+        assert r.has_work()                 # still owed, not forgotten
+        assert len(r.pending) == 1 and r.pending[0].rid == 0
+        assert r.summary()["lost"] == 1     # honest accounting meanwhile
+
+
+class TestHedging:
+    def test_straggler_hedge_first_winner_kept(self):
+        """r0 stalls past hedge_ms; the hedge lands on r1 and wins; r0's
+        late answer is counted as a duplicate, not a second retirement."""
+        release = threading.Event()
+
+        def transport(url, payload, timeout_s):
+            if url.endswith("/generate") and "//a" in url:
+                release.wait(5.0)            # the straggler
+                return 200, {"rid": payload["rid"], "tokens": [9],
+                             "ttft_ms": 99.0}
+            return _ok_reply(url, payload, timeout_s)
+
+        r = Router(["http://a", "http://b"], transport=transport,
+                   hedge_ms=30.0, scrape_interval_s=1e9)
+        r.submit(0, [1])
+        _drive(r)
+        s = r.summary()
+        assert s["requests"] == 1 and s["hedged"] == 1
+        assert r.completed[0].replica == "r1"         # hedge won
+        assert r.completed[0].result["tokens"] == [1, 2]
+        release.set()                                 # straggler lands...
+        deadline = time.monotonic() + 2.0
+        while r.counters["duplicates"] < 1 and time.monotonic() < deadline:
+            r.step()
+            time.sleep(0.002)
+        assert r.counters["duplicates"] == 1          # ...as a duplicate
+        assert len(r.completed) == 1                  # exactly once
+
+    def test_no_hedge_below_threshold_or_without_second_replica(self):
+        hold = threading.Event()
+
+        def transport(url, payload, timeout_s):
+            if url.endswith("/generate"):
+                hold.wait(0.2)
+            return _ok_reply(url, payload, timeout_s)
+
+        r = Router(["http://a"], transport=transport, hedge_ms=10.0)
+        r.submit(0, [1])
+        _drive(r)
+        assert r.counters["hedged"] == 0  # nowhere else to race
+
+    def test_hedge_disabled_with_nonpositive_threshold(self):
+        r = Router(["http://a", "http://b"], transport=_ok_reply,
+                   hedge_ms=0)
+        r.submit(0, [1])
+        _drive(r)
+        assert r.counters["hedged"] == 0
+
+
+class TestRouterObs:
+    def test_events_emitted_and_typed(self, tmp_path):
+        from tpuframe.obs import events as obs_events
+        from tpuframe.obs import goodput
+
+        obs_events.init(str(tmp_path))
+        try:
+            def transport(url, payload, timeout_s):
+                if "//a" in url and url.endswith("/generate"):
+                    raise OSError("down")
+                return _ok_reply(url, payload, timeout_s)
+
+            r = Router(["http://a", "http://b"], transport=transport,
+                       queue_limit=1, hedge_ms=0, scrape_interval_s=1e9,
+                       dispatch_policy=_no_sleep_policy())
+            r.submit(0, [1])
+            assert not r.submit(1, [1])      # shed -> router_shed
+            _drive(r)
+            r.summary()                      # -> router_summary
+        finally:
+            obs_events.close()
+        files = obs_events.event_files(str(tmp_path))
+        assert obs_events.validate_files(files) == []  # schema-clean
+        merged = obs_events.merge(str(tmp_path))
+        types = {e["type"] for e in merged}
+        assert {"router_admit", "router_shed", "router_dispatch",
+                "router_drain", "router_redispatch", "router_request",
+                "router_summary"} <= types
+
+        fleet = goodput.fleet_stats(merged)
+        assert fleet is not None
+        assert fleet["requests"] == 1 and fleet["admitted"] == 1
+        assert fleet["shed"] == 1 and fleet["lost"] == 0
+        assert fleet["redispatched"] == 1
+        assert fleet["drains"] == [{"replica": "r0",
+                                    "reason": "dispatch OSError"}]
+        assert fleet["by_replica"] == {"r1": 1}
+        assert fleet["ttft_ms"] is not None
+        # training-only logs stay fleet-free
+        assert goodput.fleet_stats(
+            [e for e in merged
+             if not e["type"].startswith("router")]) is None
+
+    def test_router_ttft_includes_queue_wait(self):
+        """Router TTFT = wait for dispatch + replica-reported TTFT; a
+        request stuck behind a full fleet must show the queueing."""
+        hold = threading.Event()
+
+        def transport(url, payload, timeout_s):
+            if url.endswith("/generate") and payload["rid"] == 0:
+                hold.wait(5.0)
+            return _ok_reply(url, payload, timeout_s)
+
+        r = Router(["http://a"], transport=transport, hedge_ms=0,
+                   max_inflight_per_replica=1, scrape_interval_s=1e9)
+        r.submit(0, [1])
+        r.submit(1, [1])
+        r.step()
+        time.sleep(0.1)                      # rid 1 queues behind rid 0
+        hold.set()
+        _drive(r)
+        later = next(q for q in r.completed if q.rid == 1)
+        assert later.ttft_ms >= 100.0        # the wait is in the number
+
+
+class TestTransport:
+    def test_parse_gauges(self):
+        text = ("# TYPE tpuframe_serve_queue_depth gauge\n"
+                "tpuframe_serve_queue_depth 3\n"
+                "tpuframe_serve_active_slots 2\n"
+                "other_metric 9\n# EOF\n")
+        out = router_lib.parse_gauges(
+            text, ("tpuframe_serve_queue_depth",
+                   "tpuframe_serve_active_slots"))
+        assert out == {"tpuframe_serve_queue_depth": 3.0,
+                       "tpuframe_serve_active_slots": 2.0}
+
+    def test_http_transport_returns_http_errors_as_answers(self):
+        """A 503 body must come back as (503, body) — not raise into the
+        RetryPolicy and burn its budget (exporter-backed round trip)."""
+        from tpuframe.obs import exporter
+
+        ex = exporter.MetricsExporter(port=0).start()
+        try:
+            ex.add_handler("/gen", lambda body: (
+                200, json.dumps({"echo": json.loads(body)["x"]}).encode()))
+            base = f"http://127.0.0.1:{ex.port}"
+            status, body = router_lib.http_transport(
+                f"{base}/gen", {"x": 5}, 2.0)
+            assert (status, body) == (200, {"echo": 5})
+            status, _ = router_lib.http_transport(f"{base}/missing",
+                                                  {"x": 1}, 2.0)
+            assert status == 404             # returned, not raised
+            status, body = router_lib.http_transport(
+                f"{base}/healthz", None, 2.0)   # GET when payload is None
+            assert status == 200 and body == "ok\n"
+        finally:
+            ex.stop()
+
+    def test_check_is_clean(self):
+        assert router_lib.check() == []

@@ -249,6 +249,39 @@ class TestScheduler:
         assert req.tokens[-1] == 104
         assert len(req.tokens) == 2
 
+    def test_retire_then_admit_fills_freed_slot_same_step(self):
+        """A slot freed by this step's retire is refilled by the trailing
+        admit pass — the follower's prefill (and TTFT clock stop) lands
+        this step instead of idling the slot until the next one."""
+        sched = Scheduler(_FakeEngine(slots=1))
+        sched.submit(Request(rid=0, prompt=[1, 2], max_new_tokens=2))
+        sched.submit(Request(rid=1, prompt=[3, 4], max_new_tokens=2))
+        sched.step()       # rid 0: admit + decode = done; rid 1 admitted
+        assert [r.rid for r in sched.completed] == [0]
+        follower = sched.active[0]
+        assert follower is not None and follower.rid == 1
+        assert len(follower.tokens) == 1          # prefill token landed
+        assert follower.first_token_t is not None  # TTFT already stopped
+        sched.step()                      # rid 1's one decode token
+        assert [r.rid for r in sched.completed] == [0, 1]
+        for r in sched.completed:
+            assert len(r.tokens) == 2
+            assert r.ttft_ms() is not None and r.ttft_ms() >= 0
+
+    def test_instant_retire_reuses_slot_within_admit_pass(self):
+        """max_new_tokens=1 requests finish at prefill: the admit pass
+        retires them in place and reuses the slot, so a 1-slot scheduler
+        drains any number of them in a single step."""
+        sched = Scheduler(_FakeEngine(slots=1))
+        for rid in range(3):
+            sched.submit(Request(rid=rid, prompt=[rid], max_new_tokens=1))
+        produced = sched.step()
+        assert produced == 3               # all three admitted this step
+        assert not sched.has_work()
+        assert [r.rid for r in sched.completed] == [0, 1, 2]
+        for r in sched.completed:
+            assert len(r.tokens) == 1 and r.done_t is not None
+
 
 # ---------------------------------------------------------------------------
 # The real engine: loadgen, events, compile-cache warm restart.
@@ -600,3 +633,82 @@ class TestServeTune:
         rows = report["serve"]["rows"]
         assert rows == sorted(rows,
                               key=lambda r: r["predicted_ms_per_token"])
+
+
+# ---------------------------------------------------------------------------
+# Replica drain semantics (serve/replica.py over the fake engine).
+# ---------------------------------------------------------------------------
+
+class TestReplicaDrain:
+    def test_drain_finishes_inflight_then_exits(self):
+        """A replica that flips draining mid-generation still answers
+        every request it already accepted (200 with the full token
+        stream), rejects new work with 503, reads unhealthy for the
+        router's scrape — and only then does its main loop exit."""
+        import threading
+
+        from tpuframe.serve.replica import FakeEngine, Replica
+
+        replica = Replica(FakeEngine(slots=1), handler_timeout_s=10.0)
+        results = []
+
+        def call(rid):
+            body = json.dumps({"rid": rid, "prompt": [1, 2, 3],
+                               "max_new_tokens": 4}).encode()
+            results.append(replica.handle_generate(body))
+
+        t = threading.Thread(target=call, args=(0,), daemon=True)
+        t.start()
+        deadline = 200
+        while not replica._inbox and deadline:  # accepted, not yet pumped
+            deadline -= 1
+            import time as _time
+            _time.sleep(0.01)
+        assert replica._inbox, "request never reached the inbox"
+
+        replica.drain()                      # mid-generation drain signal
+        assert replica.healthy() is False    # /healthz now reads 503
+        status, body = replica.handle_generate(
+            json.dumps({"rid": 1, "prompt": [4], "max_new_tokens": 2})
+            .encode())
+        assert status == 503                 # new work rejected
+        assert json.loads(body.decode())["error"] == "draining"
+
+        rc = replica.run()                   # drains, then exits
+        assert rc == 0
+        t.join(5.0)
+        (accepted,) = results                # the accepted request: 200,
+        status, body = accepted              # full stream, never dropped
+        assert status == 200
+        msg = json.loads(body.decode())
+        assert msg["rid"] == 0 and len(msg["tokens"]) == 4
+        assert msg["ttft_ms"] is not None
+        assert not replica.scheduler.has_work()
+
+    def test_fake_engine_streams_are_prompt_deterministic(self):
+        """Re-prefilling the same prompt on a fresh replica reproduces
+        the identical token stream — the idempotence the router's
+        hedging and redispatch (first-winner-kept) rely on."""
+        from tpuframe.serve.replica import FakeEngine
+
+        def stream(prompt, n):
+            sched = Scheduler(FakeEngine(slots=1))
+            sched.submit(Request(rid=0, prompt=prompt, max_new_tokens=n))
+            while sched.has_work():
+                sched.step()
+            return sched.completed[0].tokens
+
+        assert stream([5, 6, 7], 6) == stream([5, 6, 7], 6)
+        assert stream([5, 6, 7], 6) != stream([5, 6, 8], 6)
+
+    def test_bad_request_and_oversized_prompt_get_400(self):
+        from tpuframe.serve.replica import FakeEngine, Replica
+
+        replica = Replica(FakeEngine(slots=1))
+        status, _ = replica.handle_generate(b"not json")
+        assert status == 400
+        status, body = replica.handle_generate(
+            json.dumps({"rid": 0, "prompt": list(range(100)),
+                        "max_new_tokens": 2}).encode())
+        assert status == 400                # outside buckets: rejected
+        assert "outside buckets" in json.loads(body.decode())["error"]

@@ -162,6 +162,118 @@ def test_start_from_env_gating(monkeypatch, tmp_path):
     assert exporter.get() is None
 
 
+def test_exporter_stop_is_idempotent():
+    ex = exporter.MetricsExporter(port=0).start()
+    assert ex.port and ex.port > 0
+    ex.stop()
+    ex.stop()  # second stop must be a no-op, not a crash
+    # and the module-level stop() with no exporter alive is too
+    exporter.stop()
+    exporter.stop()
+
+
+def test_exporter_occupied_port_falls_back_to_ephemeral(capsys):
+    """A fleet launching N replicas on one host with the same port knob
+    must not lose N-1 scrape planes: the loser of the bind race serves
+    from an ephemeral port (on ``.port``) instead of crashing or going
+    silently scrape-less."""
+    first = exporter.MetricsExporter(port=0).start()
+    try:
+        second = exporter.MetricsExporter(port=first.port).start()
+        try:
+            assert second.port and second.port != first.port
+            status, body = _get(f"http://127.0.0.1:{second.port}/metrics")
+            assert status == 200 and body.rstrip().endswith("# EOF")
+        finally:
+            second.stop()
+        assert "fell back to ephemeral port" in capsys.readouterr().err
+    finally:
+        first.stop()
+
+
+def test_exporter_post_handler_round_trip():
+    ex = exporter.MetricsExporter(port=0).start()
+    try:
+        ex.add_handler("/echo", lambda body: (200, body.upper()))
+
+        def boom(body):
+            raise RuntimeError("handler boom")
+
+        ex.add_handler("/boom", boom)
+        base = f"http://127.0.0.1:{ex.port}"
+
+        def post(path, data):
+            req = urllib.request.Request(f"{base}{path}", data=data)
+            try:
+                with urllib.request.urlopen(req, timeout=2.0) as r:
+                    return r.status, r.read()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read()
+
+        status, body = post("/echo", b"fleet")
+        assert (status, body) == (200, b"FLEET")
+        status, _ = post("/nowhere", b"x")
+        assert status == 404
+        status, body = post("/boom", b"x")  # 500, server stays up
+        assert status == 500 and b"RuntimeError" in body
+        status, body = post("/echo", b"still alive")
+        assert (status, body) == (200, b"STILL ALIVE")
+    finally:
+        ex.stop()
+
+
+def test_router_scrape_503_redispatches_with_zero_loss():
+    """End-to-end over real HTTP: replica A accepts a request then its
+    /healthz flips 503 mid-generation; the router's scrape marks it
+    draining (``router_drain``), re-dispatches the in-flight request to
+    replica B (``router_redispatch``), and the admitted request retires
+    exactly once — zero loss, first winner kept."""
+    from tpuframe.serve.router import Router
+
+    a_state = {"ok": True}
+    a_release = threading.Event()
+
+    def a_generate(body):
+        msg = json.loads(body.decode())
+        a_state["ok"] = False          # health flips mid-generation
+        a_release.wait(10.0)           # ...and A stalls on the answer
+        return 200, json.dumps({"rid": msg["rid"], "tokens": [1],
+                                "ttft_ms": 1.0}).encode()
+
+    def b_generate(body):
+        msg = json.loads(body.decode())
+        return 200, json.dumps({"rid": msg["rid"], "tokens": [1, 2],
+                                "ttft_ms": 2.0}).encode()
+
+    ex_a = exporter.MetricsExporter(port=0,
+                                    health=lambda: a_state["ok"]).start()
+    ex_b = exporter.MetricsExporter(port=0).start()
+    try:
+        ex_a.add_handler("/generate", a_generate)
+        ex_b.add_handler("/generate", b_generate)
+        router = Router(
+            [f"http://127.0.0.1:{ex_a.port}",
+             f"http://127.0.0.1:{ex_b.port}"],
+            queue_limit=8, hedge_ms=0,  # no hedging: drain does the work
+            scrape_interval_s=0.01, scrape_timeout_s=1.0,
+            dispatch_timeout_s=15.0)
+        assert router.submit(7, [1, 2, 3], 4)
+        deadline = time.monotonic() + 15.0
+        while router.has_work() and time.monotonic() < deadline:
+            router.step()
+            time.sleep(0.005)
+        summary = router.summary()
+    finally:
+        a_release.set()
+        ex_a.stop()
+        ex_b.stop()
+    assert summary["admitted"] == 1 and summary["requests"] == 1
+    assert summary["lost"] == 0
+    assert summary["drains"] == 1 and summary["redispatched"] == 1
+    (req,) = router.completed
+    assert req.replica == "r1" and req.result["tokens"] == [1, 2]
+
+
 # ---------------------------------------------------------------------------
 # Flight recorder
 # ---------------------------------------------------------------------------

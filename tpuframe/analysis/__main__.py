@@ -258,6 +258,16 @@ def _run_quantwire_check() -> int:
     return len(problems)
 
 
+def _run_router_check() -> int:
+    from tpuframe.serve import router
+
+    problems = router.check()
+    for p in problems:
+        print(f"ROUTER {p}")
+    print(f"[analysis] router self-check: {len(problems)} problem(s)")
+    return len(problems)
+
+
 def _run_obs_check() -> int:
     # Through the real CLI entry point, not an import — the gate then
     # also catches a broken ``python -m tpuframe.obs`` invocation.
@@ -339,6 +349,7 @@ def main(argv=None) -> int:
         n_findings += _run_tune_check()
         n_findings += _run_mem_check()
         n_findings += _run_serve_check()
+        n_findings += _run_router_check()
         n_findings += _run_zero1_check()
         n_findings += _run_elastic_check()
         n_findings += _run_quantwire_check()

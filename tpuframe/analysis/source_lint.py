@@ -112,6 +112,17 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          ``__new__``) are happens-before publication and exempt;
          call-site-serialized lifecycle mutations suppress with
          ``# tf-lint: ok[TF114]`` and a reason.
+  TF118  raw network client call outside the router/exporter seams — a
+         ``urllib.request.urlopen``/``http.client.HTTPConnection``/
+         ``socket.socket``/``socket.create_connection`` call anywhere
+         but ``serve/router.py`` (the fleet's one HTTP client, where
+         every request rides a RetryPolicy: decorrelated jitter, attempt
+         timeout, deadline) or ``obs/exporter.py`` (the one server).  An
+         ad-hoc client call elsewhere has no retry budget, no fault
+         seams and no obs counters — the same bypass class as TF105's
+         raw-GCS check, at the fleet seam.  Local non-fleet socket use
+         (ephemeral-port probes) suppresses with ``# tf-lint: ok[TF118]``
+         and a reason.
 
 Scope: TF101/TF102 only fire *inside functions known to be traced*
 (decorated with ``jax.jit``/``pmap``/``shard_map`` or passed to
@@ -176,6 +187,10 @@ RULES = {
     "TF117": "jax.block_until_ready()/jax.device_get() inside a traced "
              "hot path (parallel/, serve/engine.py) — forces a schedule "
              "barrier that destroys collective/compute overlap",
+    "TF118": "raw network client call (urllib.request.urlopen/"
+             "http.client/socket.socket) outside the sanctioned fleet "
+             "seams (serve/router.py, obs/exporter.py) — bypasses the "
+             "RetryPolicy transport",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -290,6 +305,17 @@ _WORLD_READ_TAILS = {"process_count", "device_count",
 _SYNC_SCOPE_PART = "parallel/"
 _SYNC_SCOPE_SUFFIX = "serve/engine.py"
 _SYNC_BARRIER_TAILS = {"block_until_ready", "device_get"}
+
+# TF118: the fleet's network client seams.  router.py owns the one HTTP
+# client (http_transport, always called under a RetryPolicy) and
+# exporter.py the one server; a raw client call anywhere else skips
+# retries, fault seams and the dispatch/scrape obs counters — the TF105
+# raw-GCS bypass class at the fleet boundary.  ``socket.gethostname``
+# and friends are not client calls and are untouched; local ephemeral-
+# port probes suppress with a reason.
+_NET_EXEMPT_SUFFIXES = ("serve/router.py", "obs/exporter.py")
+_NET_CALL_DOTTED = {"socket.socket", "socket.create_connection"}
+_NET_CALL_TAILS = {"urlopen", "HTTPConnection", "HTTPSConnection"}
 
 # TF105a: google.cloud.storage blob/bucket methods — allowed only inside
 # the retry-wrapped data/gcs.py layer.
@@ -497,6 +523,7 @@ class FileContext:
         self.thread_scope = not any(p in norm
                                     for p in _THREAD_SANCTIONED_PARTS)
         self.http_scope = not norm.endswith(_HTTP_EXEMPT_SUFFIX)
+        self.net_scope = not norm.endswith(_NET_EXEMPT_SUFFIXES)
         self.lock_scope = any(p in norm for p in _LOCK_DISCIPLINE_PARTS)
         self.wire_scope = norm.endswith(_WIRE_SEAM_SUFFIXES)
         self.world_scope = not any(p in norm
@@ -584,6 +611,27 @@ def _tf113_http_server(ctx: FileContext, node, fn):
         ctx.emit("TF113", node,
                  "http.server used outside obs/exporter.py — route the "
                  "endpoint through the telemetry exporter", fn)
+
+
+@_node_rule
+def _tf118_raw_network(ctx: FileContext, node, fn):
+    if not ctx.net_scope or not isinstance(node, ast.Call):
+        return
+    dotted = _dotted(node.func)
+    if not dotted:
+        return
+    tail = dotted.rsplit(".", 1)[-1]
+    if dotted in _NET_CALL_DOTTED or dotted in _NET_CALL_TAILS or (
+            tail in _NET_CALL_TAILS
+            and dotted.startswith(("urllib.", "http.client.",
+                                   "request.", "client."))):
+        ctx.emit("TF118", node,
+                 f"raw network client call {dotted}() outside "
+                 f"serve/router.py / obs/exporter.py — fleet traffic must "
+                 f"ride router.http_transport under a RetryPolicy "
+                 f"(backoff, attempt timeout, deadline, obs counters); "
+                 f"local non-fleet socket use suppresses with a reason",
+                 fn)
 
 
 def _tf106_emit(ctx: FileContext, node, key, fn):

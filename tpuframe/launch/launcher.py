@@ -40,7 +40,9 @@ from tpuframe.utils import compile_cache
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
+    # Local ephemeral-port probe (bind on loopback, never fleet traffic)
+    # — no retry/backoff semantics to bypass.
+    with socket.socket() as s:  # tf-lint: ok[TF118]
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 

@@ -204,6 +204,22 @@ def cmd_summarize(directory: str, generation: str | None) -> int:
             print(f"  tokens/s: {serve['tokens_per_s']:.2f} "
                   f"({serve['tokens_per_s_per_chip']:.2f} per chip, "
                   f"{serve['n_devices']} device(s))")
+
+    fleet = goodput_lib.fleet_stats(merged)
+    if fleet is not None:
+        print(f"fleet: {fleet['requests']}/{fleet['admitted']} admitted "
+              f"request(s) retired, {fleet['shed']} shed, "
+              f"{fleet['lost']} lost, {fleet['hedged']} hedged, "
+              f"{fleet['redispatched']} redispatched")
+        if fleet["by_replica"]:
+            print("  by replica: " + " ".join(
+                f"{k}={v}" for k, v in fleet["by_replica"].items()))
+        for d in fleet["drains"]:
+            print(f"  drain: {d['replica']} ({d['reason']})")
+        if fleet["ttft_ms"]:
+            pcts = fleet["ttft_ms"]
+            print("  router TTFT (ms): " + " ".join(
+                f"{q}={pcts[q]:.2f}" for q in ("p50", "p90", "p99")))
     return 0
 
 

@@ -56,6 +56,23 @@ Event types (see ``REQUIRED_FIELDS`` for the per-type contract):
   serve_request  a served request retired (prompt/output token counts,
                  TTFT/TPOT ms)
   serve_summary  end-of-loadgen rollup (requests, tokens/sec, devices)
+  router_admit   the fleet router accepted a request into its bounded
+                 pending queue
+  router_shed    admission control rejected a request (429-style: the
+                 bounded queue was full; queued = depth at rejection)
+  router_dispatch
+                 a request was sent to a replica (first placement)
+  router_hedge   a straggler request got a second, racing dispatch on
+                 another replica (first winner kept)
+  router_redispatch
+                 an in-flight request was re-dispatched off a draining
+                 replica (503 / scrape timeout / dispatch failure)
+  router_drain   a replica was marked draining (replica, reason) — no
+                 new dispatches; its in-flight work is re-dispatched
+  router_request a routed request retired at the router (end-to-end
+                 TTFT ms, winning replica, output tokens)
+  router_summary end-of-run fleet rollup (completed/shed/hedged/
+                 redispatched counts, replicas seen)
   ============== ========================================================
 
 Emission is *best-effort everywhere*: ``emit()`` is a no-op until
@@ -111,6 +128,14 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "serve_step": ("step", "wall_ms", "active"),
     "serve_request": ("id", "prompt_tokens", "output_tokens", "ttft_ms"),
     "serve_summary": ("requests", "tokens_per_s"),
+    "router_admit": ("id",),
+    "router_shed": ("id", "queued"),
+    "router_dispatch": ("id", "replica"),
+    "router_hedge": ("id", "replica"),
+    "router_redispatch": ("id", "replica"),
+    "router_drain": ("replica", "reason"),
+    "router_request": ("id", "replica", "ttft_ms"),
+    "router_summary": ("requests", "shed"),
 }
 
 _ENVELOPE = ("schema", "type", "t", "host", "proc", "attempt")

@@ -75,6 +75,7 @@ class MetricsExporter:
         self._lock = threading.Lock()
         self._gauges: dict[tuple, float] = {}
         self._collectors: list = []
+        self._handlers: dict[str, object] = {}
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -96,6 +97,20 @@ class MetricsExporter:
     def set_health(self, fn) -> None:
         with self._lock:
             self._health = fn
+
+    def add_handler(self, path: str, fn) -> None:
+        """Register ``fn(body: bytes) -> (status: int, body: bytes)`` to
+        serve POST requests at ``path``.  This keeps the process's whole
+        HTTP surface on the one sanctioned endpoint (TF113): the serving
+        replica's ``/generate`` rides the same server, port knob and
+        health probe as the scrape plane instead of standing up its own
+        socket."""
+        with self._lock:
+            self._handlers[path] = fn
+
+    def _handler_for(self, path: str):
+        with self._lock:
+            return self._handlers.get(path)
 
     def healthy(self) -> bool:
         fn = self._health
@@ -174,6 +189,26 @@ class MetricsExporter:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                path = self.path.split("?")[0]
+                handler = exporter._handler_for(path)
+                if handler is None:
+                    status, body = 404, b"not found\n"
+                else:
+                    try:
+                        n = int(self.headers.get("Content-Length") or 0)
+                        status, body = handler(self.rfile.read(n))
+                    except Exception as e:  # noqa: BLE001 — a broken
+                        # handler must answer 500, not kill the server
+                        status, body = 500, f"{type(e).__name__}: {e}\n" \
+                            .encode()
+                self.send_response(int(status))
+                self.send_header("Content-Type",
+                                 "application/json; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def log_message(self, *args):  # scrapes must not spam stdout
                 pass
 
@@ -188,11 +223,27 @@ class MetricsExporter:
         except OSError as e:
             import sys
 
-            print(f"[tpuframe.obs] metrics exporter: cannot bind port "
-                  f"{self._port_requested} ({e}) — scrape endpoint off, "
-                  f"textfile output unaffected", file=sys.stderr)
-            self._server = None  # tf-lint: ok[TF114] — caller-serialized
-            return self
+            if int(self._port_requested) != 0:
+                # Occupied/unbindable port: fall back to an ephemeral one
+                # (the bound port lands on ``.port``) instead of silently
+                # dropping the scrape plane — a fleet replica without a
+                # /healthz is indistinguishable from a dead one.
+                try:
+                    self._server = ThreadingHTTPServer(  # tf-lint: ok[TF114]
+                        ("0.0.0.0", 0), _Handler)
+                    print(f"[tpuframe.obs] metrics exporter: cannot bind "
+                          f"port {self._port_requested} ({e}) — fell back "
+                          f"to ephemeral port "
+                          f"{self._server.server_address[1]}",
+                          file=sys.stderr)
+                except OSError as e2:
+                    e = e2
+                    self._server = None  # tf-lint: ok[TF114] — caller-ser.
+            if self._server is None:
+                print(f"[tpuframe.obs] metrics exporter: cannot bind port "
+                      f"{self._port_requested} ({e}) — scrape endpoint "
+                      f"off, textfile output unaffected", file=sys.stderr)
+                return self
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]  # tf-lint: ok[TF114]
         # Serves in-process snapshots only (counters/gauges under a plain

@@ -538,6 +538,47 @@ def serve_stats(events: list) -> dict | None:
     }
 
 
+def fleet_stats(events: list) -> dict | None:
+    """Router-level rollup of the fleet's ``router_*`` events; None when
+    the log carries no router traffic.  ``lost`` is the fleet contract's
+    headline number — admitted minus retired, which a healthy run keeps
+    at zero through drain/redispatch — and shed is reported beside it
+    because an explicitly shed request is *not* a lost one (it was never
+    acknowledged)."""
+    done = [r for r in events if r.get("type") == "router_request"]
+    admits = sum(1 for r in events if r.get("type") == "router_admit")
+    sheds = sum(1 for r in events if r.get("type") == "router_shed")
+    drains = [r for r in events if r.get("type") == "router_drain"]
+    hedges = sum(1 for r in events if r.get("type") == "router_hedge")
+    redispatches = sum(1 for r in events
+                       if r.get("type") == "router_redispatch")
+    summary = next((r for r in reversed(events)
+                    if r.get("type") == "router_summary"), None)
+    if not (done or admits or sheds or summary is not None):
+        return None
+
+    ttft = sorted(float(r["ttft_ms"]) for r in done
+                  if r.get("ttft_ms") is not None)
+    by_replica: dict = {}
+    for r in done:
+        name = str(r.get("replica"))
+        by_replica[name] = by_replica.get(name, 0) + 1
+    return {
+        "requests": len(done),
+        "admitted": admits,
+        "shed": sheds,
+        "lost": admits - len(done),
+        "hedged": hedges,
+        "redispatched": redispatches,
+        "drains": [{"replica": r.get("replica"),
+                    "reason": r.get("reason")} for r in drains],
+        "by_replica": dict(sorted(by_replica.items())),
+        "ttft_ms": {q: round(_pct(ttft, v), 3) for q, v in
+                    (("p50", 0.5), ("p90", 0.9), ("p99", 0.99))}
+        if ttft else None,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Run comparison — the regression sentry (``python -m tpuframe.obs compare``).
 # ---------------------------------------------------------------------------
@@ -576,6 +617,11 @@ def _compare_metrics(events: list[dict], *,
             out["serve_ttft_p90_ms"] = serve["ttft_ms"]["p90"]
         if serve.get("tpot_ms"):
             out["serve_tpot_p90_ms"] = serve["tpot_ms"]["p90"]
+    fleet = fleet_stats(events)
+    if fleet is not None and fleet.get("ttft_ms"):
+        # End-to-end (router queue wait + replica TTFT): the number the
+        # chaos proof bounds at <=2x baseline under a replica kill.
+        out["router_ttft_p90_ms"] = fleet["ttft_ms"]["p90"]
     return out
 
 
@@ -602,6 +648,7 @@ def compare_runs(a_events: list[dict], b_events: list[dict], *,
         ("mfu_productive", "rel_drop", th["mfu_drop"]),
         ("serve_ttft_p90_ms", "pct_increase", th["serve_pct"]),
         ("serve_tpot_p90_ms", "pct_increase", th["serve_pct"]),
+        ("router_ttft_p90_ms", "pct_increase", th["serve_pct"]),
     )
     out: dict = {"metrics": {}, "regressions": [], "improvements": []}
     for name, kind, threshold in checks:

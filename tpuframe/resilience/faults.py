@@ -31,6 +31,15 @@ Seams are named injection points the framework calls into:
                 right after an async save is enqueued, while its upload
                 is in flight (default kind ``sigterm`` — drives the
                 flush-before-rc-14 path)
+  replica_crash
+                the serving replica's main loop, once per scheduler
+                step (default kind ``crash`` — the replica-kill model:
+                the router must redispatch its in-flight requests)
+  replica_hang  same seam, default kind ``hang`` — the replica's step
+                loop stops beating while its exporter thread keeps
+                serving, so ``/healthz`` flips 503 (the stall model)
+  replica_slow  same seam, default kind ``slow`` — a straggler replica
+                (sleeps ``delay_s``; the hedging model)
   ============  ======================================================
 
 Kinds: ``ioerror`` (raise a retryable :class:`InjectedFault`), ``slow``
@@ -68,12 +77,16 @@ _KINDS = ("ioerror", "slow", "corrupt", "torn", "crash", "sigterm",
           "sigint", "hang", "partial_sigterm")
 _SEAMS = ("gcs_read", "gcs_write", "gcs_list", "gcs_stat", "gcs_delete",
           "ckpt_shard", "host", "slow_gcs", "crash_during_upload",
-          "sigterm_pending_upload")
+          "sigterm_pending_upload", "replica_crash", "replica_hang",
+          "replica_slow")
 # The checkpoint-pipeline seams read more naturally with their purpose as
 # the default kind — ``slow_gcs`` without ``:kind=`` means slow, not a
-# spelled-the-seam-name-but-raises-ioerror surprise.
+# spelled-the-seam-name-but-raises-ioerror surprise.  Same for the
+# serving-replica seams: the name IS the failure mode.
 _SEAM_DEFAULT_KIND = {"slow_gcs": "slow", "crash_during_upload": "crash",
-                      "sigterm_pending_upload": "sigterm"}
+                      "sigterm_pending_upload": "sigterm",
+                      "replica_crash": "crash", "replica_hang": "hang",
+                      "replica_slow": "slow"}
 _CRASH_RC = 42
 
 

@@ -149,6 +149,32 @@ def cmd_selfcheck(args) -> int:
         failures.append("decode outputs flagged cache-unsafe")
     print("[serve] compile-cache safety: ok")
 
+    # 6. Fleet smoke: 2 fake-engine replica subprocesses behind the
+    #    router, seeded loadgen, one replica_crash mid-run — the
+    #    zero-loss drain/redispatch contract on every CI run (the full
+    #    3-replica latency proof lives in tests/test_chaos.py).
+    from tpuframe.serve import router as router_lib
+
+    try:
+        fleet = router_lib.fleet_smoke(
+            replicas=2, n_requests=10, kill_rank=1, kill_step=3,
+            step_delay_ms=5.0, seed=args.seed,
+            log=lambda m: print(f"[serve] {m}"))
+    except Exception as e:  # noqa: BLE001 — a harness crash is a failure
+        failures.append(f"fleet smoke crashed: {type(e).__name__}: {e}")
+    else:
+        if fleet["lost"] or fleet["shed"] or fleet["timed_out"]:
+            failures.append(
+                f"fleet smoke: lost={fleet['lost']} shed={fleet['shed']} "
+                f"timed_out={fleet['timed_out']} (want 0/0/False)")
+        if fleet["drains"] < 1:
+            failures.append("fleet smoke: replica_crash produced no "
+                            "router drain")
+        print(f"[serve] fleet smoke: {fleet['requests']} requests, "
+              f"{fleet['drains']} drain(s), "
+              f"{fleet['redispatched']} redispatched, "
+              f"exit codes {fleet['exit_codes']}")
+
     for f in failures:
         print(f"SERVE FAIL {f}")
     print(f"[serve] selfcheck: {len(failures)} failure(s)")

@@ -1,0 +1,283 @@
+"""One serving replica of the fleet — engine + scheduler behind the exporter.
+
+A replica is the unit the router (``serve/router.py``) load-balances
+over: the existing continuous-batching :class:`~tpuframe.serve.scheduler.
+Scheduler` wrapped in a process whose *entire* HTTP surface rides the PR 9
+telemetry exporter (``obs/exporter.py`` — the one sanctioned endpoint,
+TF113):
+
+  ``/metrics``   live queue depth / active slots / TTFT percentiles (the
+                 router's load + shed signal)
+  ``/healthz``   200 while the step loop beats and the replica is not
+                 draining; 503 otherwise — the router's drain signal
+  ``/generate``  POST ``{"rid", "prompt", "max_new_tokens"}`` → blocks
+                 until the scheduler retires the request, returns
+                 ``{"rid", "tokens", "ttft_ms", "tpot_ms", "proc"}``
+
+Threading contract: the exporter's HTTP worker threads only parse,
+enqueue into the inbox and wait on an event — the *main* thread is the
+only one that touches the engine (prefill/insert/decode are jax on the
+real engine; a worker thread driving them would be the TF111 collective-
+ordering hazard).  No thread is created in this module.
+
+Drain semantics (the zero-loss half of the fleet contract): SIGTERM — or
+a 503-flipping health probe — marks the replica draining.  ``/generate``
+rejects *new* work with 503, ``/healthz`` goes 503 so the router stops
+dispatching and re-dispatches as it sees fit, and the main loop keeps
+stepping until every request it already accepted has retired and been
+answered; only then does it exit 0.  A request is therefore never
+acknowledged-and-dropped: it either completes here or was never accepted.
+
+Chaos seams (``resilience/faults.py``): the step loop fires
+``replica_slow`` / ``replica_hang`` / ``replica_crash`` once per
+iteration with the fault step pinned to the scheduler step count, so
+``TPUFRAME_FAULTS="replica_crash:step=3:rank=1"`` deterministically
+kills replica 1 after its third scheduler step.
+
+The :class:`FakeEngine` is the pure-host stand-in for fleet tests and
+the selfcheck smoke: deterministic token streams that are a function of
+the prompt alone, so re-prefill on any replica reproduces them — the
+idempotence the router's hedging (first-winner-kept) relies on, same as
+the real engine's greedy argmax decode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+from tpuframe.obs import events as obs_events
+from tpuframe.obs import exporter as obs_exporter
+from tpuframe.resilience import faults
+from tpuframe.serve.scheduler import Request, Scheduler
+
+READY_PREFIX = "TPUFRAME_REPLICA_READY"
+
+# Fired once per main-loop iteration, cheap no-ops unless armed.
+_FAULT_SEAMS = ("replica_slow", "replica_hang", "replica_crash")
+
+
+class FakeEngine:
+    """Deterministic pure-host engine with the LMEngine seam contract.
+
+    Token streams are a pure function of the prompt (first token from a
+    prompt hash, each decode token from the previous one), so any
+    replica re-prefilling the same request produces the same stream —
+    the property that makes the router's redispatch/hedging idempotent.
+    ``step_delay_s`` models decode cost so fleet runs have real
+    queueing behavior without a jax compile.
+    """
+
+    def __init__(self, *, slots: int = 2, prompt_buckets=(16, 32),
+                 eos_id: int | None = None, step_delay_s: float = 0.0,
+                 vocab_size: int = 256):
+        self.slots = slots
+        self.prompt_buckets = tuple(sorted(prompt_buckets))
+        self.eos_id = eos_id
+        self.step_delay_s = step_delay_s
+        self.vocab_size = vocab_size
+        self._last = [0] * slots
+
+    def prefill(self, token_ids):
+        first = (sum(int(t) for t in token_ids)
+                 + 31 * len(token_ids)) % self.vocab_size
+        return first, ("pcache", len(token_ids)), len(token_ids)
+
+    def insert(self, slot, pcache, length, first_token) -> None:
+        self._last[slot] = int(first_token)
+
+    def decode_step(self):
+        if self.step_delay_s > 0:
+            time.sleep(self.step_delay_s)
+        out = []
+        for s in range(self.slots):
+            self._last[s] = (self._last[s] * 31 + 7) % self.vocab_size
+            out.append(self._last[s])
+        return out
+
+    def reset(self) -> None:
+        self._last = [0] * self.slots
+
+
+class Replica:
+    """The serving fleet's worker: scheduler main loop + exporter surface."""
+
+    def __init__(self, engine, *, stall_timeout_s: float = 2.0,
+                 handler_timeout_s: float = 120.0, clock=time.monotonic):
+        self.engine = engine
+        self._clock = clock
+        self.stall_timeout_s = stall_timeout_s
+        self.handler_timeout_s = handler_timeout_s
+        self.scheduler = Scheduler(engine)
+        self._inbox: list = []               # (Request, threading.Event)
+        self._inbox_lock = threading.Lock()
+        self._waiters: dict = {}             # rid -> threading.Event
+        self._resolved = 0                   # prefix of scheduler.completed
+        self._draining = False
+        self._last_beat = clock()
+        self.exporter = obs_exporter.start_from_env(health=self.healthy)
+        if self.exporter is not None:
+            self.exporter.add_handler("/generate", self.handle_generate)
+
+    # -- health / drain ---------------------------------------------------
+
+    def healthy(self) -> bool:
+        """503 the moment we drain OR the step loop stops beating — the
+        router must see a hung replica (main loop stuck, exporter thread
+        alive) as unhealthy before any request deadline trips."""
+        if self._draining:
+            return False
+        return (self._clock() - self._last_beat) < self.stall_timeout_s
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, signum=None, frame=None) -> None:
+        """Graceful drain (the SIGTERM handler): stop accepting, finish
+        and answer everything already accepted, then let ``run`` exit."""
+        self._draining = True
+
+    # -- the exporter-thread side -----------------------------------------
+
+    def handle_generate(self, body: bytes):
+        """POST /generate — runs on an exporter HTTP worker thread.
+        Only parses, enqueues and waits; the main loop owns the engine."""
+        try:
+            msg = json.loads(body.decode() or "{}")
+            rid = int(msg["rid"])
+            prompt = [int(t) for t in msg["prompt"]]
+            max_new = int(msg.get("max_new_tokens", 8))
+        except (KeyError, ValueError, TypeError) as e:
+            return 400, json.dumps({"error": f"bad request: {e}"}).encode()
+        if len(prompt) > max(self.engine.prompt_buckets) or not prompt:
+            return 400, json.dumps(
+                {"error": f"prompt length {len(prompt)} outside buckets "
+                          f"{self.engine.prompt_buckets}"}).encode()
+        if self._draining:
+            return 503, json.dumps({"error": "draining"}).encode()
+        req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new,
+                      arrival_t=time.perf_counter())
+        done = threading.Event()
+        with self._inbox_lock:
+            self._inbox.append((req, done))
+        if not done.wait(self.handler_timeout_s):
+            return 504, json.dumps(
+                {"error": "timed out waiting for the scheduler"}).encode()
+        return 200, json.dumps({
+            "rid": rid,
+            "tokens": [int(t) for t in req.tokens],
+            "ttft_ms": req.ttft_ms(),
+            "tpot_ms": req.tpot_ms(),
+            "proc": os.environ.get("TPUFRAME_PROCESS_ID", "0"),
+        }).encode()
+
+    # -- the main-loop side ------------------------------------------------
+
+    def _pump_inbox(self) -> int:
+        with self._inbox_lock:
+            batch, self._inbox = self._inbox, []
+        for req, done in batch:
+            self._waiters[req.rid] = done
+            self.scheduler.submit(req)
+        return len(batch)
+
+    def _resolve_completed(self) -> None:
+        completed = self.scheduler.completed
+        while self._resolved < len(completed):
+            req = completed[self._resolved]
+            self._resolved += 1
+            done = self._waiters.pop(req.rid, None)
+            if done is not None:
+                done.set()
+
+    def run(self, *, max_steps: int | None = None,
+            idle_sleep_s: float = 0.002,
+            max_idle_s: float | None = None) -> int:
+        """The replica main loop: beat, fire chaos seams, pump the inbox,
+        step the scheduler, answer retired requests.  Returns 0 when a
+        drain completed with nothing left in flight."""
+        sched = self.scheduler
+        idle_since = self._clock()
+        while True:
+            self._last_beat = self._clock()
+            faults.set_step(sched.step_count)
+            for seam in _FAULT_SEAMS:
+                faults.fire(seam)
+            self._pump_inbox()
+            if sched.has_work():
+                sched.step()
+                self._resolve_completed()
+                idle_since = self._clock()
+            elif self._draining:
+                break  # drained: every accepted request has been answered
+            else:
+                if (max_idle_s is not None
+                        and self._clock() - idle_since > max_idle_s):
+                    break
+                time.sleep(idle_sleep_s)
+            if max_steps is not None and sched.step_count >= max_steps:
+                break
+        self._resolve_completed()
+        return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpuframe.serve.replica",
+        description="one serving-fleet replica (engine+scheduler behind "
+                    "the telemetry exporter)")
+    ap.add_argument("--engine", default="fake", choices=("fake", "tiny-lm"))
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--step-delay-ms", type=float, default=0.0,
+                    help="fake-engine decode cost per step")
+    ap.add_argument("--stall-timeout-s", type=float, default=2.0)
+    ap.add_argument("--max-steps", type=int, default=None)
+    ap.add_argument("--max-idle-s", type=float, default=None,
+                    help="exit after this much idle time (orphan guard)")
+    ap.add_argument("--ready-file", default=None,
+                    help="write the READY line (bound port) here")
+    args = ap.parse_args(argv)
+
+    faults.reset_from_env()
+    obs_events.init()
+    if args.engine == "fake":
+        engine = FakeEngine(slots=args.slots,
+                            step_delay_s=args.step_delay_ms / 1e3)
+    else:
+        from tpuframe.models.transformer_lm import LMConfig
+        from tpuframe.serve.engine import LMEngine
+
+        buckets = (16, 32)
+        engine = LMEngine(LMConfig.tiny(), slots=args.slots,
+                          prompt_buckets=buckets, decode_block=16,
+                          max_context=max(buckets) + 32)
+
+    replica = Replica(engine, stall_timeout_s=args.stall_timeout_s)
+    signal.signal(signal.SIGTERM, replica.drain)
+    if replica.exporter is None or replica.exporter.port is None:
+        print("[replica] no scrape endpoint — set TPUFRAME_METRICS_PORT "
+              "(0 = ephemeral) before launching a fleet replica",
+              file=sys.stderr)
+        return 2
+    ready = f"{READY_PREFIX} port={replica.exporter.port} pid={os.getpid()}"
+    if args.ready_file:
+        tmp = f"{args.ready_file}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(ready + "\n")
+        os.replace(tmp, args.ready_file)
+    print(ready, flush=True)
+
+    rc = replica.run(max_steps=args.max_steps, max_idle_s=args.max_idle_s)
+    obs_events.close()
+    obs_exporter.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,11 +84,23 @@ class Scheduler:
         self.step_count = 0
         self.tokens_generated = 0
         # Live telemetry (obs/exporter.py, env-gated no-op otherwise):
-        # queue/slot gauges pushed per step, TTFT/TPOT percentiles over
-        # retired requests served through a pull collector.
+        # queue/slot/token gauges and TTFT/TPOT percentiles served
+        # through pull collectors — a scrape between steps must see the
+        # *current* pending depth (the router's admission signal), not
+        # the last step's snapshot.
         self._exporter = obs_exporter.start_from_env()
         if self._exporter is not None:
             self._exporter.add_collector(self._latency_samples)
+            self._exporter.add_collector(self._load_samples)
+
+    def _load_samples(self):
+        return [
+            ("tpuframe_serve_queue_depth", {}, float(len(self.pending))),
+            ("tpuframe_serve_active_slots", {},
+             float(sum(r is not None for r in self.active))),
+            ("tpuframe_serve_tokens_generated", {},
+             float(self.tokens_generated)),
+        ]
 
     def _latency_samples(self):
         ttft = sorted(v for v in (r.ttft_ms() for r in self.completed)
@@ -118,22 +130,15 @@ class Scheduler:
                                          for r in self.active)
 
     def step(self) -> int:
-        """One scheduler step (admit + decode + retire).  Returns the
-        number of live tokens produced this step."""
+        """One scheduler step (admit + decode + retire + admit).
+        Returns the number of live tokens produced this step.
+
+        The trailing admit pass fills slots freed by *this step's*
+        retires — their prefill (and first token, so TTFT) lands this
+        step and their first decode token next step.  Without it a
+        freed slot idles until the next step's leading admit."""
         t0 = self._clock()
-        admitted = 0
-        for slot in range(self.engine.slots):
-            if self.active[slot] is not None or not self.pending:
-                continue
-            req = self.pending.pop(0)
-            first_tok, pcache, length = self.engine.prefill(req.prompt)
-            self.engine.insert(slot, pcache, length, first_tok)
-            req.first_token_t = self._clock()
-            req.tokens.append(first_tok)
-            self.active[slot] = req
-            admitted += 1
-            if self._finished(req, first_tok):
-                self._retire(slot)
+        admitted = self._admit()
 
         produced = 0
         if any(r is not None for r in self.active):
@@ -148,6 +153,7 @@ class Scheduler:
                 if self._finished(req, tok):
                     req.done_t = now
                     self._retire(slot)
+        admitted += self._admit()
         self.step_count += 1
         self.tokens_generated += produced + admitted
         obs_events.emit(
@@ -156,17 +162,33 @@ class Scheduler:
             active=sum(r is not None for r in self.active),
             admitted=admitted, produced=produced,
             queued=len(self.pending))
-        if self._exporter is not None:
-            self._exporter.set_gauge("tpuframe_serve_queue_depth",
-                                     len(self.pending))
-            self._exporter.set_gauge(
-                "tpuframe_serve_active_slots",
-                sum(r is not None for r in self.active))
-            self._exporter.set_gauge("tpuframe_serve_tokens_generated",
-                                     self.tokens_generated)
         return produced + admitted
 
     # -- internals ----------------------------------------------------------
+
+    def _admit(self) -> int:
+        """Fill free slots from the pending FIFO.  A request that
+        finishes at prefill (max_new_tokens=1 or instant EOS) retires in
+        place and its slot is reused without advancing — one admit pass
+        never leaves a free slot behind while requests wait."""
+        admitted = 0
+        slot = 0
+        while self.pending and slot < self.engine.slots:
+            if self.active[slot] is not None:
+                slot += 1
+                continue
+            req = self.pending.pop(0)
+            first_tok, pcache, length = self.engine.prefill(req.prompt)
+            self.engine.insert(slot, pcache, length, first_tok)
+            req.first_token_t = self._clock()
+            req.tokens.append(first_tok)
+            self.active[slot] = req
+            admitted += 1
+            if self._finished(req, first_tok):
+                self._retire(slot)
+            else:
+                slot += 1
+        return admitted
 
     def _finished(self, req: Request, tok: int) -> bool:
         return (len(req.tokens) >= req.max_new_tokens
