@@ -394,7 +394,9 @@ def test_derived_for_every_fixture_strategy():
 _TOP_KEYS = {"schema", "jax", "n_devices", "lint", "strategies"}
 _STRATEGY_KEYS = {"name", "status", "reason", "violations", "collectives",
                   "total_bytes", "derived", "drift", "detectors", "graph",
-                  "schedule", "schedule_drift", "overlap"}
+                  "schedule", "schedule_drift", "overlap", "comm_split"}
+_COMM_SPLIT_KEYS = {"slices", "ici", "dcn", "ici_bytes", "dcn_bytes",
+                    "unattributed", "t_ici_ms", "t_dcn_ms", "generation"}
 _DETECTOR_KEYS = {"redundant_pair", "wire_dtype", "replication",
                   "replica_groups", "census", "exposed_comm"}
 _SCHEDULE_KEYS = {"ignore_below", "peak_live_bytes", "undonated_doubles",
@@ -435,7 +437,7 @@ def test_report_schema_pinned(tmp_path):
     parses it, so key changes must be deliberate (bump REPORT_SCHEMA)."""
     report = _build_one_report(tmp_path)
     assert set(report) == _TOP_KEYS
-    assert report["schema"] == shardflow.REPORT_SCHEMA == 2
+    assert report["schema"] == shardflow.REPORT_SCHEMA == 3
     assert report["lint"] == [{"rule": "TF999", "path": "x.py",
                                "line": 3, "message": "demo"}]
     (entry,) = report["strategies"]
@@ -449,6 +451,7 @@ def test_report_schema_pinned(tmp_path):
                                    "collectives_by_kind"}
     assert set(entry["schedule"]) == _SCHEDULE_KEYS
     assert set(entry["overlap"]) == _OVERLAP_KEYS
+    assert set(entry["comm_split"]) == _COMM_SPLIT_KEYS
     assert entry["drift"] == []
     assert entry["schedule_drift"] == []
     json.dumps(report)  # must be serializable as-is

@@ -32,7 +32,13 @@ non-zero on any finding:
      (regenerate with ``--emit-budgets``) and the schedule/liveness
      records against ``derived_schedule.json`` (regenerate with
      ``--emit-schedule``);
-  8. compare selfcheck — the jax-free golden compare pair under
+  8. pspec self-check — the declarative parallelism-spec grammar
+     (:mod:`tpuframe.parallel.pspec`) fuzzes its pinned parse/format
+     round-trip and rejection tables, and seeds a replica-group
+     mismatch against the hierarchical ICI×DCN mesh that the detector
+     MUST flag (plus a valid cross-slice twin whose bytes the ICI/DCN
+     split must attribute to DCN) — the gate refuses to run blind;
+  9. compare selfcheck — the jax-free golden compare pair under
      ``docs/samples/analysis_compare/`` must keep exercising the whole
      ``--compare`` contract (schema keys, rc codes, the schedule
      section), so a report-schema change that strands the differ fails
@@ -258,6 +264,16 @@ def _run_quantwire_check() -> int:
     return len(problems)
 
 
+def _run_pspec_check() -> int:
+    from tpuframe.parallel import pspec
+
+    problems = pspec.check()
+    for p in problems:
+        print(f"PSPEC {p}")
+    print(f"[analysis] pspec self-check: {len(problems)} problem(s)")
+    return len(problems)
+
+
 def _run_router_check() -> int:
     from tpuframe.serve import router
 
@@ -353,6 +369,7 @@ def main(argv=None) -> int:
         n_findings += _run_zero1_check()
         n_findings += _run_elastic_check()
         n_findings += _run_quantwire_check()
+        n_findings += _run_pspec_check()
         n_findings += _run_obs_check()
         if args.json:
             _write_json(args.json, audits, lint_findings, args.devices)

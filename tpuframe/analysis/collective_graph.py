@@ -76,7 +76,8 @@ _SHAPE_RE = re.compile(
 _OPERAND_RE = re.compile(r"%([\w.-]+)")
 _CHANNEL_RE = re.compile(r"channel_id=(\d+)")
 _GROUPS_RE = re.compile(r"replica_groups=\{((?:\{[0-9, ]*\},?)*)\}")
-_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?")
 _PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{[0-9, ]*\},?)*)\}")
 _CALLED_RE = re.compile(
     r"(?:to_apply|calls|body|condition|true_computation|"
@@ -173,6 +174,52 @@ def _parse_groups(txt: str) -> tuple[tuple[int, ...], ...]:
     return tuple(g for g in groups if g)
 
 
+def materialized_groups(node, n_devices: int
+                        ) -> tuple[tuple[int, ...], ...] | None:
+    """Explicit device groups for a collective node, whatever textual
+    form its ``replica_groups`` took.
+
+    Explicit groups pass through; the iota form
+    ``[count,size]<=[dims]T(perm)`` materializes as
+    ``transpose(reshape(arange(prod(dims)), dims), perm).flatten()``
+    chunked into ``count`` rows of ``size`` (HLO's
+    IotaReplicaGroupList semantics — the ``T(...)`` variant yields
+    strided groups, so it cannot be skipped); absent/empty groups mean
+    one group of all ``n_devices``.  Returns ``None`` when the iota
+    spec is inconsistent — callers treat that as unattributable."""
+    if node.replica_groups:
+        return node.replica_groups
+    if node.iota_groups is None:
+        return (tuple(range(n_devices)),)
+    count, size = node.iota_groups
+    dims = node.iota_reshape or (count * size,)
+    total = 1
+    for d in dims:
+        total *= d
+    if total != count * size:
+        return None
+    perm = node.iota_transpose or tuple(range(len(dims)))
+    if sorted(perm) != list(range(len(dims))):
+        return None
+    # Row-major strides of the reshape, read through the transpose.
+    strides = [1] * len(dims)
+    for i in range(len(dims) - 2, -1, -1):
+        strides[i] = strides[i + 1] * dims[i + 1]
+    t_dims = [dims[p] for p in perm]
+    t_strides = [strides[p] for p in perm]
+    flat: list[int] = []
+    idx = [0] * len(t_dims)
+    for _ in range(total):
+        flat.append(sum(i * s for i, s in zip(idx, t_strides)))
+        for ax in range(len(t_dims) - 1, -1, -1):
+            idx[ax] += 1
+            if idx[ax] < t_dims[ax]:
+                break
+            idx[ax] = 0
+    return tuple(tuple(flat[g * size:(g + 1) * size])
+                 for g in range(count))
+
+
 @dataclass
 class Node:
     """One HLO instruction: a def, its shape/dtype, and its uses."""
@@ -187,6 +234,10 @@ class Node:
     called: tuple[str, ...] = ()    # called computation names
     replica_groups: tuple[tuple[int, ...], ...] | None = None
     iota_groups: tuple[int, int] | None = None   # (count, size) iota form
+    #: the iota form's reshape dims and transpose permutation
+    #: (``[c,s]<=[d0,d1]T(1,0)``) — needed to materialize strided groups.
+    iota_reshape: tuple[int, ...] | None = None
+    iota_transpose: tuple[int, ...] | None = None
     source_target_pairs: tuple[tuple[int, ...], ...] | None = None
     channel_id: int | None = None
     sharded: bool = False           # carries a sharding={...} annotation
@@ -619,6 +670,10 @@ def _parse_instruction(line: str, line_no: int,
         called=tuple(_CALLED_RE.findall(attrs_txt)),
         replica_groups=_parse_groups(gm.group(1)) if gm else None,
         iota_groups=(int(im.group(1)), int(im.group(2))) if im else None,
+        iota_reshape=(tuple(int(d) for d in im.group(3).split(","))
+                      if im else None),
+        iota_transpose=(tuple(int(d) for d in im.group(4).split(","))
+                        if im and im.group(4) else None),
         source_target_pairs=_parse_groups(pm.group(1)) if pm else None,
         channel_id=int(cm.group(1)) if cm else None,
         sharded=bool(_SHARDING_RE.search(attrs_txt)),

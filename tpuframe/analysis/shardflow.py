@@ -78,7 +78,9 @@ from tpuframe.analysis import hlo_audit
 #: schema version of both the --json report and derived_budgets.json.
 #: v2: per-strategy "schedule" (liveness/window census), "overlap"
 #: (roofline overlap-potential score), and the exposed_comm detector.
-REPORT_SCHEMA = 2
+#: v3: per-strategy "comm_split" — ICI vs DCN byte attribution from the
+#: materialized replica groups against the declared hierarchical mesh.
+REPORT_SCHEMA = 3
 
 DERIVED_BUDGETS_PATH = os.path.join(os.path.dirname(
     os.path.abspath(__file__)), "derived_budgets.json")
@@ -834,6 +836,74 @@ def overlap_score(graph: cg.CollectiveGraph, report, *,
     }
 
 
+def comm_split(graph: cg.CollectiveGraph, report, *, mesh_shape: dict,
+               n_devices: int, generation: str = "v5e") -> dict:
+    """ICI vs DCN byte attribution from replica groups.
+
+    On a hierarchical mesh the ``slice`` axis is outermost, so logical
+    device ``d`` lives in slice ``d // (n_devices / slices)`` — a
+    collective whose materialized replica groups (or permute pairs)
+    contain members of more than one slice must leave the ICI torus,
+    and its FULL wire bytes are charged to DCN (conservative: the slow
+    hop bounds the op).  Bytes use the census ruler (``hlo_audit`` op
+    bytes matched by source line, like :func:`overlap_score`; result
+    bytes as fallback), so quantized wires split at their real payload.
+    Single-slice meshes attribute everything to ICI by construction.
+    ``unattributed`` counts collectives whose iota group spec could not
+    be materialized — those are charged to DCN, never dropped."""
+    from tpuframe.tune import roofline
+
+    # "slice" is mesh.SLICE_AXIS; spelled literally so the report stays
+    # buildable without jax (mesh imports it).
+    slices = int(mesh_shape.get("slice", 1)) if mesh_shape else 1
+    if slices < 1 or n_devices % max(slices, 1):
+        slices = 1
+    inner = n_devices // slices
+    line_bytes: dict[str, list] = {}
+    if report is not None:
+        for op in report.ops:
+            line_bytes.setdefault(op.line, []).append(int(op.bytes))
+    ici: dict[str, int] = {}
+    dcn: dict[str, int] = {}
+    unattributed = 0
+    for _comp, node in graph.collectives():
+        matched = line_bytes.get(node.line)
+        nbytes = matched.pop(0) if matched else node.result_bytes
+        crossing = False
+        if slices > 1:
+            if node.kind == "collective-permute":
+                pairs = node.source_target_pairs or ()
+                crossing = any(s // inner != t // inner
+                               for s, t, *_ in pairs)
+            else:
+                groups = cg.materialized_groups(node, n_devices)
+                if groups is None:
+                    unattributed += 1
+                    crossing = True
+                else:
+                    crossing = any(
+                        len({d // inner for d in g}) > 1 for g in groups)
+        bucket = dcn if crossing else ici
+        bucket[node.kind] = bucket.get(node.kind, 0) + int(nbytes)
+    ici_bytes = sum(ici.values())
+    dcn_bytes = sum(dcn.values())
+    return {
+        "slices": slices,
+        "ici": {k: int(v) for k, v in sorted(ici.items())},
+        "dcn": {k: int(v) for k, v in sorted(dcn.items())},
+        "ici_bytes": int(ici_bytes),
+        "dcn_bytes": int(dcn_bytes),
+        "unattributed": int(unattributed),
+        "t_ici_ms": round(sum(
+            roofline.comm_ms(generation, k, b, n_devices)
+            for k, b in ici.items()), 6),
+        "t_dcn_ms": round(sum(
+            roofline.dcn_ms(generation, k, b, slices)
+            for k, b in dcn.items()), 6),
+        "generation": generation,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Per-audit flow check + the gate entry point.
 # ---------------------------------------------------------------------------
@@ -879,6 +949,10 @@ def audit_flow(audit, *, derived_file: dict | None = None,
         "overlap": overlap_score(
             graph, audit.report, n_devices=n_devices,
             ignore_below=audit.budget.ignore_below),
+        "comm_split": comm_split(
+            graph, audit.report,
+            mesh_shape=meta.mesh_dict if meta else {},
+            n_devices=n_devices),
         "problems": problems,
     }
 
@@ -944,6 +1018,7 @@ def build_report(audits, *, lint_findings=(), n_devices: int = 8,
                 "schedule": flow["schedule"],
                 "schedule_drift": flow["schedule_drift"],
                 "overlap": flow["overlap"],
+                "comm_split": flow["comm_split"],
             })
         strategies_out.append(entry)
     return {
@@ -1052,7 +1127,7 @@ def compare_reports(a: dict, b: dict, *,
 STRATEGY_REPORT_KEYS = frozenset({
     "name", "status", "reason", "violations", "collectives",
     "total_bytes", "derived", "drift", "detectors", "graph",
-    "schedule", "schedule_drift", "overlap",
+    "schedule", "schedule_drift", "overlap", "comm_split",
 })
 
 

@@ -123,6 +123,19 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          raw-GCS check, at the fleet seam.  Local non-fleet socket use
          (ephemeral-port probes) suppresses with ``# tf-lint: ok[TF118]``
          and a reason.
+  TF119  raw mesh construction outside the mesh seam — a
+         ``jax.sharding.Mesh(...)``/``jax.make_mesh(...)`` call anywhere
+         but ``parallel/mesh.py`` (the one module that knows the axis
+         order) or ``parallel/pspec.py`` (the declarative spec that
+         lowers onto it).  A hand-built mesh silently re-decides the
+         axis names and the ICI/DCN ordering that every replica-group
+         validation, batch partition and DCN-split attribution keys on —
+         the exact drift class the hierarchical ``slice`` axis makes
+         fatal (an inner-out slice axis puts model traffic on DCN).
+         Build through ``mesh.make_mesh(MeshSpec(...))`` or a parsed
+         ``ParallelSpec``; degenerate single-purpose meshes (the
+         process-axis host mesh, topology probes) suppress with
+         ``# tf-lint: ok[TF119]`` and a reason.
 
 Scope: TF101/TF102 only fire *inside functions known to be traced*
 (decorated with ``jax.jit``/``pmap``/``shard_map`` or passed to
@@ -191,6 +204,10 @@ RULES = {
              "http.client/socket.socket) outside the sanctioned fleet "
              "seams (serve/router.py, obs/exporter.py) — bypasses the "
              "RetryPolicy transport",
+    "TF119": "raw mesh construction (jax.sharding.Mesh/jax.make_mesh) "
+             "outside the mesh seam (parallel/mesh.py, "
+             "parallel/pspec.py) — re-decides axis names and ICI/DCN "
+             "ordering behind the spec grammar's back",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -314,6 +331,12 @@ _SYNC_BARRIER_TAILS = {"block_until_ready", "device_get"}
 # and friends are not client calls and are untouched; local ephemeral-
 # port probes suppress with a reason.
 _NET_EXEMPT_SUFFIXES = ("serve/router.py", "obs/exporter.py")
+
+# TF119: the mesh seam.  mesh.py owns axis names/order (slice axis
+# OUTERMOST so cross-slice collectives ride DCN); pspec.py is the
+# declarative grammar that lowers onto it.  Everything else builds
+# through them.
+_MESH_EXEMPT_SUFFIXES = ("parallel/mesh.py", "parallel/pspec.py")
 _NET_CALL_DOTTED = {"socket.socket", "socket.create_connection"}
 _NET_CALL_TAILS = {"urlopen", "HTTPConnection", "HTTPSConnection"}
 
@@ -524,6 +547,7 @@ class FileContext:
                                     for p in _THREAD_SANCTIONED_PARTS)
         self.http_scope = not norm.endswith(_HTTP_EXEMPT_SUFFIX)
         self.net_scope = not norm.endswith(_NET_EXEMPT_SUFFIXES)
+        self.mesh_scope = not norm.endswith(_MESH_EXEMPT_SUFFIXES)
         self.lock_scope = any(p in norm for p in _LOCK_DISCIPLINE_PARTS)
         self.wire_scope = norm.endswith(_WIRE_SEAM_SUFFIXES)
         self.world_scope = not any(p in norm
@@ -904,6 +928,33 @@ def _tf117_traced_sync(ctx: FileContext, node, fn):
                  f"overlap across this point; sync on the host after "
                  f"the step returns, or suppress with tf-lint: "
                  f"ok[TF117] and a reason", fn)
+
+
+@_node_rule
+def _tf119_raw_mesh(ctx: FileContext, node, fn):
+    """A mesh constructed by hand outside the mesh seam:
+    ``Mesh(...)`` in any dotted spelling, or jax's own
+    ``make_mesh(...)`` (``jax.make_mesh``/``jax.sharding.make_mesh`` —
+    NOT ``mesh_lib.make_mesh``, which IS the seam).  Axis names and the
+    outermost-slice ordering are the contract every downstream consumer
+    keys on (replica-group validation, ``batch_axes``, the ICI/DCN
+    byte split); a raw construction opts out of all of it silently."""
+    if not ctx.mesh_scope or not isinstance(node, ast.Call):
+        return
+    callee = _dotted(node.func)
+    tail = callee.rsplit(".", 1)[-1]
+    raw = (tail == "Mesh"
+           or (tail == "make_mesh"
+               and callee in ("jax.make_mesh", "jax.sharding.make_mesh",
+                              "sharding.make_mesh")))
+    if raw:
+        ctx.emit("TF119", node,
+                 f"raw `{callee}(...)` outside parallel/mesh.py — a "
+                 f"hand-built mesh re-decides axis names and the "
+                 f"ICI/DCN slice ordering behind the spec grammar's "
+                 f"back; build through mesh.make_mesh(MeshSpec(...)) / "
+                 f"ParallelSpec.make_mesh(), or suppress with tf-lint: "
+                 f"ok[TF119] and a reason", fn)
 
 
 @_fn_rule

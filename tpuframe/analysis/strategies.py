@@ -24,6 +24,7 @@ child process sets this up — see ``tpuframe.analysis.__main__``).
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 
 from tpuframe.analysis import budgets as budgets_lib
@@ -191,86 +192,150 @@ def _lm_pieces(batch: int = 8, seq: int = 32, **cfg_kw):
 # --------------------------------------------------------------------------
 # Builders.  Each returns
 # (jitted_step, example_args, budget, param_bytes, meta).
+#
+# The data-parallel family (dp / dp-zero1 / dp-int8 / dp-zero1-int8) and
+# every hierarchical multi-slice layout are SPEC-LOWERED: one generic
+# builder parses a ``tpuframe.parallel.pspec`` string, builds the
+# declared (possibly ICI×DCN) mesh, and lets ``pspec.lower`` pick the
+# step kwargs — zero1/wire-format ride as orthogonal modifiers instead
+# of four hand-copied builders.  The remaining hand-wired builders (tp,
+# pp, sp, ep, adasum, serve) keep their dedicated harnesses.
 # --------------------------------------------------------------------------
 
 
-def _build_dp(n_devices: int):
-    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
+def _spec_budget(spec, pb: int, n_devices: int, *, weight_update: str,
+                 wire_format: str, padded: int | None):
+    """The declared CommBudget for a composed spec — the same per-kind
+    ceilings the hand-wired family declared, picked by modifier; the
+    byte-exact pin lives in ``derived_budgets.json`` either way."""
+    if spec.fsdp > 1 or spec.tp > 1 or spec.ep > 1:
+        return budgets_lib.fsdp_budget(pb)
+    if weight_update == "zero1" and wire_format == "int8-block":
+        return budgets_lib.zero1_int8_budget(padded, n_devices)
+    if weight_update == "zero1":
+        return budgets_lib.zero1_budget(padded)
+    if wire_format == "int8-block":
+        return budgets_lib.dp_int8_budget(pb, n_devices)
+    return budgets_lib.dp_budget(pb)
 
-    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=n_devices))
-    _, loss_fn, tx, example, pb, _ = _lm_pieces()
-    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False)
-    return step, example, budgets_lib.dp_budget(pb), pb, _meta(mesh)
+
+def _build_from_spec(spec_text: str, n_devices: int, *,
+                     weight_update: str = "replicated",
+                     wire_format: str | None = None):
+    """Generic spec-lowered builder: ``spec_text`` (the
+    ``TPUFRAME_SPEC`` grammar) -> hierarchical mesh -> lowered step.
+    A spec whose axis product cannot fit ``n_devices`` is an
+    :class:`Unavailable` (a skip — the spec is for a different world
+    size), never a violation."""
+    import dataclasses
+
+    import jax
+
+    from tpuframe.parallel import mesh as mesh_lib, pspec
+    from tpuframe.parallel import step as step_lib
+
+    spec = pspec.parse_spec(spec_text)
+    try:
+        spec.sizes(n_devices)
+    except pspec.SpecError as e:
+        raise Unavailable(str(e)) from e
+    mesh = spec.make_mesh(devices=jax.devices()[:n_devices])
+    _, loss_fn, tx, (state, batch), pb, _ = _lm_pieces()
+    wire = wire_format or "fp"
+    padded = None
+    if weight_update == "zero1":
+        from tpuframe.parallel import zero1 as zero1_lib
+
+        n = zero1_lib.world_size(mesh, mesh_lib.batch_axes(mesh))
+        opt = jax.eval_shape(
+            lambda p: zero1_lib.init_opt_state(tx, p, n), state.params)
+        state = dataclasses.replace(state, opt_state=opt)
+        padded = zero1_lib.padded_bytes(state.params, n)
+    kwargs = pspec.lower(spec, mesh, state, weight_update=weight_update,
+                         wire_format=wire)
+    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
+                                    **kwargs)
+    budget = _spec_budget(spec, pb, n_devices, weight_update=weight_update,
+                          wire_format=wire, padded=padded)
+    shardings = kwargs.get("state_shardings")
+    return (step, (state, batch), budget, pb,
+            _meta(mesh,
+                  wire_format="int8-block" if wire == "int8-block"
+                  else "fp",
+                  declared_leaves=(_declared_leaves(state, shardings)
+                                   if shardings is not None else ())))
+
+
+def register_spec_strategy(spec_text: str, *,
+                           weight_update: str = "replicated",
+                           wire_format: str | None = None) -> str:
+    """Register a composed parallelism spec as a dynamic analysis
+    strategy.  The name is the spec's canonical spelling under a
+    ``spec:`` prefix (plus any modifiers) — stable, so its auto-derived
+    budget can be pinned in ``derived_budgets.json`` like any hand-wired
+    strategy's."""
+    import functools
+
+    from tpuframe.parallel import pspec
+
+    name = f"spec:{pspec.parse_spec(spec_text).canonical()}"
+    if weight_update != "replicated":
+        name += f"+{weight_update}"
+    if wire_format:
+        name += f"+{wire_format}"
+    STRATEGIES[name] = functools.partial(
+        _build_from_spec, spec_text, weight_update=weight_update,
+        wire_format=wire_format)
+    return name
+
+
+_warned_legacy: set = set()
+
+
+def _warn_legacy(fn_name: str, spec_text: str) -> None:
+    """Warn-once deprecation for the hand-wired DP-family constructors
+    (the ``TPUFRAME_BENCH_REMAT`` / ``quantized_mean`` alias idiom)."""
+    if fn_name in _warned_legacy:
+        return
+    _warned_legacy.add(fn_name)
+    import warnings
+
+    warnings.warn(
+        f"strategies.{fn_name} is a deprecated hand-wired constructor; "
+        f"the strategy is spec-lowered now — use the {spec_text!r} "
+        f"parallelism spec (tpuframe.parallel.pspec)",
+        DeprecationWarning, stacklevel=3)
+
+
+def _build_dp(n_devices: int):
+    _warn_legacy("_build_dp", "dp=*")
+    return _build_from_spec("dp=*", n_devices)
 
 
 def _build_zero1(n_devices: int):
-    """Plain DP with the ZeRO-1 weight-update transform: the identical
-    tiny-LM step, but the optimizer state in zero1's flat sharded layout
-    and ``weight_update="zero1"`` — the audit proves the collective swap
-    (no all-reduce above the scalar floor; reduce-scatter + all-gather at
-    exactly the pad-to-multiple byte total)."""
-    import dataclasses
-
-    import jax
-
-    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
-    from tpuframe.parallel import zero1 as zero1_lib
-
-    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=n_devices))
-    _, loss_fn, tx, (state, batch), pb, _ = _lm_pieces()
-    n = zero1_lib.world_size(mesh)
-    opt = jax.eval_shape(
-        lambda p: zero1_lib.init_opt_state(tx, p, n), state.params)
-    state = dataclasses.replace(state, opt_state=opt)
-    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
-                                    weight_update="zero1")
-    padded = zero1_lib.padded_bytes(state.params, n)
-    return (step, (state, batch), budgets_lib.zero1_budget(padded), pb,
-            _meta(mesh))
+    """Deprecated alias: plain DP with the ZeRO-1 weight-update modifier
+    (``weight_update="zero1"`` on the ``dp=*`` spec) — the audit proves
+    the collective swap (no all-reduce above the scalar floor;
+    reduce-scatter + all-gather at exactly the pad-to-multiple total)."""
+    _warn_legacy("_build_zero1", "dp=*")
+    return _build_from_spec("dp=*", n_devices, weight_update="zero1")
 
 
 def _build_dp_int8(n_devices: int):
-    """Plain DP over the int8-block wire: the identical tiny-LM step
-    with ``wire_format="int8-block"`` — grad all-reduce becomes a
-    quantized all-to-all + all-gather pair carrying s8 payloads, and the
-    budget proves the per-kind wire bytes drop ~4x vs :func:`_build_dp`
-    (within the per-block f32 scale overhead and the fp fallback for
-    sub-floor leaves)."""
-    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
-
-    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=n_devices))
-    _, loss_fn, tx, example, pb, _ = _lm_pieces()
-    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
-                                    wire_format="int8-block")
-    return (step, example, budgets_lib.dp_int8_budget(pb, n_devices), pb,
-            _meta(mesh, wire_format="int8-block"))
+    """Deprecated alias: plain DP over the int8-block wire
+    (``wire_format="int8-block"`` on the ``dp=*`` spec) — grad
+    all-reduce becomes a quantized all-to-all + all-gather pair carrying
+    s8 payloads at ~4x fewer wire bytes."""
+    _warn_legacy("_build_dp_int8", "dp=*")
+    return _build_from_spec("dp=*", n_devices, wire_format="int8-block")
 
 
 def _build_zero1_int8(n_devices: int):
-    """ZeRO-1 over the int8-block wire: quantized grad reduce-scatter
-    plus a quantized DELTA all-gather for the updated params — the
-    all-gather leg that PERF §18 charges ZeRO-1 +9% step time for on
-    BERT is exactly what this shrinks 4x."""
-    import dataclasses
-
-    import jax
-
-    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
-    from tpuframe.parallel import zero1 as zero1_lib
-
-    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=n_devices))
-    _, loss_fn, tx, (state, batch), pb, _ = _lm_pieces()
-    n = zero1_lib.world_size(mesh)
-    opt = jax.eval_shape(
-        lambda p: zero1_lib.init_opt_state(tx, p, n), state.params)
-    state = dataclasses.replace(state, opt_state=opt)
-    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
-                                    weight_update="zero1",
-                                    wire_format="int8-block")
-    padded = zero1_lib.padded_bytes(state.params, n)
-    return (step, (state, batch),
-            budgets_lib.zero1_int8_budget(padded, n_devices), pb,
-            _meta(mesh, wire_format="int8-block"))
+    """Deprecated alias: ZeRO-1 over the int8-block wire — both
+    modifiers composed on the ``dp=*`` spec."""
+    _warn_legacy("_build_zero1_int8", "dp=*")
+    return _build_from_spec("dp=*", n_devices, weight_update="zero1",
+                            wire_format="int8-block")
 
 
 def _build_fsdp(n_devices: int):
@@ -461,12 +526,24 @@ def _build_adasum(n_devices: int):
             _meta(mesh))
 
 
-#: MULTICHIP_r05.json strategy name -> builder.
+#: MULTICHIP_r05.json strategy name -> builder.  The DP family is
+#: spec-lowered (the partials below ARE the registration — the old
+#: ``_build_dp``-style constructors survive only as warn-once
+#: deprecated aliases).  ``spec:`` entries follow the
+#: :func:`register_spec_strategy` naming convention; the composed
+#: hierarchical entry is the ISSUE's acceptance case — dp×fsdp inside
+#: each slice, replicated over the DCN slice axis.
 STRATEGIES = {
-    "dp": _build_dp,
-    "dp-int8": _build_dp_int8,
-    "dp-zero1": _build_zero1,
-    "dp-zero1-int8": _build_zero1_int8,
+    "dp": functools.partial(_build_from_spec, "dp=*"),
+    "dp-int8": functools.partial(_build_from_spec, "dp=*",
+                                 wire_format="int8-block"),
+    "dp-zero1": functools.partial(_build_from_spec, "dp=*",
+                                  weight_update="zero1"),
+    "dp-zero1-int8": functools.partial(_build_from_spec, "dp=*",
+                                       weight_update="zero1",
+                                       wire_format="int8-block"),
+    "spec:dp=2,fsdp=2;slices=2": functools.partial(
+        _build_from_spec, "dp=2,fsdp=2;slices=2"),
     "resnet-fsdp": _build_fsdp,
     "lm-tensor-parallel": _build_tp,
     "lm-seq-parallel": _build_ring_sp,

@@ -45,6 +45,8 @@ Event types (see ``REQUIRED_FIELDS`` for the per-type contract):
                  (mode replicated|zero1, resolution source, shard count)
   wire_format    gradient-path collective wire format chosen for the
                  step program (format fp|int8-block, resolution source)
+  pspec          declarative parallelism spec the run's mesh was built
+                 from (canonical spec string, resolution source)
   elastic_resize world size changed across a relaunch boundary (n_from,
                  n_to, rescale policy + source, old/new batch and LR)
   run_end        final step, wall s, goodput buckets, MFU, counters,
@@ -121,6 +123,7 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "remat_policy": ("policy", "source"),
     "weight_update": ("mode", "source"),
     "wire_format": ("format", "source"),
+    "pspec": ("spec", "source"),
     "elastic_resize": ("n_from", "n_to", "policy"),
     "run_end": ("final_step", "wall_s", "goodput"),
     "trace_start": ("step", "path"),

@@ -15,9 +15,15 @@ from tpuframe.parallel.bootstrap import (  # noqa: F401
     shutdown,
 )
 from tpuframe.parallel.mesh import (  # noqa: F401
+    SLICE_AXIS,
     MeshSpec,
+    batch_axes,
     best_effort_mesh,
     make_mesh,
+)
+from tpuframe.parallel.pspec import (  # noqa: F401
+    ParallelSpec,
+    parse_spec,
 )
 from tpuframe.parallel.collectives import (  # noqa: F401
     allgather,

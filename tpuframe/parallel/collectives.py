@@ -352,7 +352,9 @@ def cross_replica_mean(tree: PyTree, mesh: Mesh | None = None) -> PyTree:
     for d in jax.devices():
         per_proc.setdefault(d.process_index, d)
     devs = [per_proc[i] for i in sorted(per_proc)]
-    pmesh = Mesh(np.asarray(devs), ("proc",))
+    # One-device-per-process host mesh for cross-process gathers — a
+    # degenerate transport detail, not a training-axis mesh.
+    pmesh = Mesh(np.asarray(devs), ("proc",))  # tf-lint: ok[TF119]
     sharding = NamedSharding(pmesh, P("proc"))
 
     def _mean(leaf):
@@ -398,7 +400,9 @@ def primary_device_put(x, sharding: NamedSharding) -> jax.Array:
     # jax.devices() order and the caller's mesh order differ; deriving both
     # sides from one order keeps the jit's input and output compatible.
     devs = list(sharding.mesh.devices.flat)
-    pmesh = Mesh(np.asarray(devs), ("bcast",))
+    # Broadcast-row host mesh in the caller's device order — transport
+    # detail, same class as the proc mesh above.
+    pmesh = Mesh(np.asarray(devs), ("bcast",))  # tf-lint: ok[TF119]
     rows = NamedSharding(pmesh, P("bcast"))
     payload_row = min(i for i, d in enumerate(devs) if d.process_index == 0)
     # One shared zero row (not a local_devices×leaf buffer): host RAM stays

@@ -44,7 +44,9 @@ def auto_mesh(mesh: Mesh) -> Mesh:
         return mesh  # pre-AxisType jax: every mesh already propagates Auto
     if all(t == AxisType.Auto for t in mesh.axis_types):
         return mesh
-    return Mesh(mesh.devices, mesh.axis_names,
+    # Axis-type-only rewrap of an existing seam-built mesh: devices and
+    # axis names pass through unchanged.
+    return Mesh(mesh.devices, mesh.axis_names,  # tf-lint: ok[TF119]
                 axis_types=(AxisType.Auto,) * len(mesh.axis_names))
 
 

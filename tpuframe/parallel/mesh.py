@@ -23,6 +23,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # model/seq innermost so their heavier collectives stay on nearest-neighbor ICI.
 AXES = ("data", "fsdp", "pipe", "seq", "expert", "model")
 
+# Multi-slice meshes carry one extra DCN axis *outside* every ICI axis: the
+# slice axis must be the slowest-varying dimension so that only collectives
+# which genuinely span slices ride the (much slower) data-center network.
+SLICE_AXIS = "slice"
+
 # The axes over which a global batch is partitioned. Batch-like arrays shard
 # over all of these; fsdp contributes to the data-parallel world size.
 BATCH_AXES = ("data", "fsdp")
@@ -30,7 +35,13 @@ BATCH_AXES = ("data", "fsdp")
 
 @dataclass(frozen=True)
 class MeshSpec:
-    """Logical parallelism degrees. -1 on ``data`` means "all remaining chips"."""
+    """Logical parallelism degrees. -1 on ``data`` means "all remaining chips".
+
+    ``slices > 1`` declares a hierarchical ICI×DCN topology: the ICI axes
+    describe one slice, and a ``slice`` axis of that size is prepended
+    outermost.  ``slices == 1`` (the default) produces the exact same mesh
+    as before the axis existed — single-slice programs see zero drift.
+    """
 
     data: int = -1
     fsdp: int = 1
@@ -38,8 +49,14 @@ class MeshSpec:
     seq: int = 1
     expert: int = 1
     model: int = 1
+    slices: int = 1
+
+    def axis_names(self) -> tuple[str, ...]:
+        return (SLICE_AXIS, *AXES) if self.slices > 1 else AXES
 
     def sizes(self, n_devices: int) -> dict[str, int]:
+        if self.slices < 1:
+            raise ValueError(f"slices must be >= 1, got {self.slices}")
         sizes = {
             "data": self.data,
             "fsdp": self.fsdp,
@@ -48,6 +65,8 @@ class MeshSpec:
             "expert": self.expert,
             "model": self.model,
         }
+        if self.slices > 1:
+            sizes = {SLICE_AXIS: self.slices, **sizes}
         fixed = int(np.prod([v for v in sizes.values() if v != -1]))
         n_wild = sum(1 for v in sizes.values() if v == -1)
         if n_wild > 1:
@@ -83,13 +102,14 @@ def make_mesh(
     all_devices = jax.devices()
     devices = devices if devices is not None else all_devices
     sizes = spec.sizes(len(devices))
-    shape = tuple(sizes[a] for a in AXES)
+    axes = spec.axis_names()
+    shape = tuple(sizes[a] for a in axes)
     if [d.id for d in devices] == [d.id for d in all_devices]:
         # Full-device meshes go through jax.make_mesh, which reorders devices
         # to match the physical ICI torus on real TPU slices.
-        return jax.make_mesh(shape, AXES, devices=devices)
+        return jax.make_mesh(shape, axes, devices=devices)
     dev_array = np.asarray(devices).reshape(shape)
-    return Mesh(dev_array, AXES)
+    return Mesh(dev_array, axes)
 
 
 def best_effort_mesh(max_devices: int | None = None) -> Mesh:
@@ -100,13 +120,25 @@ def best_effort_mesh(max_devices: int | None = None) -> Mesh:
     return make_mesh(MeshSpec(data=len(devices)), devices=devices)
 
 
+def batch_axes(mesh: Mesh | None = None) -> tuple[str, ...]:
+    """The axes a global batch shards over, for this mesh's topology.
+
+    On a hierarchical mesh the slice axis is batch-like too — each slice
+    works on its own shard of the batch and only gradients cross DCN — so
+    it joins ``data``/``fsdp`` (outermost, matching mesh axis order).
+    """
+    if mesh is not None and SLICE_AXIS in mesh.shape:
+        return (SLICE_AXIS, *BATCH_AXES)
+    return BATCH_AXES
+
+
 def data_parallel_size(mesh: Mesh) -> int:
-    return int(np.prod([mesh.shape[a] for a in BATCH_AXES]))
+    return int(np.prod([mesh.shape[a] for a in batch_axes(mesh)]))
 
 
-def batch_spec(extra: tuple = ()) -> P:
+def batch_spec(extra: tuple = (), *, mesh: Mesh | None = None) -> P:
     """PartitionSpec for batch-major arrays: leading dim over the batch axes."""
-    return P(BATCH_AXES, *extra)
+    return P(batch_axes(mesh), *extra)
 
 
 def replicated_spec() -> P:
@@ -114,7 +146,7 @@ def replicated_spec() -> P:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, batch_spec())
+    return NamedSharding(mesh, batch_spec(mesh=mesh))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

@@ -456,10 +456,10 @@ def make_train_step(
     # outputs are single-valued across those axes.  Sequence-parallel configs
     # extend both: the batch is additionally sharded along its seq dim and the
     # loss mean spans the seq axis too.
-    axes = reduce_axes if reduce_axes is not None else mesh_lib.BATCH_AXES
+    axes = reduce_axes if reduce_axes is not None else mesh_lib.batch_axes(mesh)
     repl = NamedSharding(mesh, P())
     batch_part = (batch_partition if batch_partition is not None
-                  else mesh_lib.batch_spec())
+                  else mesh_lib.batch_spec(mesh=mesh))
     batch_sh = NamedSharding(mesh, batch_part)
 
     if state_shardings is not None:
@@ -539,9 +539,9 @@ def make_eval_step(
     if mesh is None:
         return jax.jit(lambda s, b: metric_fn(s.params, s.model_state, b))
 
-    axes = reduce_axes if reduce_axes is not None else mesh_lib.BATCH_AXES
+    axes = reduce_axes if reduce_axes is not None else mesh_lib.batch_axes(mesh)
     batch_part = (batch_partition if batch_partition is not None
-                  else mesh_lib.batch_spec())
+                  else mesh_lib.batch_spec(mesh=mesh))
 
     if state_shardings is not None:
         # Auto-SPMD eval against fsdp-sharded state (shard_map would demand a

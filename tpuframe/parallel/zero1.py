@@ -155,8 +155,12 @@ def padded_len(size: int, n: int) -> int:
     return _padded(int(size), int(n))
 
 
-def world_size(mesh: Mesh, axes=mesh_lib.BATCH_AXES) -> int:
-    """Number of weight-update shards: the product of ``axes`` sizes."""
+def world_size(mesh: Mesh, axes=None) -> int:
+    """Number of weight-update shards: the product of ``axes`` sizes.
+    The default is the mesh's own data-parallel axes (slice-aware: on a
+    hierarchical multi-slice mesh the DCN ``slice`` axis shards too)."""
+    if axes is None:
+        axes = mesh_lib.batch_axes(mesh)
     return int(np.prod([mesh.shape[a] for a in axes if a in mesh.shape]))
 
 
@@ -270,16 +274,20 @@ def check_state_layout(state, n: int):
 
 
 def make_state(params: PyTree, tx: optax.GradientTransformation,
-               mesh: Mesh | None = None, *, axes=mesh_lib.BATCH_AXES,
+               mesh: Mesh | None = None, *, axes=None,
                model_state: PyTree | None = None,
                rng: jax.Array | None = None):
     """``TrainState.create`` twin for the zero1 path: the optimizer state
     is created directly in the sharded layout — with a mesh, a jitted
     init with sharded ``out_shardings`` so the ``[padded]`` moments are
     born distributed and no replicated copy ever exists; params/step/rng/
-    model_state are placed replicated (ZeRO-1 keeps them so)."""
+    model_state are placed replicated (ZeRO-1 keeps them so).  ``axes``
+    defaults to the mesh's own data-parallel axes (slice-aware)."""
     from tpuframe.parallel import step as step_lib
 
+    if axes is None:
+        axes = mesh_lib.BATCH_AXES if mesh is None \
+            else mesh_lib.batch_axes(mesh)
     n = world_size(mesh, axes) if mesh is not None else 1
     if mesh is None:
         opt = init_opt_state(tx, params, n)

@@ -109,14 +109,25 @@ def _is_lm_task(cfg: TrainConfig) -> bool:
     return cfg.dataset == "lm_text"
 
 
+def _cfg_batch_axes(cfg: TrainConfig) -> tuple:
+    """The config's data-parallel mesh axes — slice-aware: a multi-slice
+    MeshSpec replicates data over the DCN ``slice`` axis too, so batch
+    partitions and loss means must range over it (the mesh-aware
+    ``mesh_lib.batch_axes`` twin, derivable before the mesh exists)."""
+    if getattr(cfg.mesh, "slices", 1) > 1:
+        return (mesh_lib.SLICE_AXIS, *mesh_lib.BATCH_AXES)
+    return mesh_lib.BATCH_AXES
+
+
 def _batch_layout(cfg: TrainConfig):
     """(loader partition, step batch_partition, reduce axes) for the config.
     Sequence-parallel configs shard the batch's seq dim and extend the loss
     mean over the seq axis; everything else uses the pure batch layout."""
     from jax.sharding import PartitionSpec as P
     if cfg.shard_seq:
-        part = P(mesh_lib.BATCH_AXES, "seq")
-        return part, part, (*mesh_lib.BATCH_AXES, "seq")
+        axes = _cfg_batch_axes(cfg)
+        part = P(axes, "seq")
+        return part, part, (*axes, "seq")
     return None, None, None
 
 
@@ -143,6 +154,10 @@ class Harness:
     # (format, resolution source) from tpuframe.parallel.quantwire.resolve
     # — ("fp", "default") when nothing elected a quantized wire.
     wire_format: tuple = ("fp", "default")
+    # (canonical spec string, resolution source) from
+    # tpuframe.parallel.pspec.resolve — (None, "default") when the mesh
+    # came from the config rather than a TPUFRAME_SPEC declaration.
+    pspec: tuple = (None, "default")
     # Full provenance of an elastic n→n′ resize detected at build time
     # (committed checkpoint world ≠ current world), or None.  Emitted as
     # the typed ``elastic_resize`` run event.
@@ -151,6 +166,19 @@ class Harness:
 
 def build_harness(cfg: TrainConfig) -> Harness:
     bootstrap.initialize()
+    # Declarative parallelism spec: a TPUFRAME_SPEC declaration
+    # ("dp=4,fsdp=2;slices=2") wins over the config's mesh — one string
+    # names the whole hierarchical ICI×DCN layout, and the MeshSpec it
+    # lowers to flows through every seam below (world resolution,
+    # sharded-state detection, batch axes) unchanged.
+    from tpuframe.parallel import pspec as pspec_lib
+
+    spec, spec_source = pspec_lib.resolve()
+    if spec is not None:
+        cfg = cfg.with_overrides(mesh=spec.mesh_spec())
+        if bootstrap.is_primary():
+            print(f"[tpuframe] parallelism spec '{spec.canonical()}' "
+                  f"({spec_source}) -> mesh {cfg.mesh}", flush=True)
     # World resolution goes through the elastic resolver — the single
     # source of truth train.py and bench.py share, read at call time so a
     # relaunch at a new world size can never see a stale capture.
@@ -441,6 +469,8 @@ def build_harness(cfg: TrainConfig) -> Harness:
                    remat_policy=(remat_policy, remat_source),
                    weight_update=(weight_update, wu_source),
                    wire_format=(wire_format, wf_source),
+                   pspec=(spec.canonical() if spec is not None else None,
+                          spec_source),
                    elastic_resize=elastic_resize)
 
 
@@ -452,8 +482,8 @@ def _lm_reduce_axis(cfg: TrainConfig, *, for_grad: bool):
     gradients themselves — a psum inside the loss would mis-scale them —
     so the gradient-side global mean only applies in the default implicit
     mode, and the biased combination is refused outright."""
-    axes = ((*mesh_lib.BATCH_AXES, "seq") if cfg.shard_seq
-            else mesh_lib.BATCH_AXES)
+    axes = ((*_cfg_batch_axes(cfg), "seq") if cfg.shard_seq
+            else _cfg_batch_axes(cfg))
     if not for_grad:
         return axes  # eval metrics have no explicit-reduction mode
     # The local-loss requirement only exists where make_train_step actually
@@ -1018,6 +1048,13 @@ def _train_impl(cfg: TrainConfig, *, trace_dir: str | None = None,
         # the predicted byte drop landed.
         events_lib.emit("wire_format", format=h.wire_format[0],
                         source=h.wire_format[1])
+        # Parallelism-spec provenance: which declarative spec (if any)
+        # the run's mesh was lowered from and who elected it — joins
+        # the run manifest's mesh dict to the TPUFRAME_SPEC grammar, so
+        # the analyzer can tie ICI/DCN comm attribution back to the
+        # declared hierarchical layout.
+        if h.pspec[0] is not None:
+            events_lib.emit("pspec", spec=h.pspec[0], source=h.pspec[1])
         # Elastic resize provenance: the world changed across the attempt
         # boundary.  n_from/n_to, the declared rescale policy and the
         # exact batch/LR transition, as one typed record — the obs

@@ -37,6 +37,7 @@ class Hardware:
     hbm_bytes_per_s: float  # peak HBM bandwidth, bytes/s
     hbm_capacity_bytes: float  # usable HBM per chip, bytes
     ici_bytes_per_s: float = 0.0  # aggregate ICI bandwidth per chip, bytes/s
+    dcn_bytes_per_s: float = 0.0  # per-chip DCN share for cross-slice hops
 
 
 # Sources: v5e column = PERF.md §2 (197e12 / 0.81e12 / 15.75 GB, the values
@@ -46,11 +47,18 @@ class Hardware:
 # system specs; only the v5e row is pinned by recorded measurements here).
 # ICI column: aggregate interchip bandwidth per chip from the same public
 # specs — v4 2400 Gbps, v5e 1600 Gbps, v5p 4800 Gbps, v6e 3584 Gbps.
+# DCN column: a cross-slice collective leaves the ICI torus through the
+# hosts' datacenter NICs — modeled as one 200 Gbps NIC shared by a
+# 4-chip host, i.e. 6.25 GB/s per chip, for every generation.  That is
+# an ASSUMPTION (no multislice measurement exists in this repo yet —
+# PERF.md §23); the check_tables DCN anchor pins it so it cannot move
+# silently, and the 32x ICI:DCN ratio on v5e is the whole reason the
+# slice axis must carry the lightest collectives.
 HARDWARE = {
-    "v4": Hardware("v4", 275e12, 1.23e12, 32.0 * 1e9, 300e9),
-    "v5e": Hardware("v5e", 197e12, 0.81e12, 15.75 * 1e9, 200e9),
-    "v5p": Hardware("v5p", 459e12, 2.76e12, 95.0 * 1e9, 600e9),
-    "v6e": Hardware("v6e", 918e12, 1.64e12, 32.0 * 1e9, 448e9),
+    "v4": Hardware("v4", 275e12, 1.23e12, 32.0 * 1e9, 300e9, 6.25e9),
+    "v5e": Hardware("v5e", 197e12, 0.81e12, 15.75 * 1e9, 200e9, 6.25e9),
+    "v5p": Hardware("v5p", 459e12, 2.76e12, 95.0 * 1e9, 600e9, 6.25e9),
+    "v6e": Hardware("v6e", 918e12, 1.64e12, 32.0 * 1e9, 448e9, 6.25e9),
 }
 
 
@@ -191,6 +199,21 @@ def comm_ms(generation: str, kind: str, nbytes: float,
     return factor * scale * float(nbytes) / hw.ici_bytes_per_s * 1e3
 
 
+def dcn_ms(generation: str, kind: str, nbytes: float,
+           n_slices: int) -> float:
+    """Predicted DCN milliseconds for one cross-slice collective: the
+    same ring model as :func:`comm_ms` but over the slice count and the
+    per-chip DCN share — ``factor * (s-1)/s * bytes / dcn_bw``.  Like
+    the ICI model, ``nbytes`` is the op's bytes as parsed from the
+    compiled HLO (quantized wires count their actual payload)."""
+    hw = get_hardware(generation)
+    if hw.dcn_bytes_per_s <= 0 or n_slices <= 1:
+        return 0.0
+    factor = _COMM_RING_FACTORS.get(kind, 1.0)
+    scale = (n_slices - 1) / n_slices
+    return factor * scale * float(nbytes) / hw.dcn_bytes_per_s * 1e3
+
+
 def hbm_ms(generation: str, nbytes: float) -> float:
     """Predicted HBM milliseconds to stream ``nbytes`` on one chip — the
     same bandwidth roofline as :func:`score`'s ``t_hbm_ms``, exposed per
@@ -224,6 +247,40 @@ def comm_score(generation: str, report, n_devices: int) -> dict:
         "rows": rows,
         "comm_bytes": int(sum(r["bytes"] for r in rows)),
         "t_ici_ms": round(sum(r["t_ici_ms"] for r in rows), 4),
+    }
+
+
+def comm_split_score(generation: str, split: dict, *, n_devices: int,
+                     n_slices: int) -> dict:
+    """Per-kind predicted comm rows with the wire attributed to its
+    fabric: ``split`` is shardflow's ICI/DCN byte attribution
+    (``{"ici": {kind: bytes}, "dcn": {kind: bytes}}`` — a collective
+    whose replica groups span slices is charged to DCN).  ICI rows are
+    priced over the full device ring, DCN rows over the slice ring and
+    the per-chip DCN share; on v5e the ~32x bandwidth gap between the
+    two columns is the multi-slice placement signal."""
+    rows = []
+    for fabric, priced in (("ici", lambda k, b: comm_ms(
+            generation, k, b, n_devices)),
+                           ("dcn", lambda k, b: dcn_ms(
+            generation, k, b, n_slices))):
+        for kind, nbytes in sorted((split.get(fabric) or {}).items()):
+            rows.append({"fabric": fabric, "kind": kind,
+                         "bytes": int(nbytes),
+                         "t_ms": round(priced(kind, nbytes), 4)})
+    ici_ms = sum(r["t_ms"] for r in rows if r["fabric"] == "ici")
+    dcn_ms_total = sum(r["t_ms"] for r in rows if r["fabric"] == "dcn")
+    return {
+        "generation": get_hardware(generation).generation,
+        "n_devices": int(n_devices),
+        "n_slices": int(n_slices),
+        "rows": rows,
+        "ici_bytes": int(sum(r["bytes"] for r in rows
+                             if r["fabric"] == "ici")),
+        "dcn_bytes": int(sum(r["bytes"] for r in rows
+                             if r["fabric"] == "dcn")),
+        "t_ici_ms": round(ici_ms, 4),
+        "t_dcn_ms": round(dcn_ms_total, 4),
     }
 
 
@@ -298,6 +355,25 @@ def check_tables() -> list:
     if abs(t_s8 * 4 - t_f32) > 1e-9:
         problems.append("comm model is not linear in wire bytes — "
                         "int8 prediction must be f32/4")
+    # DCN anchor (mirrors the ICI one): the same 102.23 MB grad
+    # all-reduce crossing 2 slices is 2 * 1/2 * 1.0223e8 / 6.25e9 =
+    # 16.357 ms — ~21x the 4-chip ICI ring, which is the whole point of
+    # attributing the split.  Linearity in bytes is pinned too, so the
+    # dcn_bytes_per_s table cannot silently regress shape.
+    for gen, hw in sorted(HARDWARE.items()):
+        if not hw.dcn_bytes_per_s > 0:
+            problems.append(f"hardware table {gen}: non-positive DCN peak")
+        elif hw.dcn_bytes_per_s >= hw.ici_bytes_per_s:
+            problems.append(f"hardware table {gen}: DCN share >= ICI peak "
+                            f"— the fabrics are swapped")
+    t_dcn = dcn_ms("v5e", "all-reduce", 1.0223e8, 2)
+    if abs(t_dcn - 16.357) > 0.01:
+        problems.append(f"v5e DCN anchor drifted: {t_dcn:.4f} != 16.357 ms")
+    if abs(dcn_ms("v5e", "all-reduce", 2 * 1.0223e8, 2) - 2 * t_dcn) > 1e-9:
+        problems.append("DCN model is not linear in wire bytes")
+    if dcn_ms("v5e", "all-reduce", 1.0223e8, 1) != 0.0:
+        problems.append("DCN model must price a single-slice program at "
+                        "exactly zero — there is no cross-slice wire")
     # hbm_ms must be the same ruler as score()'s t_hbm_ms — the overlap
     # scorer prices interleavable compute with it, and a divergence would
     # let the two rooflines disagree about the identical byte count.

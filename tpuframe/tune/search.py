@@ -199,7 +199,9 @@ def _fa_compile(topo_devices, seq, head_dim, heads, batch, bq, bk):
 
     from tpuframe.ops import flash_attention as fa
 
-    mesh = Mesh(np.array(topo_devices[:1]), ("d",))
+    # Single-device topology probe, not a training mesh — no axis-name
+    # contract to honour.
+    mesh = Mesh(np.array(topo_devices[:1]), ("d",))  # tf-lint: ok[TF119]
     repl = NamedSharding(mesh, P())
     x = jax.ShapeDtypeStruct((batch, seq, heads, head_dim), jnp.bfloat16,
                              sharding=repl)
