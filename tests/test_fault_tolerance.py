@@ -35,7 +35,7 @@ def test_crash_and_resume(tmp_path):
     """Hard-kill (os._exit, no cleanup) at step 13; the restarted job must
     resume from the last committed checkpoint (step 10) and finish — the
     slice-restart recovery model (SURVEY.md §5.3)."""
-    crashed = _run_train(tmp_path, {"TPUFRAME_FAULT_STEP": "13"})
+    crashed = _run_train(tmp_path, {"TPUFRAME_FAULTS": "host:step=13:kind=crash"})
     assert crashed.returncode == 42, crashed.stderr[-1500:]
     assert "FAULT INJECTION" in crashed.stdout
     # checkpoints 5 and 10 committed; nothing at 13
@@ -53,7 +53,7 @@ def test_crash_and_resume(tmp_path):
 def test_resumed_loss_matches_straight_run(tmp_path):
     straight = _run_train(tmp_path / "a")
     assert straight.returncode == 0, straight.stderr[-1500:]
-    crashed = _run_train(tmp_path / "b", {"TPUFRAME_FAULT_STEP": "13"})
+    crashed = _run_train(tmp_path / "b", {"TPUFRAME_FAULTS": "host:step=13:kind=crash"})
     assert crashed.returncode == 42
     resumed = _run_train(tmp_path / "b")
     assert resumed.returncode == 0, resumed.stderr[-1500:]

@@ -215,7 +215,7 @@ def test_launch_cli_relaunch_resumes_crashed_job(tmp_path):
     import subprocess
 
     env = dict(os.environ)
-    env.update({"TPUFRAME_FAULT_STEP": "6", "TPUFRAME_FAULT_ONCE": "1"})
+    env.update({"TPUFRAME_FAULTS": "host:step=6:kind=crash:once=1"})
     proc = subprocess.run(
         [sys.executable, "-m", "tpuframe.launch", "local",
          "--nprocs", "2", "--devices", "2", "--relaunch", "1", "--",
@@ -323,7 +323,7 @@ def test_pod_config_multihost_kill_and_reshard_resume(tmp_path):
     # step-4 checkpoint committed).
     with pytest.raises(RuntimeError, match="exit 42"):
         LocalCluster(4, 2, timeout=600,
-                     extra_env={"TPUFRAME_FAULT_STEP": "6"}).launch(argv)
+                     extra_env={"TPUFRAME_FAULTS": "host:step=6:kind=crash"}).launch(argv)
     committed = sorted(p.name for p in (tmp_path / "ck").iterdir()
                        if p.is_dir() and (p / "COMMIT").exists())
     assert "step_00000004" in committed, committed

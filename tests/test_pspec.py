@@ -200,6 +200,47 @@ class TestLower:
         with pytest.raises(pspec.SpecError, match="spec.make_mesh"):
             pspec.lower(spec, mesh8)  # mesh8 is data=8, fsdp=1
 
+    def test_sp_lowering_widens_reduction_over_seq(self):
+        spec = pspec.parse_spec("dp=4,sp=2")
+        mesh = spec.make_mesh()
+        kw = pspec.lower(spec, mesh)
+        assert kw["reduce_axes"] == (*mesh_lib.batch_axes(mesh), "seq")
+        assert kw["batch_partition"] == P(mesh_lib.batch_axes(mesh),
+                                          "seq")
+
+    def test_sp_refuses_shard_map_modifiers(self):
+        spec = pspec.parse_spec("dp=4,sp=2")
+        mesh = spec.make_mesh()
+        for kw in ({"weight_update": "zero1"},
+                   {"wire_format": "int8-block"},
+                   {"fusion_threshold": 1 << 20},
+                   {"grad_reduce": "adasum"}):
+            with pytest.raises(pspec.SpecError, match="do not compose"):
+                pspec.lower(spec, mesh, **kw)
+
+    def test_tp_requires_rules(self):
+        spec = pspec.parse_spec("dp=2,tp=4")
+        mesh = spec.make_mesh()
+        with pytest.raises(pspec.SpecError, match="tp_rules"):
+            pspec.lower(spec, mesh, _tiny_lm_state(optax.adamw(1e-3)))
+
+    def test_adasum_is_exclusive_but_lowers_alone(self):
+        spec = pspec.parse_spec("dp=8")
+        mesh = spec.make_mesh()
+        with pytest.raises(pspec.SpecError, match="adasum"):
+            pspec.lower(spec, mesh, weight_update="zero1",
+                        grad_reduce="adasum")
+        kw = pspec.lower(spec, mesh, grad_reduce="adasum")
+        assert kw["grad_reduce"] == "adasum"
+
+    def test_lower_pp_validates_before_delegating(self):
+        nopp = pspec.parse_spec("dp=8")
+        with pytest.raises(pspec.SpecError, match="pp > 1"):
+            pspec.lower_pp(nopp, nopp.make_mesh(), None, None)
+        comp = pspec.parse_spec("dp=2,tp=2,pp=2")
+        with pytest.raises(pspec.SpecError, match="dp only"):
+            pspec.lower_pp(comp, comp.make_mesh(), None, None)
+
 
 # ----------------------------------------------------------------------
 # golden-loss equivalence: spec-lowered vs hand-wired, 3 strategies
@@ -509,6 +550,53 @@ class TestTF119:
 
 
 # ----------------------------------------------------------------------
+# TF120: the strategy-registration seam lint
+# ----------------------------------------------------------------------
+
+class TestTF120:
+    META = ("from tpuframe.analysis.strategies import StrategyMeta\n"
+            "m = StrategyMeta(name='mine')\n")
+
+    def _lint(self, src, path):
+        return [f for f in source_lint.lint_source(src, path)
+                if f.rule == "TF120"]
+
+    def test_hand_built_meta_flagged(self):
+        assert len(self._lint(self.META, "tpuframe/train.py")) == 1
+
+    def test_registry_subscript_write_flagged(self):
+        src = ("from tpuframe.analysis import strategies\n"
+               "strategies.STRATEGIES['mine'] = build\n")
+        assert len(self._lint(src, "tpuframe/bench.py")) == 1
+
+    def test_registry_update_flagged(self):
+        for call in ("STRATEGIES.update({'mine': build})",
+                     "strategies.STRATEGIES.setdefault('mine', build)"):
+            assert len(self._lint(call + "\n", "tpuframe/bench.py")) == 1
+
+    def test_strategy_seam_exempt(self):
+        assert self._lint(self.META,
+                          "tpuframe/analysis/strategies.py") == []
+
+    def test_reading_the_registry_is_fine(self):
+        src = ("from tpuframe.analysis import strategies\n"
+               "b = strategies.STRATEGIES['dp']\n"
+               "names = list(strategies.STRATEGIES)\n")
+        assert self._lint(src, "tpuframe/bench.py") == []
+
+    def test_suppression_honoured(self):
+        src = "m = StrategyMeta(name='x')  # tf-lint: ok[TF120]\n"
+        assert self._lint(src, "tpuframe/train.py") == []
+
+    def test_tree_is_clean(self):
+        from pathlib import Path
+
+        findings = [f for f in source_lint.lint_paths(
+            [Path("tpuframe")]) if f.rule == "TF120"]
+        assert findings == [], "\n".join(map(str, findings))
+
+
+# ----------------------------------------------------------------------
 # spec-lowered registration surface: aliases warn once, event registered
 # ----------------------------------------------------------------------
 
@@ -540,3 +628,18 @@ class TestRegistration:
         from tpuframe.obs import events
 
         assert events.REQUIRED_FIELDS["pspec"] == ("spec", "source")
+
+    def test_every_training_strategy_is_spec_lowered(self):
+        """Tentpole acceptance: zero hand-wired training builders.  Every
+        training entry in the registry is a partial over
+        _build_from_spec with a spec string; serve-dp-decode is the one
+        decode program (not a training parallelism, documented in the
+        registry)."""
+        import functools
+
+        for name, builder in strategies.STRATEGIES.items():
+            if name == "serve-dp-decode":
+                continue
+            assert isinstance(builder, functools.partial), name
+            assert builder.func is strategies._build_from_spec, name
+            assert builder.args and isinstance(builder.args[0], str), name

@@ -1,6 +1,6 @@
 """int8-quantized gradient reduction (quantwire.all_reduce_mean +
-hvd.DistributedOptimizer(compression="int8") + the deprecated
-collectives.quantized_mean alias) — the EQuARX-style wire format
+hvd.DistributedOptimizer(compression="int8"); the removed
+collectives.quantized_mean alias must raise) — the EQuARX-style wire format
 (SURVEY.md §3b ring-allreduce row; PAPERS.md:7; arXiv:2506.17615).
 
 Uses the legacy ``jax.experimental.shard_map`` idiom with
@@ -8,7 +8,6 @@ Uses the legacy ``jax.experimental.shard_map`` idiom with
 closed over and varied per replica via ``lax.axis_index``.
 """
 
-import warnings
 
 import jax
 import jax.numpy as jnp
@@ -131,24 +130,15 @@ def test_quantized_narrow_int_on_the_wire(mesh8):
         "payload-sized f32 all-reduce still present"
 
 
-def test_deprecated_alias_warns_and_matches(mesh8):
-    """collectives.quantized_mean is a warn-once alias over quantwire —
-    exactly one quantization implementation in the tree."""
-    collectives._QUANTIZED_MEAN_WARNED = False
-    tree = {"g": jnp.asarray(
-        np.random.default_rng(5).normal(size=(2048,)), jnp.float32)}
-    with warnings.catch_warnings(record=True) as w:
-        warnings.simplefilter("always")
-        out = _per_replica(
-            mesh8, lambda t: collectives.quantized_mean(t, axis="data"),
-            tree)
-        assert any(issubclass(x.category, DeprecationWarning) for x in w)
-    ref = _per_replica(
-        mesh8,
-        lambda t: quantwire.all_reduce_mean(t, ("data",), min_elems=0),
-        tree)
-    np.testing.assert_allclose(np.asarray(out["g"]), np.asarray(ref["g"]),
-                               atol=1e-6)
+def test_removed_alias_raises_with_replacement():
+    """collectives.quantized_mean is gone — the error must name the one
+    remaining quantization seam so a stale call site self-documents its
+    own migration."""
+    tree = {"g": jnp.zeros((8,), jnp.float32)}
+    with pytest.raises(RuntimeError, match="quantwire.all_reduce_mean"):
+        collectives.quantized_mean(tree, axis="data")
+    with pytest.raises(RuntimeError, match="TPUFRAME_WIRE_FORMAT"):
+        collectives.quantized_mean(tree, axis="data")
 
 
 def test_distributed_optimizer_int8_trains(mesh8):

@@ -169,12 +169,23 @@ class TestFaultSpec:
         with pytest.raises(ValueError, match="unknown fault option"):
             faults.parse("gcs_read:when=later")
 
-    def test_legacy_env_compiles_to_host_crash(self, capsys):
+    def test_removed_legacy_env_raises_with_spelling(self):
+        """The pre-grammar aliases are gone — setting one must raise with
+        the exact TPUFRAME_FAULTS spelling, never be silently ignored (a
+        fault the operator thinks is armed but never fires turns every
+        resilience proof downstream into a false pass)."""
+        with pytest.raises(RuntimeError,
+                           match=r"host:step=7:kind=crash:once=1"):
+            faults.reset_from_env(
+                {"TPUFRAME_FAULT_STEP": "7", "TPUFRAME_FAULT_ONCE": "1"})
+        with pytest.raises(RuntimeError, match="TPUFRAME_FAULT_ONCE"):
+            faults.reset_from_env({"TPUFRAME_FAULT_ONCE": "1"})
+        # the modern spelling of the same fault still arms and still
+        # honours the once=1 resumed-run drop
         reg = faults.reset_from_env(
-            {"TPUFRAME_FAULT_STEP": "7", "TPUFRAME_FAULT_ONCE": "1"})
+            {"TPUFRAME_FAULTS": "host:step=7:kind=crash:once=1"})
         f = reg.faults[-1]
         assert (f.seam, f.kind, f.step, f.once) == ("host", "crash", 7, True)
-        # once=1 faults are dropped on a resumed run
         reg.set_resumed(True)
         assert reg.faults == []
 

@@ -527,3 +527,170 @@ class TestShippedDB:
         assert len(fa) >= 4
         bench = db.records(family="bench_resnet50")
         assert len({r.config.get("opts_name") for r in bench}) >= 2
+
+
+# ---------------------------------------------------------------------------
+# tune plan: the static auto-parallelism planner
+# ---------------------------------------------------------------------------
+
+from tpuframe.tune import plan  # noqa: E402
+
+
+def _plan_row(name, spec, total, comm, **over):
+    r = {"name": name, "spec": spec, "slices": 1, "n_devices": 4,
+         "compile_topology": "v5e:2x2", "config": {}, "status": "ok",
+         "detector_problems": [], "budget_findings": [],
+         "predicted_step_ms": round(total - 0.001, 6), "t_ici_ms": 0.001,
+         "t_dcn_ms": 0.0, "ici_bytes": comm, "dcn_bytes": 0,
+         "comm_bytes": comm, "predicted_total_ms": total,
+         "overlap_potential": 0.5, "bound": "hbm", "fits": True,
+         "peak_memory_bytes": 1 << 20}
+    r.update(over)
+    return r
+
+
+def _plan_report():
+    """A synthetic report exercising ranking, admissibility and all three
+    pinned verdicts — shaped exactly like a real `tune plan` emission."""
+    rows = [
+        _plan_row("spec:dp=*", "dp=*", 0.03, 300),
+        _plan_row("spec:dp=*+zero1", "dp=*", 0.04, 600),
+        _plan_row("spec:dp=*+int8-block", "dp=*", 0.05, 200),
+        _plan_row("spec:dp=2,fsdp=2;slices=2", "dp=2,fsdp=2;slices=2",
+                  0.06, 1000, slices=2, n_devices=8, t_ici_ms=0.004,
+                  t_dcn_ms=0.025, ici_bytes=800, dcn_bytes=200),
+        _plan_row("spec:dp=*,tp=2", "dp=*,tp=2", 0.01, 10,
+                  status="inadmissible",
+                  detector_problems=["seeded structural finding"]),
+    ]
+    ranking = plan.rank_rows(rows)
+    return {"schema": plan.PLAN_SCHEMA, "jax": plan._jax_version(),
+            "topology": "v5e:2x2", "generation": "v5e",
+            "objective": "step + wire", "slice_counts": [1, 2],
+            "candidates": rows, "skips": [], "ranking": ranking,
+            "winner": rows[0], "verdicts": plan.compute_verdicts(rows)}
+
+
+class TestPlanner:
+    def test_scaled_topology(self):
+        assert plan._scaled_topology("v5e:2x2", 1) == "v5e:2x2"
+        assert plan._scaled_topology("v5e:2x2", 2) == "v5e:2x4"
+        assert plan._scaled_topology("v4:2x2x2", 4) == "v4:2x2x8"
+
+    def test_rank_rows_excludes_inadmissible_and_is_total(self):
+        rows = _plan_report()["candidates"]
+        ranking = plan.rank_rows(rows)
+        assert ranking[0] == "spec:dp=*"          # lowest admissible total
+        assert "spec:dp=*,tp=2" not in ranking    # 0.01 ms but flagged
+        assert ranking == plan.rank_rows(list(reversed(rows)))
+
+    def test_verdicts_hold_on_synthetic_rows(self):
+        v = plan.compute_verdicts(_plan_report()["candidates"])
+        assert v["zero1_bytes"]["holds"] is True       # 300 < 600
+        assert v["wire_bytes"]["holds"] is True        # 0.03 < 0.05 totals
+        assert v["dcn_split"]["holds"] is True         # 0.025>0.004, 200<800
+        # missing rows degrade to holds=None, never a crash
+        assert plan.compute_verdicts([])["zero1_bytes"]["holds"] is None
+
+    def test_check_clean_then_catches_tampering(self, tmp_path):
+        import copy as copy_lib
+
+        path = str(tmp_path / "plan_report.json")
+        report = _plan_report()
+        with open(path, "w") as f:
+            json.dump(report, f)
+        assert plan.check(path) == []
+
+        tampered = copy_lib.deepcopy(report)
+        tampered["ranking"] = list(reversed(tampered["ranking"]))
+        with open(path, "w") as f:
+            json.dump(tampered, f)
+        assert any("ranking drift" in p for p in plan.check(path))
+
+        tampered = copy_lib.deepcopy(report)
+        tampered["verdicts"]["zero1_bytes"]["holds"] = False
+        with open(path, "w") as f:
+            json.dump(tampered, f)
+        assert any("disagree" in p for p in plan.check(path))
+
+    def test_check_flags_verdict_that_stopped_holding(self, tmp_path):
+        """A verdict that re-derives to holds=False is a FINDING — the
+        rows contradict the pinned PERF direction."""
+        report = _plan_report()
+        for r in report["candidates"]:
+            if r["name"] == "spec:dp=*+zero1":
+                r["comm_bytes"] = 100      # now dp moves MORE bytes
+        report["verdicts"] = plan.compute_verdicts(report["candidates"])
+        report["ranking"] = plan.rank_rows(report["candidates"])
+        report["winner"] = next(r for r in report["candidates"]
+                                if r["name"] == report["ranking"][0])
+        path = str(tmp_path / "plan_report.json")
+        with open(path, "w") as f:
+            json.dump(report, f)
+        assert any("does NOT hold" in p for p in plan.check(path))
+
+    def test_seeded_ranking_positive(self):
+        report = _plan_report()
+        assert plan._seeded_ranking_positive(report) == []
+        thin = dict(report, candidates=report["candidates"][:1],
+                    ranking=report["ranking"][:1])
+        assert any("cross-checked" in p
+                   for p in plan._seeded_ranking_positive(thin))
+
+    def test_version_skew_skips(self, tmp_path):
+        report = _plan_report()
+        report["jax"] = "0.0.0-some-other-jax"
+        path = str(tmp_path / "plan_report.json")
+        with open(path, "w") as f:
+            json.dump(report, f)
+        assert plan.check(path) == []
+
+    def test_missing_report_is_a_finding(self, tmp_path):
+        problems = plan.check(str(tmp_path / "nope.json"))
+        assert any("tune plan" in p for p in problems)
+
+    def test_shipped_report_passes_check(self):
+        """The committed plan report must stay re-derivable — the same
+        leg the analysis gate runs."""
+        path = plan.default_report_path()
+        if not os.path.exists(path):
+            pytest.skip("no shipped plan report")
+        assert plan.check(path) == []
+
+
+class TestResolveSpec:
+    """db.resolve_spec: env > DB > default, generation-gated like every
+    other tuned knob — CPU tier-1 runs must never see a planned spec."""
+
+    @pytest.fixture
+    def seeded(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "tune_db.json")
+        db = tune_db.TuningDB(path)
+        db.add({"program": "train_lm_tiny", "family": "plan_spec",
+                "fingerprint": "f" * 32, "topology": "v5e:2x2",
+                "generation": "v5e", "config": {"spec": "dp=*,ep=2"},
+                "predicted": {"predicted_ms": 0.03, "source": "planned"}})
+        db.save()
+        monkeypatch.setenv("TPUFRAME_TUNE_DB", path)
+        for var in ("TPUFRAME_SPEC", "TPUFRAME_TUNE_GEN",
+                    "PALLAS_AXON_TPU_GEN"):
+            monkeypatch.delenv(var, raising=False)
+
+    def test_no_generation_no_resolution(self, seeded):
+        assert tune_db.resolve_spec("train_lm_tiny") is None
+
+    def test_generation_gated_resolution(self, seeded, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_TUNE_GEN", "v5e")
+        assert tune_db.resolve_spec("train_lm_tiny") == "dp=*,ep=2"
+        # unknown program falls back to the family winner
+        assert tune_db.resolve_spec("other_prog") == "dp=*,ep=2"
+
+    def test_env_spec_abstains(self, seeded, monkeypatch):
+        monkeypatch.setenv("TPUFRAME_TUNE_GEN", "v5e")
+        monkeypatch.setenv("TPUFRAME_SPEC", "dp=4")
+        assert tune_db.resolve_spec("train_lm_tiny") is None
+
+    def test_env_overrides_carries_spec(self, seeded):
+        db = tune_db.TuningDB.open()
+        rec = db.best(family="plan_spec")
+        assert rec.env_overrides()["TPUFRAME_SPEC"] == "dp=*,ep=2"

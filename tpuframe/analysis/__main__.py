@@ -42,13 +42,21 @@ non-zero on any finding:
      ``docs/samples/analysis_compare/`` must keep exercising the whole
      ``--compare`` contract (schema keys, rc codes, the schedule
      section), so a report-schema change that strands the differ fails
-     CI before it ships.
+     CI before it ships;
+  10. plan self-check — the pinned ``tune plan`` report
+     (``perf/results/plan_report_*``) must schema-validate, its ranking
+     must re-derive from its own rows with every ranked candidate
+     detector-clean, a seeded best/worst cost swap must flip the
+     derived ranking (the gate refuses to rank blind), and the three
+     pinned PERF verdicts (§18/§20/§23) must re-derive AND hold
+     (``tpuframe.tune.plan.check``; version-skew skips itself like
+     ``--emit-budgets``).
 
 ``--json PATH`` writes the whole gate outcome as a schema-pinned report;
 ``--compare A.json B.json`` diffs two such reports for structural
 collective regressions (rc 1 regression / 0 clean / 2 no overlap — the
 ``obs compare`` contract) without touching jax at all; ``--selfcheck``
-runs only leg 8 (also jax-free).
+runs only legs 9 and 10 (jax-free but for the version stamp).
 
 Strategies this interpreter cannot express (see
 :class:`~tpuframe.analysis.strategies.Unavailable`) print as SKIP and do
@@ -274,6 +282,19 @@ def _run_pspec_check() -> int:
     return len(problems)
 
 
+def _run_plan_check() -> int:
+    # Jax-light: validates the pinned planner report (schema pin,
+    # re-derivable ranking, seeded ranking-drift positive, the three
+    # pinned PERF verdicts) — jax is touched only for the version stamp.
+    from tpuframe.tune import plan
+
+    problems = plan.check()
+    for p in problems:
+        print(f"PLAN {p}")
+    print(f"[analysis] plan self-check: {len(problems)} problem(s)")
+    return len(problems)
+
+
 def _run_router_check() -> int:
     from tpuframe.serve import router
 
@@ -326,8 +347,9 @@ def main(argv=None) -> int:
                             args.bytes_tol)
 
     if args.selfcheck:
-        # Also jax-free: golden-pair + schema validation only.
-        return 1 if _run_flow_selfcheck() else 0
+        # Also jax-free: golden-pair + schema validation, plus the
+        # planner-report pin (version-skew skips itself).
+        return 1 if (_run_flow_selfcheck() + _run_plan_check()) else 0
 
     if (args.emit_budgets or args.emit_schedule) and args.strategy:
         print("[analysis] --emit-budgets/--emit-schedule regenerate the "
@@ -370,6 +392,7 @@ def main(argv=None) -> int:
         n_findings += _run_elastic_check()
         n_findings += _run_quantwire_check()
         n_findings += _run_pspec_check()
+        n_findings += _run_plan_check()
         n_findings += _run_obs_check()
         if args.json:
             _write_json(args.json, audits, lint_findings, args.devices)

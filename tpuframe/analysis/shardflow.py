@@ -912,10 +912,12 @@ def comm_split(graph: cg.CollectiveGraph, report, *, mesh_shape: dict,
 def audit_flow(audit, *, derived_file: dict | None = None,
                schedule_file: dict | None = None,
                graph: cg.CollectiveGraph | None = None,
-               n_devices: int = 8) -> dict:
+               n_devices: int = 8, drift: bool = True) -> dict:
     """All structural detectors over one strategy audit.  Returns the
     per-strategy report fragment; ``problems`` is the flattened finding
-    list the gate counts."""
+    list the gate counts.  ``drift=False`` skips the derived-file pin
+    comparison — the planner's ad-hoc spec candidates have no pinned
+    declaration, only the structural detectors apply."""
     if graph is None:
         graph = cg.parse_graph(audit.compiled.as_text())
     meta = getattr(audit, "meta", None)
@@ -933,16 +935,17 @@ def audit_flow(audit, *, derived_file: dict | None = None,
             graph, bool(meta.declared_overlapped) if meta else False,
             ignore_below=audit.budget.ignore_below),
     }
-    drift = budget_drift(audit, derived_file)
-    sched_drift = schedule_drift(audit, schedule_file, graph=graph)
+    drift_p = budget_drift(audit, derived_file) if drift else []
+    sched_drift = (schedule_drift(audit, schedule_file, graph=graph)
+                   if drift else [])
     problems = ([f"[{audit.name}] {f}"
                  for fs in detectors.values() for f in fs]
-                + drift + sched_drift)
+                + drift_p + sched_drift)
     return {
         "graph": graph.summary(),
         "detectors": detectors,
         "derived": derive_budget(audit.report, audit.budget.ignore_below),
-        "drift": drift,
+        "drift": drift_p,
         "schedule": derive_schedule_entry(
             graph, ignore_below=audit.budget.ignore_below),
         "schedule_drift": sched_drift,

@@ -136,6 +136,17 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          ``ParallelSpec``; degenerate single-purpose meshes (the
          process-axis host mesh, topology probes) suppress with
          ``# tf-lint: ok[TF119]`` and a reason.
+  TF120  strategy registration outside the spec seam — a hand-built
+         ``StrategyMeta(...)`` or a write into the ``STRATEGIES``
+         registry (subscript assignment, ``.update(...)``,
+         ``.setdefault(...)``) anywhere but ``analysis/strategies.py``.
+         Since the grammar closed over all nine strategies, the one
+         sanctioned way to add a strategy is
+         ``register_spec_strategy("name", "spec", ...)`` — a hand-wired
+         builder bypasses spec lowering, so its CommBudget/schedule
+         record is no longer auto-derived from the grammar and the
+         planner cannot enumerate it.  Out-of-repo experiment plugins
+         suppress with ``# tf-lint: ok[TF120]`` and a reason.
 
 Scope: TF101/TF102 only fire *inside functions known to be traced*
 (decorated with ``jax.jit``/``pmap``/``shard_map`` or passed to
@@ -208,6 +219,10 @@ RULES = {
              "outside the mesh seam (parallel/mesh.py, "
              "parallel/pspec.py) — re-decides axis names and ICI/DCN "
              "ordering behind the spec grammar's back",
+    "TF120": "strategy registration (StrategyMeta(...)/STRATEGIES "
+             "write) outside analysis/strategies.py's "
+             "register_spec_strategy seam — a hand-wired builder "
+             "bypasses spec lowering and the planner's enumeration",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -337,6 +352,11 @@ _NET_EXEMPT_SUFFIXES = ("serve/router.py", "obs/exporter.py")
 # declarative grammar that lowers onto it.  Everything else builds
 # through them.
 _MESH_EXEMPT_SUFFIXES = ("parallel/mesh.py", "parallel/pspec.py")
+
+# TF120: the strategy seam.  strategies.py owns the registry; every
+# entry goes through register_spec_strategy so its budget/schedule
+# record derives from the spec grammar and `tune plan` can enumerate it.
+_STRATEGY_EXEMPT_SUFFIXES = ("analysis/strategies.py",)
 _NET_CALL_DOTTED = {"socket.socket", "socket.create_connection"}
 _NET_CALL_TAILS = {"urlopen", "HTTPConnection", "HTTPSConnection"}
 
@@ -548,6 +568,8 @@ class FileContext:
         self.http_scope = not norm.endswith(_HTTP_EXEMPT_SUFFIX)
         self.net_scope = not norm.endswith(_NET_EXEMPT_SUFFIXES)
         self.mesh_scope = not norm.endswith(_MESH_EXEMPT_SUFFIXES)
+        self.strategy_scope = not norm.endswith(
+            _STRATEGY_EXEMPT_SUFFIXES)
         self.lock_scope = any(p in norm for p in _LOCK_DISCIPLINE_PARTS)
         self.wire_scope = norm.endswith(_WIRE_SEAM_SUFFIXES)
         self.world_scope = not any(p in norm
@@ -955,6 +977,53 @@ def _tf119_raw_mesh(ctx: FileContext, node, fn):
                  f"back; build through mesh.make_mesh(MeshSpec(...)) / "
                  f"ParallelSpec.make_mesh(), or suppress with tf-lint: "
                  f"ok[TF119] and a reason", fn)
+
+
+@_node_rule
+def _tf120_strategy_seam(ctx: FileContext, node, fn):
+    """A strategy registered behind the spec seam's back: a hand-built
+    ``StrategyMeta(...)`` or any write into the ``STRATEGIES`` registry
+    (``STRATEGIES[name] = ...``, ``STRATEGIES.update(...)``,
+    ``STRATEGIES.setdefault(...)``) outside ``analysis/strategies.py``.
+    The registry's contract since the grammar closed is that every
+    entry lowers from a ``ParallelSpec`` via ``register_spec_strategy``
+    — that is what keeps the derived budgets/schedules auto-derivable
+    and the ``tune plan`` candidate space equal to the strategy space."""
+    if not ctx.strategy_scope:
+        return
+    if isinstance(node, ast.Call):
+        callee = _dotted(node.func)
+        tail = callee.rsplit(".", 1)[-1]
+        if tail == "StrategyMeta":
+            ctx.emit("TF120", node,
+                     f"hand-built `{callee}(...)` outside "
+                     f"analysis/strategies.py — register through "
+                     f"strategies.register_spec_strategy(name, spec) so "
+                     f"the budget/schedule derive from the grammar and "
+                     f"the planner can enumerate it, or suppress with "
+                     f"tf-lint: ok[TF120] and a reason", fn)
+            return
+        if (tail in ("update", "setdefault")
+                and callee.rsplit(".", 2)[-2:-1] == ["STRATEGIES"]):
+            ctx.emit("TF120", node,
+                     f"`{callee}(...)` writes the strategy registry "
+                     f"outside analysis/strategies.py — use "
+                     f"strategies.register_spec_strategy(name, spec), "
+                     f"or suppress with tf-lint: ok[TF120] and a "
+                     f"reason", fn)
+        return
+    if isinstance(node, ast.Assign):
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Subscript)
+                    and _dotted(tgt.value).rsplit(".", 1)[-1]
+                    == "STRATEGIES"):
+                ctx.emit("TF120", node,
+                         "subscript write into STRATEGIES outside "
+                         "analysis/strategies.py — use "
+                         "strategies.register_spec_strategy(name, "
+                         "spec), or suppress with tf-lint: ok[TF120] "
+                         "and a reason", fn)
+                return
 
 
 @_fn_rule

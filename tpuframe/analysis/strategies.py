@@ -193,23 +193,39 @@ def _lm_pieces(batch: int = 8, seq: int = 32, **cfg_kw):
 # Builders.  Each returns
 # (jitted_step, example_args, budget, param_bytes, meta).
 #
-# The data-parallel family (dp / dp-zero1 / dp-int8 / dp-zero1-int8) and
-# every hierarchical multi-slice layout are SPEC-LOWERED: one generic
+# Every training parallelism strategy is SPEC-LOWERED: one generic
 # builder parses a ``tpuframe.parallel.pspec`` string, builds the
-# declared (possibly ICI×DCN) mesh, and lets ``pspec.lower`` pick the
-# step kwargs — zero1/wire-format ride as orthogonal modifiers instead
-# of four hand-copied builders.  The remaining hand-wired builders (tp,
-# pp, sp, ep, adasum, serve) keep their dedicated harnesses.
+# declared (possibly ICI×DCN) mesh, and lets ``pspec.lower`` /
+# ``pspec.lower_pp`` pick the step seams — zero1/wire-format/adasum ride
+# as orthogonal modifiers, tp/ep thread the model sharding rules, sp
+# partitions the sequence dim, pp drives the GPipe harness.  The only
+# hand-wired builder left is the serving decode audit, which is a decode
+# program (no train step, no parallelism spec to lower).
 # --------------------------------------------------------------------------
 
 
 def _spec_budget(spec, pb: int, n_devices: int, *, weight_update: str,
-                 wire_format: str, padded: int | None):
+                 wire_format: str, padded: int | None, ab: int = 0,
+                 seq_mode: str | None = None,
+                 grad_reduce: str | None = None):
     """The declared CommBudget for a composed spec — the same per-kind
-    ceilings the hand-wired family declared, picked by modifier; the
-    byte-exact pin lives in ``derived_budgets.json`` either way."""
-    if spec.fsdp > 1 or spec.tp > 1 or spec.ep > 1:
+    ceilings the hand-wired family declared, picked by axis/modifier;
+    the byte-exact pin lives in ``derived_budgets.json`` either way."""
+    if spec.pp > 1:
+        return budgets_lib.pp_budget(pb, ab, n_micro=2)
+    if spec.ep > 1:
+        return budgets_lib.ep_budget(pb, ab)
+    if spec.tp > 1:
+        return budgets_lib.tp_budget(pb, ab, num_layers=2)
+    if spec.fsdp > 1:
         return budgets_lib.fsdp_budget(pb)
+    if spec.sp > 1:
+        if (seq_mode or "ring") == "ring":
+            return budgets_lib.ring_sp_budget(pb, kv_bytes=2 * ab,
+                                              sp_degree=spec.sp)
+        return budgets_lib.ulysses_sp_budget(pb, ab)
+    if grad_reduce == "adasum":
+        return budgets_lib.adasum_budget(pb, n_devices)
     if weight_update == "zero1" and wire_format == "int8-block":
         return budgets_lib.zero1_int8_budget(padded, n_devices)
     if weight_update == "zero1":
@@ -219,14 +235,91 @@ def _spec_budget(spec, pb: int, n_devices: int, *, weight_update: str,
     return budgets_lib.dp_budget(pb)
 
 
+def _moe_pieces():
+    """Tiny MoE TransformerLM + shapes-only state/batch for the ``ep``
+    lowering: expert blocks every layer, aux loss threaded through the
+    ``mutable=["aux_loss"]`` collection exactly as train.py does."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpuframe.models import losses
+    from tpuframe.models.transformer_lm import LMConfig, TransformerLM
+    from tpuframe.parallel import step as step_lib
+
+    cfg = LMConfig.tiny(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, intermediate_size=64, max_seq=16,
+                        moe_experts=4, moe_k=2, moe_every=1)
+    model = TransformerLM(cfg)
+    variables = jax.eval_shape(model.init, jax.random.key(0),
+                               jax.ShapeDtypeStruct((1, 16), jnp.int32))
+    tx = optax.adamw(1e-3)
+
+    def loss_fn(params, model_state, b, rng):
+        logits, sown = model.apply({"params": params}, b["input_ids"],
+                                   train=True, rngs={"dropout": rng},
+                                   mutable=["aux_loss"])
+        loss = losses.softmax_cross_entropy(logits, b["labels"])
+        leaves = jax.tree.leaves(sown)
+        aux = sum(leaves) / max(len(leaves), 1)
+        return loss + cfg.moe_aux_weight * aux, ({}, {"moe_aux": aux})
+
+    state = jax.eval_shape(lambda p: step_lib.TrainState.create(p, tx),
+                           variables["params"])
+    ids = jax.ShapeDtypeStruct((8, 16), jnp.int32)
+    example = (state, {"input_ids": ids, "labels": ids})
+    pb = _tree_bytes(variables["params"])
+    ab = 8 * 16 * 32 * 4
+    return model, loss_fn, tx, example, pb, ab
+
+
+def _pp_build(spec, mesh):
+    """The ``pp`` lowering: ScanBlockLM with one block per stage, driven
+    through :func:`tpuframe.parallel.pspec.lower_pp` (the GPipe
+    harness).  Modifiers never reach here — the caller rejects them."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpuframe.models.transformer_lm import LMConfig, ScanBlockLM
+    from tpuframe.parallel import pspec
+    from tpuframe.parallel import step as step_lib
+
+    cfg = LMConfig.tiny(vocab_size=64, hidden_size=32,
+                        num_layers=spec.pp, num_heads=2,
+                        intermediate_size=64, max_seq=16)
+    model = ScanBlockLM(cfg)
+    tx = optax.adamw(1e-3)
+    variables = jax.eval_shape(model.init, jax.random.key(0),
+                               jax.ShapeDtypeStruct((1, 16), jnp.int32))
+    n_micro = 2
+    factory, _place_state, _place_batch = pspec.lower_pp(
+        spec, mesh, model, tx, n_micro=n_micro)
+    state = jax.eval_shape(lambda p: step_lib.TrainState.create(p, tx),
+                           variables["params"])
+    ids = jax.ShapeDtypeStruct((8, 16), jnp.int32)
+    step = factory(state)
+    pb = _tree_bytes(variables["params"])
+    ab = 8 * 16 * 32 * 4
+    return (step, (state, {"input_ids": ids, "labels": ids}),
+            budgets_lib.pp_budget(pb, ab, n_micro=n_micro), pb,
+            _meta(mesh))
+
+
 def _build_from_spec(spec_text: str, n_devices: int, *,
                      weight_update: str = "replicated",
-                     wire_format: str | None = None):
+                     wire_format: str | None = None,
+                     seq_mode: str | None = None,
+                     grad_reduce: str | None = None,
+                     devices=None):
     """Generic spec-lowered builder: ``spec_text`` (the
     ``TPUFRAME_SPEC`` grammar) -> hierarchical mesh -> lowered step.
     A spec whose axis product cannot fit ``n_devices`` is an
     :class:`Unavailable` (a skip — the spec is for a different world
-    size), never a violation."""
+    size), never a violation.  ``devices`` overrides the device list
+    (the planner passes compile-only topology devices); ``seq_mode``
+    picks ring vs Ulysses attention for ``sp`` specs; ``grad_reduce``
+    threads the adasum modifier."""
     import dataclasses
 
     import jax
@@ -239,9 +332,24 @@ def _build_from_spec(spec_text: str, n_devices: int, *,
         spec.sizes(n_devices)
     except pspec.SpecError as e:
         raise Unavailable(str(e)) from e
-    mesh = spec.make_mesh(devices=jax.devices()[:n_devices])
-    _, loss_fn, tx, (state, batch), pb, _ = _lm_pieces()
+    if devices is None:
+        devices = jax.devices()[:n_devices]
+    mesh = spec.make_mesh(devices=devices)
     wire = wire_format or "fp"
+    if spec.pp > 1:
+        if (weight_update != "replicated" or wire != "fp"
+                or seq_mode or grad_reduce):
+            raise pspec.SpecError(
+                f"spec '{spec.canonical()}': the GPipe lowering takes no "
+                f"modifiers — zero1/wire/seq_mode/adasum do not compose")
+        return _pp_build(spec, mesh)
+    if spec.ep > 1:
+        _, loss_fn, tx, (state, batch), pb, ab = _moe_pieces()
+    elif spec.sp > 1:
+        _, loss_fn, tx, (state, batch), pb, ab = _lm_pieces(
+            seq_mode=seq_mode or "ring")
+    else:
+        _, loss_fn, tx, (state, batch), pb, ab = _lm_pieces()
     padded = None
     if weight_update == "zero1":
         from tpuframe.parallel import zero1 as zero1_lib
@@ -251,12 +359,19 @@ def _build_from_spec(spec_text: str, n_devices: int, *,
             lambda p: zero1_lib.init_opt_state(tx, p, n), state.params)
         state = dataclasses.replace(state, opt_state=opt)
         padded = zero1_lib.padded_bytes(state.params, n)
+    tp_rules = None
+    if spec.tp > 1 or spec.ep > 1:
+        from tpuframe.parallel import tp as tp_lib
+
+        tp_rules = tp_lib.rules_for_model("transformer-lm")
     kwargs = pspec.lower(spec, mesh, state, weight_update=weight_update,
-                         wire_format=wire)
+                         wire_format=wire, tp_rules=tp_rules,
+                         grad_reduce=grad_reduce)
     step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
                                     **kwargs)
     budget = _spec_budget(spec, pb, n_devices, weight_update=weight_update,
-                          wire_format=wire, padded=padded)
+                          wire_format=wire, padded=padded, ab=ab,
+                          seq_mode=seq_mode, grad_reduce=grad_reduce)
     shardings = kwargs.get("state_shardings")
     return (step, (state, batch), budget, pb,
             _meta(mesh,
@@ -266,16 +381,13 @@ def _build_from_spec(spec_text: str, n_devices: int, *,
                                    if shardings is not None else ())))
 
 
-def register_spec_strategy(spec_text: str, *,
-                           weight_update: str = "replicated",
-                           wire_format: str | None = None) -> str:
-    """Register a composed parallelism spec as a dynamic analysis
-    strategy.  The name is the spec's canonical spelling under a
-    ``spec:`` prefix (plus any modifiers) — stable, so its auto-derived
-    budget can be pinned in ``derived_budgets.json`` like any hand-wired
-    strategy's."""
-    import functools
-
+def _spec_name(spec_text: str, *, weight_update: str = "replicated",
+               wire_format: str | None = None,
+               seq_mode: str | None = None,
+               grad_reduce: str | None = None) -> str:
+    """Canonical strategy name for a composed spec: the spec's canonical
+    spelling under a ``spec:`` prefix plus any modifiers — stable, so an
+    auto-derived budget can be pinned in ``derived_budgets.json``."""
     from tpuframe.parallel import pspec
 
     name = f"spec:{pspec.parse_spec(spec_text).canonical()}"
@@ -283,9 +395,33 @@ def register_spec_strategy(spec_text: str, *,
         name += f"+{weight_update}"
     if wire_format:
         name += f"+{wire_format}"
+    if seq_mode:
+        name += f"+{seq_mode}"
+    if grad_reduce:
+        name += f"+{grad_reduce}"
+    return name
+
+
+def register_spec_strategy(spec_text: str, *,
+                           weight_update: str = "replicated",
+                           wire_format: str | None = None,
+                           seq_mode: str | None = None,
+                           grad_reduce: str | None = None) -> str:
+    """Register a composed parallelism spec as a dynamic analysis
+    strategy.  The name is the spec's canonical spelling under a
+    ``spec:`` prefix (plus any modifiers) — stable, so its auto-derived
+    budget can be pinned in ``derived_budgets.json`` like any named
+    strategy's.  This is the ONE seam through which strategies enter the
+    registry (TF120 lints everything else)."""
+    import functools
+
+    name = _spec_name(spec_text, weight_update=weight_update,
+                      wire_format=wire_format, seq_mode=seq_mode,
+                      grad_reduce=grad_reduce)
     STRATEGIES[name] = functools.partial(
         _build_from_spec, spec_text, weight_update=weight_update,
-        wire_format=wire_format)
+        wire_format=wire_format, seq_mode=seq_mode,
+        grad_reduce=grad_reduce)
     return name
 
 
@@ -293,8 +429,8 @@ _warned_legacy: set = set()
 
 
 def _warn_legacy(fn_name: str, spec_text: str) -> None:
-    """Warn-once deprecation for the hand-wired DP-family constructors
-    (the ``TPUFRAME_BENCH_REMAT`` / ``quantized_mean`` alias idiom)."""
+    """Warn-once deprecation for the retired hand-wired constructors
+    (the ``TPUFRAME_BENCH_REMAT`` alias idiom)."""
     if fn_name in _warned_legacy:
         return
     _warned_legacy.add(fn_name)
@@ -339,57 +475,26 @@ def _build_zero1_int8(n_devices: int):
 
 
 def _build_fsdp(n_devices: int):
-    from tpuframe.parallel import fsdp as fsdp_lib
-    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
-
-    mesh = mesh_lib.make_mesh(
-        mesh_lib.MeshSpec(data=n_devices // 2, fsdp=2))
-    _, loss_fn, tx, (state, batch), pb, _ = _lm_pieces()
-    shardings = fsdp_lib.state_shardings(state, mesh)
-    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
-                                    state_shardings=shardings)
-    return (step, (state, batch), budgets_lib.fsdp_budget(pb), pb,
-            _meta(mesh,
-                  declared_leaves=_declared_leaves(state, shardings)))
+    """Deprecated alias: the dp×fsdp layout is spec-lowered now."""
+    _warn_legacy("_build_fsdp", "dp=*,fsdp=2")
+    return _build_from_spec("dp=*,fsdp=2", n_devices)
 
 
 def _build_tp(n_devices: int):
-    from tpuframe.parallel import fsdp as fsdp_lib, tp as tp_lib
-    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
-
+    """Deprecated alias: tensor parallelism is spec-lowered now (the
+    ``tp=`` axis threads ``tp.rules_for_model`` automatically)."""
     tp = 4 if n_devices % 4 == 0 else 2
-    mesh = mesh_lib.make_mesh(
-        mesh_lib.MeshSpec(data=n_devices // tp, model=tp))
-    _, loss_fn, tx, (state, batch), pb, ab = _lm_pieces()
-    shardings = fsdp_lib.state_shardings(
-        state, mesh, tp_rules=tp_lib.rules_for_model("transformer-lm"))
-    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
-                                    state_shardings=shardings)
-    return (step, (state, batch),
-            budgets_lib.tp_budget(pb, ab, num_layers=2), pb,
-            _meta(mesh,
-                  declared_leaves=_declared_leaves(state, shardings)))
+    _warn_legacy("_build_tp", f"dp=*,tp={tp}")
+    return _build_from_spec(f"dp=*,tp={tp}", n_devices)
 
 
 def _build_ring_sp(n_devices: int, seq_mode: str = "ring"):
-    from jax.sharding import PartitionSpec as P
-
-    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
-
+    """Deprecated alias: sequence parallelism is spec-lowered now (the
+    ``sp=`` axis partitions the batch's sequence dim; ``seq_mode`` picks
+    ring vs Ulysses attention)."""
     sp = 4 if n_devices % 4 == 0 else 2
-    mesh = mesh_lib.make_mesh(
-        mesh_lib.MeshSpec(data=n_devices // sp, seq=sp))
-    _, loss_fn, tx, (state, batch), pb, ab = _lm_pieces(seq_mode=seq_mode)
-    part = P(mesh_lib.BATCH_AXES, "seq")
-    step = step_lib.make_train_step(
-        loss_fn, tx, mesh, donate=False, batch_partition=part,
-        reduce_axes=(*mesh_lib.BATCH_AXES, "seq"))
-    if seq_mode == "ring":
-        budget = budgets_lib.ring_sp_budget(pb, kv_bytes=2 * ab,
-                                            sp_degree=sp)
-    else:
-        budget = budgets_lib.ulysses_sp_budget(pb, ab)
-    return step, (state, batch), budget, pb, _meta(mesh)
+    _warn_legacy("_build_ring_sp", f"dp=*,sp={sp}")
+    return _build_from_spec(f"dp=*,sp={sp}", n_devices, seq_mode=seq_mode)
 
 
 def _build_ulysses(n_devices: int):
@@ -397,80 +502,18 @@ def _build_ulysses(n_devices: int):
 
 
 def _build_pp(n_devices: int):
-    import jax
-    import jax.numpy as jnp
-    import optax
-
-    from tpuframe.models.transformer_lm import LMConfig, ScanBlockLM
-    from tpuframe.parallel import mesh as mesh_lib, pp_lm
-    from tpuframe.parallel import step as step_lib
-
+    """Deprecated alias: pipeline parallelism is spec-lowered now (the
+    ``pp=`` axis drives the GPipe harness via ``pspec.lower_pp``)."""
     pipe = 4 if n_devices % 4 == 0 else 2
-    mesh = mesh_lib.make_mesh(
-        mesh_lib.MeshSpec(data=n_devices // pipe, pipe=pipe))
-    cfg = LMConfig.tiny(vocab_size=64, hidden_size=32, num_layers=pipe,
-                        num_heads=2, intermediate_size=64, max_seq=16)
-    model = ScanBlockLM(cfg)
-    tx = optax.adamw(1e-3)
-    variables = jax.eval_shape(model.init, jax.random.key(0),
-                               jax.ShapeDtypeStruct((1, 16), jnp.int32))
-    n_micro = 2
-    factory, _place_state, _place_batch = pp_lm.make_pp_lm_step(
-        model, tx, mesh, n_micro=n_micro)
-    state = jax.eval_shape(lambda p: step_lib.TrainState.create(p, tx),
-                           variables["params"])
-    ids = jax.ShapeDtypeStruct((8, 16), jnp.int32)
-    step = factory(state)
-    pb = _tree_bytes(variables["params"])
-    ab = 8 * 16 * 32 * 4
-    return (step, (state, {"input_ids": ids, "labels": ids}),
-            budgets_lib.pp_budget(pb, ab, n_micro=n_micro), pb,
-            _meta(mesh))
+    _warn_legacy("_build_pp", f"dp=*,pp={pipe}")
+    return _build_from_spec(f"dp=*,pp={pipe}", n_devices)
 
 
 def _build_ep(n_devices: int):
-    import jax
-    import jax.numpy as jnp
-    import optax
-
-    from tpuframe.models import losses
-    from tpuframe.models.transformer_lm import LMConfig, TransformerLM
-    from tpuframe.parallel import fsdp as fsdp_lib, tp as tp_lib
-    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
-
-    ep = 2
-    mesh = mesh_lib.make_mesh(
-        mesh_lib.MeshSpec(data=n_devices // ep, expert=ep))
-    cfg = LMConfig.tiny(vocab_size=64, hidden_size=32, num_layers=2,
-                        num_heads=2, intermediate_size=64, max_seq=16,
-                        moe_experts=4, moe_k=2, moe_every=1)
-    model = TransformerLM(cfg)
-    variables = jax.eval_shape(model.init, jax.random.key(0),
-                               jax.ShapeDtypeStruct((1, 16), jnp.int32))
-    tx = optax.adamw(1e-3)
-
-    def loss_fn(params, model_state, b, rng):
-        logits, sown = model.apply({"params": params}, b["input_ids"],
-                                   train=True, rngs={"dropout": rng},
-                                   mutable=["aux_loss"])
-        loss = losses.softmax_cross_entropy(logits, b["labels"])
-        leaves = jax.tree.leaves(sown)
-        aux = sum(leaves) / max(len(leaves), 1)
-        return loss + cfg.moe_aux_weight * aux, ({}, {"moe_aux": aux})
-
-    state = jax.eval_shape(lambda p: step_lib.TrainState.create(p, tx),
-                           variables["params"])
-    shardings = fsdp_lib.state_shardings(
-        state, mesh, tp_rules=tp_lib.rules_for_model("transformer-lm"))
-    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
-                                    state_shardings=shardings)
-    ids = jax.ShapeDtypeStruct((8, 16), jnp.int32)
-    pb = _tree_bytes(variables["params"])
-    ab = 8 * 16 * 32 * 4
-    return (step, (state, {"input_ids": ids, "labels": ids}),
-            budgets_lib.ep_budget(pb, ab), pb,
-            _meta(mesh,
-                  declared_leaves=_declared_leaves(state, shardings)))
+    """Deprecated alias: expert parallelism is spec-lowered now (the
+    ``ep=`` axis shards the MoE expert blocks via the model rules)."""
+    _warn_legacy("_build_ep", "dp=*,ep=2")
+    return _build_from_spec("dp=*,ep=2", n_devices)
 
 
 def _build_serve_decode(n_devices: int):
@@ -516,23 +559,23 @@ def _build_serve_decode(n_devices: int):
 
 
 def _build_adasum(n_devices: int):
-    from tpuframe.parallel import mesh as mesh_lib, step as step_lib
-
-    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=n_devices))
-    _, loss_fn, tx, example, pb, _ = _lm_pieces()
-    step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
-                                    grad_reduce="adasum")
-    return (step, example, budgets_lib.adasum_budget(pb, n_devices), pb,
-            _meta(mesh))
+    """Deprecated alias: adasum is the ``grad_reduce`` modifier on the
+    plain ``dp=*`` spec now."""
+    _warn_legacy("_build_adasum", "dp=*")
+    return _build_from_spec("dp=*", n_devices, grad_reduce="adasum")
 
 
-#: MULTICHIP_r05.json strategy name -> builder.  The DP family is
-#: spec-lowered (the partials below ARE the registration — the old
-#: ``_build_dp``-style constructors survive only as warn-once
-#: deprecated aliases).  ``spec:`` entries follow the
+#: MULTICHIP_r05.json strategy name -> builder.  Every training
+#: strategy is spec-lowered (the partials below ARE the registration —
+#: the old ``_build_*`` constructors survive only as warn-once
+#: deprecated aliases).  The friendly names stay stable so the pinned
+#: records in ``derived_budgets.json``/``derived_schedule.json`` keep
+#: meaning the same programs.  ``spec:`` entries follow the
 #: :func:`register_spec_strategy` naming convention; the composed
-#: hierarchical entry is the ISSUE's acceptance case — dp×fsdp inside
-#: each slice, replicated over the DCN slice axis.
+#: hierarchical entry is the PR 15 acceptance case — dp×fsdp inside
+#: each slice, replicated over the DCN slice axis.  The serving decode
+#: audit is the one non-spec entry (a decode program, not a train-step
+#: parallelism).
 STRATEGIES = {
     "dp": functools.partial(_build_from_spec, "dp=*"),
     "dp-int8": functools.partial(_build_from_spec, "dp=*",
@@ -544,15 +587,58 @@ STRATEGIES = {
                                        wire_format="int8-block"),
     "spec:dp=2,fsdp=2;slices=2": functools.partial(
         _build_from_spec, "dp=2,fsdp=2;slices=2"),
-    "resnet-fsdp": _build_fsdp,
-    "lm-tensor-parallel": _build_tp,
-    "lm-seq-parallel": _build_ring_sp,
-    "lm-seq-ulysses": _build_ulysses,
-    "pipeline-parallel": _build_pp,
-    "expert-parallel": _build_ep,
-    "dp-adasum": _build_adasum,
+    "resnet-fsdp": functools.partial(_build_from_spec, "dp=*,fsdp=2"),
+    "lm-tensor-parallel": functools.partial(_build_from_spec, "dp=*,tp=4"),
+    "lm-seq-parallel": functools.partial(_build_from_spec, "dp=*,sp=4",
+                                         seq_mode="ring"),
+    "lm-seq-ulysses": functools.partial(_build_from_spec, "dp=*,sp=4",
+                                        seq_mode="ulysses"),
+    "pipeline-parallel": functools.partial(_build_from_spec, "dp=*,pp=4"),
+    "expert-parallel": functools.partial(_build_from_spec, "dp=*,ep=2"),
+    "dp-adasum": functools.partial(_build_from_spec, "dp=*",
+                                   grad_reduce="adasum"),
     "serve-dp-decode": _build_serve_decode,
 }
+
+
+def audit_spec(spec_text: str, *, n_devices: int,
+               weight_update: str = "replicated",
+               wire_format: str | None = None,
+               seq_mode: str | None = None,
+               grad_reduce: str | None = None,
+               devices=None, name: str | None = None) -> StrategyAudit:
+    """Audit an UNREGISTERED spec candidate — the ``tune plan`` seam.
+
+    Same build/compile/budget-check pipeline as :func:`audit_strategy`,
+    but over an ad-hoc spec string instead of a registry entry, and with
+    an optional explicit device list so the planner can compile against
+    ``pspec.topology_devices`` instead of the local backend.  The
+    planner enumerating hundreds of candidates goes through here so it
+    never hand-builds a :class:`StrategyMeta` (TF120's rule)."""
+    label = name or _spec_name(spec_text, weight_update=weight_update,
+                               wire_format=wire_format, seq_mode=seq_mode,
+                               grad_reduce=grad_reduce)
+    try:
+        if devices is None:
+            _require_devices(n_devices)
+        step, example, budget, pb, meta = _build_from_spec(
+            spec_text, n_devices, weight_update=weight_update,
+            wire_format=wire_format, seq_mode=seq_mode,
+            grad_reduce=grad_reduce, devices=devices)
+        report, compiled = hlo_audit.audit_jitted(step, *example)
+    except Unavailable as e:
+        return StrategyAudit(name=label, status="unavailable",
+                             reason=str(e))
+    except _CAPABILITY_ERRORS as e:
+        return StrategyAudit(
+            name=label, status="unavailable",
+            reason=f"{type(e).__name__}: {e} (jax {_jax_version()} lacks "
+                   f"an API this strategy's step code needs)")
+    violations = budgets_lib.check_budget(report, budget)
+    return StrategyAudit(
+        name=label, status="ok" if not violations else "violation",
+        violations=violations, report=report, budget=budget,
+        param_bytes=pb, compiled=compiled, meta=meta)
 
 
 def audit_strategy(name: str, n_devices: int = 8) -> StrategyAudit:

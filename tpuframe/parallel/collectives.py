@@ -427,34 +427,24 @@ def _bcast_sum(sharding: NamedSharding):
     return jax.jit(lambda a: a.sum(axis=0), out_shardings=sharding)
 
 
-_QUANTIZED_MEAN_WARNED = False
-
-
 def quantized_mean(tree: PyTree, axis: AxisName = "data") -> PyTree:
-    """Deprecated alias for :func:`tpuframe.parallel.quantwire.all_reduce_mean`.
+    """REMOVED — raises with the replacement spelled out.
 
     The original shared-scale int16-accumulated psum prototype grew into
     the block-quantized ``int8-block`` wire format (per-block scales, s8
     payload over all-to-all + all-gather — arXiv:2506.17615), resolved
     per strategy through ``TPUFRAME_WIRE_FORMAT`` / the tune DB on the
-    step path.  This shim keeps the old always-quantized call-site
-    semantics (``min_elems=0``: every leaf takes the quantized wire) and
-    warns once per process, the PR 5/PR 8 legacy-knob idiom.
+    step path.  The warn-once shim rode along for two release cycles;
+    with the spec grammar closed there is exactly one quantized-wire
+    seam, and a silent alias to it hides the per-strategy resolution.
     """
-    global _QUANTIZED_MEAN_WARNED
-    if not _QUANTIZED_MEAN_WARNED:
-        _QUANTIZED_MEAN_WARNED = True
-        import warnings
-
-        warnings.warn(
-            "collectives.quantized_mean is deprecated; call "
-            "tpuframe.parallel.quantwire.all_reduce_mean (or select the "
-            "wire per strategy via TPUFRAME_WIRE_FORMAT / the tune DB "
-            "on the make_train_step path)",
-            DeprecationWarning, stacklevel=2)
-    from tpuframe.parallel import quantwire
-
-    return quantwire.all_reduce_mean(tree, axis, min_elems=0)
+    raise RuntimeError(
+        "collectives.quantized_mean was removed: call "
+        "tpuframe.parallel.quantwire.all_reduce_mean(tree, axis, "
+        "min_elems=0) for the old always-quantized semantics, or — the "
+        "supported path — select the wire per strategy via "
+        "TPUFRAME_WIRE_FORMAT='int8-block' / the tune DB on the "
+        "make_train_step path")
 
 
 def host_broadcast(tree: PyTree, mesh: Mesh) -> PyTree:

@@ -184,26 +184,37 @@ def resolve(explicit: str | None = None) -> tuple:
 
 def lower(spec: ParallelSpec, mesh, state=None, *,
           weight_update: str = "replicated", wire_format: str | None = None,
-          fusion_threshold: int | None = None, tp_rules=None) -> dict:
+          fusion_threshold: int | None = None, tp_rules=None,
+          grad_reduce: str | None = None) -> dict:
     """Map a spec onto ``make_train_step`` kwargs.
 
-    Two lowering classes exist, matching the step factory's own modes:
+    Three lowering classes exist, matching the step factory's own modes:
 
       * pure data-parallel (only ``dp``/``slices`` > 1) lowers to the
         shard_map path, where ``weight_update`` (zero1), ``wire_format``
-        (int8-block) and ``fusion_threshold`` remain orthogonal
-        modifiers — exactly the knobs ``zero1.resolve`` /
-        ``quantwire.resolve`` already feed;
+        (int8-block), ``fusion_threshold`` and ``grad_reduce``
+        (``"adasum"``) remain orthogonal modifiers — exactly the knobs
+        ``zero1.resolve`` / ``quantwire.resolve`` already feed.  adasum
+        is its own wire pattern (the ppermute butterfly) and refuses the
+        other three modifiers, mirroring ``make_train_step``'s rules;
+      * sequence-parallel specs (``sp`` > 1, weights replicated) stay on
+        the shard_map path but partition the batch's sequence dim over
+        the ``seq`` axis and widen the loss reduction to span it —
+        activations shard, weights do not, so the shard_map modifiers
+        whose byte accounting assumes batch-only sharding (zero1 /
+        int8-block / fusion / adasum) do not compose;
       * weight-sharded specs (``fsdp``/``tp``/``ep`` > 1) lower to the
         auto-SPMD path via :func:`tpuframe.parallel.fsdp.state_shardings`
         over the declared (possibly hierarchical) mesh — ``state`` (a
         TrainState or its eval_shape) is required to build the sharding
-        tree, and the shard_map-only modifiers do not compose (the
-        partitioner owns the collectives).
+        tree, ``tp``/``ep`` additionally require ``tp_rules`` (else the
+        model/expert axis would silently replicate), and the
+        shard_map-only modifiers do not compose (the partitioner owns
+        the collectives).
 
-    ``pp``/``sp`` keep their dedicated harnesses (``pp_lm``, the
-    seq-parallel batch partitions) — declaring them here is a
-    :class:`SpecError`, not a silent approximation.
+    ``pp`` keeps its dedicated GPipe harness — declaring it here is a
+    :class:`SpecError` pointing at :func:`lower_pp`, not a silent
+    approximation.
 
     Returns the kwargs dict to splat into ``make_train_step(loss_fn,
     tx, mesh, **kwargs)``.
@@ -217,19 +228,35 @@ def lower(spec: ParallelSpec, mesh, state=None, *,
                 f"mesh axis {axis!r} has size {mesh.shape.get(axis, 1)} "
                 f"but spec '{spec.canonical()}' declares {size} — lower "
                 f"the spec onto the mesh it built (spec.make_mesh())")
-    if spec.pp > 1 or spec.sp > 1:
+    if spec.pp > 1:
         raise SpecError(
-            f"spec '{spec.canonical()}': pp/sp do not lower through "
-            f"make_train_step — use the dedicated pp_lm / seq-parallel "
-            f"harnesses")
+            f"spec '{spec.canonical()}': pp does not lower through "
+            f"make_train_step — use lower_pp(), which drives the pp_lm "
+            f"GPipe harness")
     wire_format = wire_format or "fp"
+    grad_reduce = grad_reduce or "mean"
+    if grad_reduce not in ("mean", "adasum"):
+        raise SpecError(f"grad_reduce={grad_reduce!r} — expected 'mean' "
+                        f"or 'adasum'")
+    modified = (weight_update != "replicated" or wire_format != "fp"
+                or fusion_threshold is not None)
     if spec.fsdp > 1 or spec.tp > 1 or spec.ep > 1:
-        if weight_update != "replicated" or wire_format != "fp" \
-                or fusion_threshold is not None:
+        if spec.sp > 1:
+            raise SpecError(
+                f"spec '{spec.canonical()}': sp is a shard_map batch "
+                f"partition and does not compose with the auto-SPMD "
+                f"weight-sharded lowering")
+        if modified or grad_reduce != "mean":
             raise SpecError(
                 f"spec '{spec.canonical()}': weight-sharded lowering is "
-                f"auto-SPMD — zero1/wire_format/fusion_threshold are "
-                f"shard_map modifiers and do not compose")
+                f"auto-SPMD — zero1/wire_format/fusion_threshold/adasum "
+                f"are shard_map modifiers and do not compose")
+        if (spec.tp > 1 or spec.ep > 1) and tp_rules is None:
+            raise SpecError(
+                f"spec '{spec.canonical()}' shards weights over the "
+                f"model/expert axis — pass tp_rules (e.g. "
+                f"tp.rules_for_model(...)); without them the axis would "
+                f"silently replicate")
         if state is None:
             raise SpecError(
                 f"spec '{spec.canonical()}' shards weights — lowering "
@@ -243,13 +270,67 @@ def lower(spec: ParallelSpec, mesh, state=None, *,
             "state_shardings": shardings,
             "batch_partition": mesh_lib.batch_spec(mesh=mesh),
         }
+    if spec.sp > 1:
+        if modified or grad_reduce != "mean":
+            raise SpecError(
+                f"spec '{spec.canonical()}': sp shards activations, not "
+                f"weights — zero1/wire_format/fusion_threshold/adasum "
+                f"assume batch-only sharding and do not compose")
+        from jax.sharding import PartitionSpec as P
+
+        axes = mesh_lib.batch_axes(mesh)
+        return {
+            "weight_update": weight_update,
+            "wire_format": wire_format,
+            "fusion_threshold": fusion_threshold,
+            "reduce_axes": (*axes, "seq"),
+            "batch_partition": P(axes, "seq"),
+        }
+    if grad_reduce == "adasum" and modified:
+        raise SpecError(
+            f"spec '{spec.canonical()}': adasum's ppermute butterfly is "
+            f"its own wire pattern — zero1/wire_format/fusion_threshold "
+            f"do not compose")
     return {
         "weight_update": weight_update,
         "wire_format": wire_format,
         "fusion_threshold": fusion_threshold,
+        "grad_reduce": grad_reduce,
         "reduce_axes": mesh_lib.batch_axes(mesh),
         "batch_partition": mesh_lib.batch_spec(mesh=mesh),
     }
+
+
+def lower_pp(spec: ParallelSpec, mesh, model, tx, *, n_micro: int = 2,
+             fused_xent: bool = False, remat_policy=None):
+    """Lower a ``pp>1`` spec onto the GPipe harness.
+
+    Pipeline parallelism cannot be expressed as ``make_train_step``
+    kwargs — the microbatch loop restructures the step itself — so the
+    spec grammar lowers it through :func:`tpuframe.parallel.pp_lm.
+    make_pp_lm_step` instead.  ``model`` must be a ScanBlockLM whose
+    ``num_layers`` is divisible by the declared ``pp`` degree (the
+    harness re-checks and raises).  Returns the harness triple
+    ``(step_fn_factory, place_state, place_batch)``."""
+    declared = spec.sizes(mesh.devices.size)
+    for axis, size in declared.items():
+        if int(mesh.shape.get(axis, 1)) != int(size):
+            raise SpecError(
+                f"mesh axis {axis!r} has size {mesh.shape.get(axis, 1)} "
+                f"but spec '{spec.canonical()}' declares {size} — lower "
+                f"the spec onto the mesh it built (spec.make_mesh())")
+    if spec.pp <= 1:
+        raise SpecError(f"spec '{spec.canonical()}' declares no pipeline "
+                        f"axis — lower_pp needs pp > 1")
+    if spec.fsdp > 1 or spec.tp > 1 or spec.ep > 1 or spec.sp > 1:
+        raise SpecError(
+            f"spec '{spec.canonical()}': the GPipe harness composes pp "
+            f"with dp only — fsdp/tp/ep/sp do not lower through it")
+    from tpuframe.parallel import pp_lm
+
+    return pp_lm.make_pp_lm_step(model, tx, mesh, n_micro=n_micro,
+                                 fused_xent=fused_xent,
+                                 remat_policy=remat_policy)
 
 
 # ---------------------------------------------------------------------------
@@ -290,6 +371,17 @@ _ROUNDTRIP_CASES = (
     ("fsdp=2", "dp=1,fsdp=2"),
     ("dp=1,tp=4;slices=4", "dp=1,tp=4;slices=4"),
     ("dp=*,ep=2", "dp=*,ep=2"),
+    ("dp=2,tp=4", "dp=2,tp=4"),
+    ("tp=2,dp=2", "dp=2,tp=2"),
+    ("dp=*,tp=2", "dp=*,tp=2"),
+    ("dp=2,pp=4", "dp=2,pp=4"),
+    ("pp=2", "dp=1,pp=2"),
+    ("dp=*,pp=2;slices=2", "dp=*,pp=2;slices=2"),
+    ("dp=2,sp=4", "dp=2,sp=4"),
+    ("sp=2,dp=*", "dp=*,sp=2"),
+    ("ep=2,dp=4", "dp=4,ep=2"),
+    ("dp=2,sp=2,ep=1,pp=1", "dp=2,sp=2"),
+    ("dp=2,tp=2,pp=2;slices=2", "dp=2,tp=2,pp=2;slices=2"),
 )
 
 #: specs the parser must REJECT (malformed grammar).
@@ -297,6 +389,8 @@ _MALFORMED_CASES = (
     "", "   ", ";slices=2", "dp", "dp=", "=4", "dp=4,", "dp=x",
     "dp=0", "dp=-2", "fsdp=*", "bogus=2", "dp=2,dp=4",
     "dp=2;slices=0", "dp=2;slices=x", "dp=2;foo=2", "dp=2;slices=",
+    "tp=*", "pp=*", "sp=*", "ep=*", "tp=0", "pp=-1", "sp=x",
+    "ep=", "dp=2,tp=2,tp=4", "dp=2,sp=1.5",
 )
 
 #: (spec, n_devices) pairs that parse but must fail validation.
@@ -305,6 +399,11 @@ _OVERCOMMITTED_CASES = (
     ("dp=4,fsdp=4", 8),
     ("dp=4;slices=4", 8),
     ("dp=3", 8),
+    ("tp=4,pp=4", 8),
+    ("dp=2,sp=8", 8),
+    ("dp=2,tp=2,ep=4", 8),
+    ("dp=*,pp=16", 8),
+    ("dp=2,tp=2;slices=4", 8),
 )
 
 # A hand-written program whose all-reduce groups ({0,1,2},{3,4,5},{6,7})

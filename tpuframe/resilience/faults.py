@@ -59,9 +59,11 @@ selects how many of the n hosts take the signal; ``once=1`` drops the
 fault on a *resumed* run (start_step > 0) so relaunch tests survive the
 step that killed them — the old ``TPUFRAME_FAULT_ONCE`` semantics.
 
-Back-compat: ``TPUFRAME_FAULT_STEP=N`` (+ ``TPUFRAME_FAULT_ONCE=1``)
-still works — it compiles into ``host:step=N:kind=crash[:once=1]`` with
-a one-line deprecation notice.
+The pre-grammar ``TPUFRAME_FAULT_STEP``/``TPUFRAME_FAULT_ONCE`` aliases
+are REMOVED: setting either raises at registry build with the
+``TPUFRAME_FAULTS`` spelling to use instead — a fault the operator
+thinks is armed but the registry silently ignores is the worst failure
+mode a chaos harness can have.
 
 No jax import: gcs and the launcher pull this in before any backend.
 """
@@ -292,25 +294,25 @@ class FaultRegistry:
 # ---------------------------------------------------------------------------
 
 _registry: FaultRegistry | None = None
-_warned_legacy = False
 
 
 def reset_from_env(env=os.environ) -> FaultRegistry:
-    """(Re)build the active registry from ``TPUFRAME_FAULTS`` plus the
-    legacy ``TPUFRAME_FAULT_STEP``/``TPUFRAME_FAULT_ONCE`` aliases."""
-    global _registry, _warned_legacy
-    faults = parse(env.get("TPUFRAME_FAULTS", ""))
-    legacy_step = int(env.get("TPUFRAME_FAULT_STEP", "0") or "0")
-    if legacy_step:
-        once = env.get("TPUFRAME_FAULT_ONCE") == "1"
-        if not _warned_legacy:
-            print(f"[tpuframe] TPUFRAME_FAULT_STEP is deprecated — use "
-                  f"TPUFRAME_FAULTS='host:step={legacy_step}:kind=crash"
-                  f"{':once=1' if once else ''}'", flush=True)
-            _warned_legacy = True
-        faults.append(Fault(seam="host", kind="crash", step=legacy_step,
-                            once=once))
-    _registry = FaultRegistry(faults)
+    """(Re)build the active registry from ``TPUFRAME_FAULTS``.
+
+    The removed ``TPUFRAME_FAULT_STEP``/``TPUFRAME_FAULT_ONCE`` aliases
+    raise loudly instead of being ignored: an operator who sets them
+    believes a fault is armed, and a chaos fault that silently never
+    fires turns every downstream resilience proof into a false pass."""
+    global _registry
+    for var in ("TPUFRAME_FAULT_STEP", "TPUFRAME_FAULT_ONCE"):
+        if env.get(var, "").strip():
+            step = env.get("TPUFRAME_FAULT_STEP", "N").strip() or "N"
+            once = ":once=1" if env.get("TPUFRAME_FAULT_ONCE") else ""
+            raise RuntimeError(
+                f"{var} was removed — spell the fault as "
+                f"TPUFRAME_FAULTS='host:step={step}:kind=crash{once}' "
+                f"(see tpuframe.resilience.faults for the grammar)")
+    _registry = FaultRegistry(parse(env.get("TPUFRAME_FAULTS", "")))
     return _registry
 
 

@@ -539,7 +539,11 @@ def _spawn_replica(rank: int, *, tmpdir: str, events_dir: str | None,
         "TPUFRAME_ATTEMPT": env.get("TPUFRAME_ATTEMPT", "0"),
     })
     env.pop("TPUFRAME_FAULTS", None)
+    # the removed legacy aliases now RAISE at registry build — scrub
+    # them so an operator shell that still exports one cannot take down
+    # a replica that never asked for a fault
     env.pop("TPUFRAME_FAULT_STEP", None)
+    env.pop("TPUFRAME_FAULT_ONCE", None)
     if events_dir:
         env["TPUFRAME_EVENTS_DIR"] = events_dir
     if faults_spec:

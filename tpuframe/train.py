@@ -174,6 +174,24 @@ def build_harness(cfg: TrainConfig) -> Harness:
     from tpuframe.parallel import pspec as pspec_lib
 
     spec, spec_source = pspec_lib.resolve()
+    if spec is None:
+        # Planner fallback: a `tune plan` winner (tune_db.json, family
+        # plan_spec) supplies the spec when neither an argument nor
+        # TPUFRAME_SPEC declared one — env > DB > default, the same
+        # precedence every other tuned knob resolves under.  Gated on a
+        # known target generation, so plain CPU test runs stay on the
+        # config's mesh.
+        from tpuframe.tune import db as tune_db
+
+        planned = tune_db.resolve_spec("train_lm_tiny")
+        if planned is not None:
+            try:
+                spec, spec_source = pspec_lib.parse_spec(planned), "plan"
+            except pspec_lib.SpecError as e:
+                raise pspec_lib.SpecError(
+                    f"tune_db.json plan_spec winner {planned!r} does not "
+                    f"parse: {e} — re-run `python -m tpuframe.tune plan` "
+                    f"or set TPUFRAME_SPEC to override") from e
     if spec is not None:
         cfg = cfg.with_overrides(mesh=spec.mesh_spec())
         if bootstrap.is_primary():
@@ -961,11 +979,11 @@ def _train_impl(cfg: TrainConfig, *, trace_dir: str | None = None,
               flush=True)
 
     # Structured fault injection (resilience/faults.py): TPUFRAME_FAULTS
-    # arms named seams; the legacy TPUFRAME_FAULT_STEP/_ONCE aliases still
-    # compile into a host-crash fault.  once=1 faults are dropped on a
-    # resumed run so relaunch/resume tests survive the step that killed
-    # them.  HANG_STEP/HANG_RANK stay env-level: the rank gate below needs
-    # jax.process_index().
+    # arms named seams (the removed TPUFRAME_FAULT_STEP/_ONCE aliases
+    # raise at registry build with the spelling to use).  once=1 faults
+    # are dropped on a resumed run so relaunch/resume tests survive the
+    # step that killed them.  HANG_STEP/HANG_RANK stay env-level: the
+    # rank gate below needs jax.process_index().
     faults_lib.set_resumed(h.start_step > 0)
     hang_step = int(os.environ.get("TPUFRAME_HANG_STEP", "0") or "0")
     hang_rank = int(os.environ.get("TPUFRAME_HANG_RANK", "-1") or "-1")

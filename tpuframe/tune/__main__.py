@@ -1,6 +1,7 @@
 """CLI for the offline autotuner.
 
     python -m tpuframe.tune sweep --topology v5e:2x2   # the whole thing
+    python -m tpuframe.tune plan                        # spec planner
     python -m tpuframe.tune sweep --remat               # remat policy search
     python -m tpuframe.tune sweep --serve               # serving decode grid
     python -m tpuframe.tune sweep --zero1               # weight-update sharding
@@ -75,6 +76,15 @@ def _cmd_sweep(args) -> int:
                  blocks=tuple(args.blocks),
                  bench_batches=tuple(args.bench_batches))
     return 0
+
+
+def _cmd_plan(args) -> int:
+    from tpuframe.tune import plan as plan_lib
+
+    report = plan_lib.plan(args.topology,
+                           slice_counts=tuple(args.slices),
+                           db_path=args.db, report_path=args.report)
+    return 0 if report.get("winner") else 1
 
 
 def _cmd_show(args) -> int:
@@ -153,6 +163,19 @@ def main(argv=None) -> int:
     sw.add_argument("--remat-policies", nargs="+", default=None,
                     metavar="POLICY")
     sw.set_defaults(fn=_cmd_sweep)
+
+    pl = sub.add_parser("plan", help="static auto-parallelism planner: "
+                                     "enumerate specs, AOT-compile on a "
+                                     "compile-only topology, gate on the "
+                                     "shardflow detectors, rank by the "
+                                     "cost stack")
+    pl.add_argument("--topology", default="v5e:2x2")
+    pl.add_argument("--slices", type=int, nargs="+", default=[1, 2],
+                    help="slice counts to plan over (DCN hierarchy)")
+    pl.add_argument("--db", default=None, help="tuning DB path "
+                    "(default: <repo>/tune_db.json)")
+    pl.add_argument("--report", default=None)
+    pl.set_defaults(fn=_cmd_plan)
 
     sh = sub.add_parser("show", help="print ranked DB contents")
     sh.add_argument("--db", default=None)

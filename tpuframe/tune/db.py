@@ -144,6 +144,8 @@ class Record:
             env["TPUFRAME_WEIGHT_UPDATE"] = str(cfg["weight_update"])
         if "wire_format" in cfg:
             env["TPUFRAME_WIRE_FORMAT"] = str(cfg["wire_format"])
+        if "spec" in cfg:
+            env["TPUFRAME_SPEC"] = str(cfg["spec"])
         if "decode_block" in cfg:
             env["TPUFRAME_DECODE_BLOCK"] = str(cfg["decode_block"])
         if cfg.get("prompt_buckets"):
@@ -432,6 +434,32 @@ def resolve_wire_format(program: str,
         return None
     fmt = rec.config.get("wire_format")
     return str(fmt) if fmt else None
+
+
+def resolve_spec(program: str,
+                 family: str = "plan_spec") -> str | None:
+    """Planned parallelism spec for ``program``: None unless the DB has a
+    ``tune plan`` winner for the target generation.  Callers apply
+    ``TPUFRAME_SPEC`` themselves FIRST via
+    :func:`tpuframe.parallel.pspec.resolve` — when the env var is set (or
+    an explicit spec argument was given) this returns None so the
+    override is unambiguous.  Returns the canonical spec string the
+    planner persisted (``config["spec"]``)."""
+    if os.environ.get("TPUFRAME_SPEC", "").strip():
+        return None
+    gen = target_generation()
+    if gen is None:
+        return None
+    db = _open_for_resolution()
+    if db is None:
+        return None
+    rec = db.best(program=program, family=family, generation=gen)
+    if rec is None:
+        rec = db.best(family=family, generation=gen)
+    if rec is None:
+        return None
+    spec = rec.config.get("spec")
+    return str(spec) if spec else None
 
 
 def resolve_decode_block(default: int = 128) -> int:

@@ -1,0 +1,488 @@
+"""Static auto-parallelism planner — ``python -m tpuframe.tune plan``.
+
+Closes ROADMAP's "turn strategy choice into a static analysis pass":
+enumerate the valid ``tpuframe.parallel.pspec`` layouts for a model ×
+device count × slice count, AOT-compile each on a compile-only TPU
+topology (no chip, no relay — the PERF §7 trick), run every shardflow
+structural detector as an ADMISSIBILITY gate, and rank the survivors by
+the analysis-v3 cost stack:
+
+  - roofline compute/HBM verdict of the compiled step
+    (``roofline.score_compiled`` — flops and bytes from cost_analysis),
+  - the ICI/DCN comm split priced by fabric
+    (``shardflow.comm_split`` -> ``roofline.comm_split_score``; a
+    collective whose replica groups span slices pays DCN bandwidth),
+  - overlap potential (how much of the wire time is hideable under
+    legally-interleavable compute),
+  - liveness peak-HBM vs the generation's capacity (``fits``).
+
+The objective is ``predicted_total_ms = step + t_ici + t_dcn`` — the
+same step-plus-wire objective the §20 wire sweep ranks on, extended
+with the DCN column.  The winner is persisted to ``tune_db.json``
+(family ``plan_spec``) under the standard env > DB > default
+resolution, so ``train.py`` consumes a planned spec unless
+``TPUFRAME_SPEC`` overrides it.
+
+The pinned, schema-versioned report (``perf/results/plan_report_*``)
+plus :func:`check`'s seeded ranking-drift positive make the planner a
+gate leg, not a demo: the checked-in ranking must be re-derivable from
+the checked-in rows, and the report must statically reproduce the
+pinned PERF verdicts (§18 replicated-vs-zero1 bytes, §20 fp-vs-int8
+totals, §23 DCN dominance on the composed spec) from cost models alone.
+
+Everything here is CPU-host only; jax is imported lazily (:func:`check`
+runs in the analysis gate, which must stay cheap when the report is
+merely validated, not regenerated).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+
+from tpuframe.tune import db as tune_db
+from tpuframe.tune import roofline
+
+#: Schema of the plan report — bump on any row/verdict shape change.
+PLAN_SCHEMA = 1
+
+#: The DB family the winner lands in (``db.resolve_spec`` reads it).
+PLAN_FAMILY = "plan_spec"
+
+#: The program tag planned specs are recorded under.
+PLAN_PROGRAM = "train_lm_tiny"
+
+
+def _log(msg, log=None):
+    (log or (lambda m: print(f"[plan] {m}", flush=True)))(msg)
+
+
+def default_report_path(topology: str = "v5e:2x2") -> str:
+    tag = topology.replace(":", "_").replace("x", "")
+    return os.path.join(tune_db.repo_root(), "perf", "results",
+                        f"plan_report_{tag}.json")
+
+
+def _scaled_topology(topology: str, n_slices: int) -> str:
+    """The compile topology for an ``n_slices``-slice candidate leg.
+
+    Multi-slice candidates compile on a SINGLE-slice topology carrying
+    the total chip count (``v5e:2x2`` x 2 slices -> ``v5e:2x4``), with
+    the ``slice`` axis declared logically in the mesh — the same
+    methodology as the §23 pin ("the slices are logical on this host").
+    A real ``num_slices>1`` compile-only topology lowers collectives
+    into per-slice partition IDs (2 replicas x 4 partitions whose
+    replica groups cover ``[0..3]`` twice), which the static
+    replica-group plane cannot attribute against the declared 8-device
+    mesh; the logical form keeps every group materializable and the
+    ICI/DCN split exact — ``comm_split`` still prices any collective
+    whose groups cross the declared slice boundary at DCN bandwidth."""
+    if n_slices <= 1:
+        return topology
+    base, _, dims = topology.partition(":")
+    parts = dims.split("x")
+    parts[-1] = str(int(parts[-1]) * n_slices)
+    return f"{base}:{'x'.join(parts)}"
+
+
+def enumerate_candidates(n_devices: int, n_slices: int = 1) -> list:
+    """The candidate grid for one (world size, slice count).
+
+    Specs are written with the ``dp=*`` wildcard so one grid serves any
+    world size; degrees that cannot fit ``n_devices`` are recorded as
+    skips by the sweep (the spec is for a different world), never
+    silently dropped.  Modifier candidates (zero1 / int8-block / adasum)
+    ride the plain-dp spec — they are step modifiers, not mesh axes."""
+    tail = f";slices={n_slices}" if n_slices > 1 else ""
+    cands = [
+        {"spec": "dp=*" + tail},
+        {"spec": "dp=*" + tail, "weight_update": "zero1"},
+        {"spec": "dp=*" + tail, "wire_format": "int8-block"},
+        {"spec": "dp=*" + tail, "weight_update": "zero1",
+         "wire_format": "int8-block"},
+        {"spec": "dp=*" + tail, "grad_reduce": "adasum"},
+        {"spec": "dp=*,fsdp=2" + tail},
+        {"spec": "dp=*,tp=2" + tail},
+        {"spec": "dp=*,tp=4" + tail},
+        {"spec": "dp=*,ep=2" + tail},
+        {"spec": "dp=*,sp=2" + tail, "seq_mode": "ring"},
+        {"spec": "dp=*,sp=2" + tail, "seq_mode": "ulysses"},
+        {"spec": "dp=*,pp=2" + tail},
+    ]
+    if n_slices > 1:
+        # The §23 composed acceptance spec: dp×fsdp inside each slice,
+        # replicated over the DCN slice axis.
+        cands.append({"spec": f"dp=2,fsdp=2;slices={n_slices}"})
+    return cands
+
+
+def _admissible(row: dict) -> bool:
+    return row.get("status") == "ok" and row.get("fits") is not False
+
+
+def rank_rows(rows: list) -> list:
+    """Deterministic ranking over admissible rows: lower predicted total
+    (step + ICI + DCN) wins, fewer wire bytes breaks ties, name is the
+    final total order.  Returns the ranked name list — re-derivable from
+    the report's own rows, which is what :func:`check` pins."""
+    adm = [r for r in rows if _admissible(r)]
+    adm.sort(key=lambda r: (r.get("predicted_total_ms") or float("inf"),
+                            r.get("comm_bytes") or 0, r["name"]))
+    return [r["name"] for r in adm]
+
+
+def _row(rows: list, name: str) -> dict | None:
+    for r in rows:
+        if r["name"] == name:
+            return r
+    return None
+
+
+def compute_verdicts(rows: list) -> dict:
+    """Re-derive the three pinned PERF verdicts from the candidate rows.
+
+    Pure arithmetic over the report — no jax, no recompile — so the
+    gate can re-check them against the stored booleans forever.  Each
+    verdict carries the numbers it compared; ``holds`` is whether the
+    pinned PERF direction reproduced.  A verdict whose required rows
+    are missing (capability skip) reports ``holds: None``."""
+    verdicts = {}
+
+    dp = _row(rows, "spec:dp=*")
+    zero1 = _row(rows, "spec:dp=*+zero1")
+    v = {"perf_section": 18,
+         "claim": "replicated dp moves fewer wire bytes than ZeRO-1 "
+                  "(rs+ag ~ 2x the all-reduce) — zero1 is a capacity "
+                  "lever, not a bytes one"}
+    if dp and zero1:
+        v.update(dp_comm_bytes=dp["comm_bytes"],
+                 zero1_comm_bytes=zero1["comm_bytes"],
+                 holds=dp["comm_bytes"] < zero1["comm_bytes"])
+    else:
+        v["holds"] = None
+    verdicts["zero1_bytes"] = v
+
+    fp = _row(rows, "spec:dp=*")
+    int8 = _row(rows, "spec:dp=*+int8-block")
+    v = {"perf_section": 20,
+         "claim": "at this scale the fp wire beats int8-block on the "
+                  "step+wire total: the quantize arithmetic lands in "
+                  "the step roofline and costs more than the saved "
+                  "bytes — the totals decide, the bytes alone do not"}
+    if fp and int8:
+        ratio = (int8["comm_bytes"] / fp["comm_bytes"]
+                 if fp["comm_bytes"] else None)
+        v.update(fp_total_ms=fp["predicted_total_ms"],
+                 int8_total_ms=int8["predicted_total_ms"],
+                 fp_comm_bytes=fp["comm_bytes"],
+                 int8_comm_bytes=int8["comm_bytes"],
+                 wire_bytes_ratio=round(ratio, 3) if ratio else None,
+                 holds=(fp["predicted_total_ms"]
+                        < int8["predicted_total_ms"]))
+    else:
+        v["holds"] = None
+    verdicts["wire_bytes"] = v
+
+    composed = None
+    for r in rows:
+        if r.get("slices", 1) > 1 and r["spec"].startswith("dp=2,fsdp=2"):
+            composed = r
+            break
+    v = {"perf_section": 23,
+         "claim": "on the composed dp×fsdp;slices=2 spec the DCN hop "
+                  "dominates the wire clock despite carrying fewer "
+                  "bytes than ICI (the ~32x bandwidth gap)"}
+    if composed and _admissible(composed):
+        v.update(ici_bytes=composed["ici_bytes"],
+                 dcn_bytes=composed["dcn_bytes"],
+                 t_ici_ms=composed["t_ici_ms"],
+                 t_dcn_ms=composed["t_dcn_ms"],
+                 holds=(composed["t_dcn_ms"] > composed["t_ici_ms"]
+                        and composed["dcn_bytes"] < composed["ici_bytes"]))
+    else:
+        v["holds"] = None
+    verdicts["dcn_split"] = v
+    return verdicts
+
+
+def plan(topology: str = "v5e:2x2", *, slice_counts=(1, 2),
+         db_path: str | None = None, report_path: str | None = None,
+         log=None) -> dict:
+    """Run the planner: enumerate, compile, gate, rank, persist."""
+    import jax  # noqa: F401 — fail fast before holding the lock
+
+    from tpuframe.analysis import shardflow, strategies
+    from tpuframe.parallel import pspec
+    from tpuframe.tune import search
+
+    search.hold_aot_lock()
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    gen = roofline.generation_from_topology(topology)
+    hw = roofline.get_hardware(gen)
+
+    rows: list = []
+    skips: list = []
+    for n_slices in slice_counts:
+        compile_topo = _scaled_topology(topology, n_slices)
+        try:
+            devices = pspec.topology_devices(compile_topo, slices=1)
+        except Exception as e:  # noqa: BLE001 — this jax may lack
+            skips.append({"slices": n_slices,       # the scaled shape
+                          "topology": compile_topo,
+                          "reason": f"{type(e).__name__}: {e}"[:300]})
+            _log(f"slices={n_slices}: topology {compile_topo} "
+                 f"unavailable ({type(e).__name__})", log)
+            continue
+        n = len(devices)
+        _log(f"slices={n_slices}: {n} compile-only devices "
+             f"({compile_topo}, slice axis logical)", log)
+        for cand in enumerate_candidates(n, n_slices):
+            audit = strategies.audit_spec(
+                cand["spec"], n_devices=n, devices=devices,
+                weight_update=cand.get("weight_update", "replicated"),
+                wire_format=cand.get("wire_format"),
+                seq_mode=cand.get("seq_mode"),
+                grad_reduce=cand.get("grad_reduce"))
+            base = {"name": audit.name, "spec": cand["spec"],
+                    "slices": n_slices, "n_devices": n,
+                    "compile_topology": compile_topo,
+                    "config": {k: v for k, v in cand.items()
+                               if k != "spec"}}
+            if audit.status == "unavailable":
+                base.update(status="skip", reason=audit.reason[:300])
+                skips.append(base)
+                _log(f"  {audit.name}: SKIP ({audit.reason[:70]})", log)
+                continue
+            flow = shardflow.audit_flow(audit, n_devices=n, drift=False)
+            # Admissibility is the STRUCTURAL shardflow plane (redundant
+            # pairs, wire dtypes, replication, replica groups, census,
+            # exposed comm).  The analytic CommBudget classes stay
+            # informational: they pin wire *patterns* to the registry's
+            # 8-CPU-device world, and the TPU backend legitimately
+            # lowers the same program differently at other world sizes
+            # (e.g. ZeRO-1 at n=4 becomes all-reduce + per-param
+            # all-gathers, which the class forbids) — that drift is a
+            # planner finding, not an inadmissible layout.
+            problems = list(flow["problems"])
+            pred = roofline.score_compiled(audit.compiled, gen)
+            split = roofline.comm_split_score(
+                gen, flow["comm_split"], n_devices=n, n_slices=n_slices)
+            # unrounded step roofline — the tiny model's differences
+            # live below score()'s 2-decimal rounding
+            t_step = max(pred["flops"] / hw.bf16_flops,
+                         pred["bytes"] / hw.hbm_bytes_per_s) * 1e3
+            total = t_step + split["t_ici_ms"] + split["t_dcn_ms"]
+            base.update(
+                status="ok" if not problems else "inadmissible",
+                detector_problems=problems,
+                budget_findings=list(audit.violations),
+                predicted_step_ms=round(t_step, 6),
+                t_ici_ms=split["t_ici_ms"],
+                t_dcn_ms=split["t_dcn_ms"],
+                ici_bytes=split["ici_bytes"],
+                dcn_bytes=split["dcn_bytes"],
+                comm_bytes=split["ici_bytes"] + split["dcn_bytes"],
+                predicted_total_ms=round(total, 6),
+                overlap_potential=flow["overlap"]["overlap_potential"],
+                bound=pred["bound"], fits=pred["fits"],
+                peak_memory_bytes=pred["peak_memory_bytes"])
+            rows.append(base)
+            _log(f"  {audit.name}: {base['status']} "
+                 f"total {base['predicted_total_ms']:.4f} ms "
+                 f"({base['comm_bytes']} wire B, "
+                 f"ici {base['t_ici_ms']} / dcn {base['t_dcn_ms']} ms)",
+                 log)
+
+    ranking = rank_rows(rows)
+    report = {
+        "schema": PLAN_SCHEMA,
+        "jax": _jax_version(),
+        "topology": topology,
+        "generation": gen,
+        "objective": "predicted_step_ms + t_ici_ms + t_dcn_ms "
+                     "(roofline step + comm split priced per fabric)",
+        "slice_counts": list(slice_counts),
+        "candidates": rows,
+        "skips": skips,
+        "ranking": ranking,
+        "winner": _row(rows, ranking[0]) if ranking else None,
+        "verdicts": compute_verdicts(rows),
+    }
+
+    if report["winner"] is not None:
+        db_path = db_path or tune_db.default_db_path()
+        db = tune_db.TuningDB.open(db_path) if os.path.exists(db_path) \
+            else tune_db.TuningDB(db_path)
+        win = report["winner"]
+        canonical = pspec.parse_spec(win["spec"]).canonical()
+        desc = {"program": PLAN_PROGRAM, "planner": "tune.plan",
+                "spec": canonical, "config": win["config"],
+                "slices": win["slices"], "n_devices": win["n_devices"]}
+        db.add({"program": PLAN_PROGRAM, "family": PLAN_FAMILY,
+                "fingerprint": tune_db.fingerprint(desc),
+                "topology": topology, "generation": gen,
+                "config": dict(win["config"], spec=canonical),
+                "predicted": {
+                    "predicted_ms": win["predicted_total_ms"],
+                    "comm_bytes": win["comm_bytes"],
+                    "overlap_potential": win["overlap_potential"],
+                    "source": "planned"}})
+        db.save()
+        _log(f"winner {win['name']} -> {db.path} "
+             f"(family {PLAN_FAMILY})", log)
+
+    report_path = report_path or default_report_path(topology)
+    os.makedirs(os.path.dirname(report_path), exist_ok=True)
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    _log(f"report: {report_path} ({len(rows)} scored, "
+         f"{len(skips)} skipped, winner "
+         f"{ranking[0] if ranking else 'none'})", log)
+    return report
+
+
+def _jax_version() -> str:
+    import jax
+
+    return jax.__version__
+
+
+# ---------------------------------------------------------------------------
+# Gate self-check: schema pin + re-derivable ranking + seeded
+# ranking-drift positive + the pinned-verdict smoke.  Pure JSON over the
+# checked-in report — jax is touched only for the version stamp.
+# ---------------------------------------------------------------------------
+
+_REQUIRED_REPORT_KEYS = ("schema", "jax", "topology", "generation",
+                         "candidates", "skips", "ranking", "winner",
+                         "verdicts")
+
+_REQUIRED_ROW_KEYS = ("name", "spec", "slices", "n_devices", "status",
+                      "detector_problems", "budget_findings",
+                      "predicted_step_ms",
+                      "t_ici_ms", "t_dcn_ms", "ici_bytes", "dcn_bytes",
+                      "comm_bytes", "predicted_total_ms",
+                      "overlap_potential", "bound", "fits")
+
+
+def _schema_problems(report: dict) -> list:
+    problems = []
+    if report.get("schema") != PLAN_SCHEMA:
+        problems.append(f"plan report schema {report.get('schema')!r} != "
+                        f"pinned {PLAN_SCHEMA}")
+        return problems
+    for k in _REQUIRED_REPORT_KEYS:
+        if k not in report:
+            problems.append(f"plan report missing key {k!r}")
+    for row in report.get("candidates", []):
+        for k in _REQUIRED_ROW_KEYS:
+            if k not in row:
+                problems.append(f"plan row {row.get('name')!r} missing "
+                                f"key {k!r}")
+                break
+    return problems
+
+
+def _ranking_problems(report: dict) -> list:
+    """The checked-in ranking must be re-derivable from the checked-in
+    rows, every ranked candidate must be detector-clean, and the winner
+    must be the top of the ranking."""
+    problems = []
+    rows = report.get("candidates", [])
+    ranking = report.get("ranking", [])
+    derived = rank_rows(rows)
+    if derived != ranking:
+        problems.append(f"plan ranking drift: report pins {ranking[:4]}"
+                        f"..., rows re-rank to {derived[:4]}...")
+    for name in ranking:
+        row = _row(rows, name)
+        if row is None:
+            problems.append(f"plan ranking names unknown row {name!r}")
+        elif row.get("detector_problems"):
+            problems.append(
+                f"plan ranked candidate {name!r} carries detector "
+                f"findings — admissibility gate leaked: "
+                f"{row['detector_problems'][:2]}")
+    winner = report.get("winner")
+    if ranking and (not winner or winner.get("name") != ranking[0]):
+        problems.append(f"plan winner {winner and winner.get('name')!r} "
+                        f"is not the ranking head {ranking[0]!r}")
+    return problems
+
+
+def _seeded_ranking_positive(report: dict) -> list:
+    """Corrupt a copy of the rows (swap the top two candidates' totals)
+    and require the ranking validator to notice — a validator that
+    cannot see a swapped ranking is blind, and the gate refuses to run
+    blind (the shardflow seeded-positive idiom)."""
+    rows = copy.deepcopy(report.get("candidates", []))
+    ranking = report.get("ranking", [])
+    if len(ranking) < 2:
+        return ["plan seeded positive: fewer than 2 admissible "
+                "candidates — the ranking cannot be cross-checked"]
+    a, b = _row(rows, ranking[0]), _row(rows, ranking[-1])
+    a["predicted_total_ms"], b["predicted_total_ms"] = (
+        b["predicted_total_ms"], a["predicted_total_ms"])
+    a["comm_bytes"], b["comm_bytes"] = b["comm_bytes"], a["comm_bytes"]
+    if rank_rows(rows) == ranking:
+        return ["plan seeded positive: swapping the best and worst "
+                "candidates' costs did not change the derived ranking — "
+                "the ranking-drift detector is blind"]
+    return []
+
+
+def _verdict_problems(report: dict) -> list:
+    """The pinned PERF verdicts must re-derive from the rows AND hold.
+    A verdict that stopped holding is a real finding (the cost stack or
+    the programs moved); a verdict whose stored booleans disagree with
+    the rows is a tampered report."""
+    problems = []
+    rows = report.get("candidates", [])
+    stored = report.get("verdicts", {})
+    fresh = compute_verdicts(rows)
+    for key, want in fresh.items():
+        got = stored.get(key)
+        if got is None:
+            problems.append(f"plan verdict {key!r} missing from report")
+            continue
+        if got.get("holds") != want.get("holds"):
+            problems.append(
+                f"plan verdict {key!r} stored holds={got.get('holds')} "
+                f"but rows re-derive holds={want.get('holds')} — report "
+                f"and rows disagree")
+        if want.get("holds") is False:
+            problems.append(
+                f"plan verdict {key!r} (PERF §{want.get('perf_section')}) "
+                f"does NOT hold on the pinned rows — the planner "
+                f"contradicts the pinned PERF verdict")
+    return problems
+
+
+def check(report_path: str | None = None) -> list:
+    """Gate leg: validate the pinned plan report.  Version-skew skip
+    follows ``--emit-budgets``: a report emitted by another jax is not a
+    finding (its compiled programs are pinned to that emitter), so the
+    check returns clean and the regenerate path re-pins."""
+    path = report_path or default_report_path()
+    if not os.path.exists(path):
+        return [f"plan report missing: {path} — run "
+                f"`python -m tpuframe.tune plan`"]
+    try:
+        with open(path) as f:
+            report = json.load(f)
+    except Exception as e:  # noqa: BLE001
+        return [f"plan report unreadable: {path} ({e})"]
+    problems = _schema_problems(report)
+    if problems:
+        return problems
+    try:
+        if report.get("jax") != _jax_version():
+            return []  # pinned to the emitting jax — skip, not a finding
+    except Exception:  # noqa: BLE001 — no jax here means pure-JSON mode
+        pass
+    problems += _ranking_problems(report)
+    problems += _seeded_ranking_positive(report)
+    problems += _verdict_problems(report)
+    return problems
