@@ -589,3 +589,176 @@ class TestFleetChaos:
                            ("replica_slow", "slow")):
             (g,) = faults.parse(seam)
             assert g.kind == kind
+
+
+class TestRollingUpdate:
+    """PR 17's chaos tier: a live weight rollout across the 3-replica
+    fleet under the same seeded burst load as TestFleetChaos, triggered
+    the production way — the harness "commits" a checkpoint mid-run
+    (manifest first, COMMIT last) and the controller's
+    ``committed_world()`` poll picks it up.
+
+    Proven, per ISSUE 17's acceptance bar:
+      * zero accepted-request loss straight through the roll (rid-exact
+        through the event stitcher);
+      * p99 TTFT during the roll <= 2x the same-seed steady-state run;
+      * every replica ends on the new version at ZERO compile-cache
+        misses (hot swap, not restart), with the mixed-version window
+        bounded and visible in fleet_stats;
+      * a seeded-slow poisoned canary auto-rolls back — rollout_abort
+        names the failing gate metric and the fleet returns to v0;
+      * a replica killed mid-swap (rc 42) is drained, its work
+        redispatched, and it relaunches on the NEW version — still
+        zero loss.
+    """
+
+    _N, _SEED = 36, 7
+    _ROLL = dict(replicas=3, n_requests=_N, seed=_SEED, slots=2,
+                 step_delay_ms=20.0, rate=1000.0,  # burst: all at t~0
+                 max_new_tokens=8, queue_limit=256, hedge_ms=5000.0,
+                 scrape_interval_s=0.05, timeout_s=90.0,
+                 canary_frac=0.34, bake_min_samples=4)
+
+    def _steady(self, events_dir):
+        from tpuframe.serve import router as router_lib
+
+        keys = ("replicas", "n_requests", "seed", "slots",
+                "step_delay_ms", "rate", "max_new_tokens", "queue_limit",
+                "hedge_ms", "scrape_interval_s", "timeout_s")
+        return router_lib.fleet_smoke(
+            events_dir=str(events_dir),
+            **{k: self._ROLL[k] for k in keys})
+
+    def _events_ok(self, events_dir):
+        files = events.event_files(str(events_dir))
+        assert files, "rollout run wrote no event files"
+        assert events.validate_files(files) == []
+        return events.merge(str(events_dir))
+
+    def _rid_exact(self, merged):
+        admits = [r["id"] for r in merged if r["type"] == "router_admit"]
+        dones = [r["id"] for r in merged if r["type"] == "router_request"]
+        assert sorted(admits) == sorted(set(admits))
+        assert sorted(dones) == sorted(admits)
+
+    def test_rolling_update_zero_loss_bounded_p99(self, tmp_path):
+        from tpuframe.serve import rollout as rollout_lib
+
+        steady = self._steady(tmp_path / "steady")
+        assert steady["lost"] == 0 and not steady["timed_out"]
+
+        watch = tmp_path / "ck"
+        watch.mkdir()
+        # Mid-commit checkpoint on disk BEFORE the fleet starts: the
+        # watcher must stay blind to it for the whole pre-trigger
+        # window (the harness lands COMMIT mid-load).
+        d = watch / "step_00000001"
+        d.mkdir()
+        (d / "manifest.json").write_text(
+            '{"step": 1, "world": {"processes": 1, "devices": 1}}')
+
+        out = rollout_lib.rolling_update_smoke(
+            events_dir=str(tmp_path / "roll"), watch_dir=str(watch),
+            gate_pct=50.0, **self._ROLL)
+        ro = out["rollout"]
+
+        # The roll completed the production way and nothing was lost.
+        assert ro["state"] == "done" and ro["version"] == 1
+        assert ro["world"]["step"] == 1
+        assert out["admitted"] == self._N and out["lost"] == 0
+        assert out["shed"] == 0 and not out["timed_out"]
+        # Every replica ended on the new version — live off each
+        # replica's own gauge, not the controller's belief.
+        assert out["final_versions"] == {"r0": 1, "r1": 1, "r2": 1}
+        # Hot swap, not restart: zero compile-cache misses, no relaunch.
+        assert ro["swap_compile_misses"] == 0
+        assert ro["relaunches"] == 0 and out["exit_codes"] == [0, 0, 0]
+        # Bounded mixed-version window: one replica at a time.
+        assert ro["window_s"] is not None and 0.0 < ro["window_s"] < 30.0
+
+        # p99 TTFT during the roll <= 2x steady state, same seed.
+        p99_a = steady["ttft_ms"]["p99"]
+        p99_b = out["ttft_ms"]["p99"]
+        assert p99_a > 0
+        assert p99_b <= 2.0 * p99_a, (
+            f"p99 TTFT {p99_b:.1f}ms during roll > 2x steady-state "
+            f"{p99_a:.1f}ms")
+
+        # rid-exactness and the typed rollout story in one stream.
+        merged = self._events_ok(tmp_path / "roll")
+        self._rid_exact(merged)
+        ro_steps = [r for r in merged if r["type"] == "rollout_step"]
+        assert [r for r in merged if r["type"] == "rollout_done"]
+        swapped = [r["replica"] for r in ro_steps
+                   if r["phase"] == "swapped"]
+        assert sorted(swapped) == ["r0", "r1", "r2"]
+        assert [r["replica"] for r in ro_steps
+                if r["phase"] == "promoted"] == ["r0"]
+
+        # The offline analyzers reconstruct the same bounded window.
+        fs = goodput.fleet_stats(merged)
+        assert fs["lost"] == 0
+        v = fs["versions"]
+        assert v["by_replica"] == {"r0": 1, "r1": 1, "r2": 1}
+        assert v["target"] == 1 and not v["aborted"]
+        assert 0.0 < v["mixed_window_s"] < 30.0
+
+    def test_poisoned_canary_auto_rolls_back(self, tmp_path):
+        from tpuframe.serve import rollout as rollout_lib
+
+        out = rollout_lib.rolling_update_smoke(
+            events_dir=str(tmp_path / "ev"), gate_pct=50.0,
+            faults_spec="slow_canary:times=1000:delay_s=0.05",
+            **self._ROLL)
+        ro = out["rollout"]
+
+        # The gate caught the regression and named the metric.
+        assert ro["state"] == "aborted" and ro["aborted"]
+        assert ro["abort_metric"] in rollout_lib.GATE_METRICS
+        # The fleet is back on the old version everywhere, and the
+        # canary's last phase is the rollback.
+        assert out["final_versions"] == {"r0": 0, "r1": 0, "r2": 0}
+        assert ro["phases"][-1] == ["r0", "rolled_back"] or \
+            tuple(ro["phases"][-1]) == ("r0", "rolled_back")
+        # Still zero loss: a rollback is a drain, not an outage.
+        assert out["admitted"] == self._N and out["lost"] == 0
+        assert not out["timed_out"] and out["exit_codes"] == [0, 0, 0]
+
+        merged = self._events_ok(tmp_path / "ev")
+        self._rid_exact(merged)
+        (abort,) = [r for r in merged if r["type"] == "rollout_abort"]
+        assert abort["metric"] == ro["abort_metric"]
+        assert abort["version"] == 1 and abort["reason"]
+        v = goodput.fleet_stats(merged)["versions"]
+        assert v["aborted"] and v["abort_metric"] == ro["abort_metric"]
+        assert v["by_replica"]["r0"] == 0
+
+    def test_mid_swap_kill_relaunches_on_new_version(self, tmp_path):
+        from tpuframe.serve import rollout as rollout_lib
+
+        out = rollout_lib.rolling_update_smoke(
+            events_dir=str(tmp_path / "ev"), gate_pct=50.0,
+            kill_during_swap_rank=1, **self._ROLL)
+        ro = out["rollout"]
+
+        # The kill was real (os._exit(42) inside swap application), the
+        # supervisor relaunched rank 1 on the NEW version, and the roll
+        # finished with every replica on it.
+        assert out["relaunched_ranks"] == [1]
+        assert ro["relaunches"] == 1
+        assert ro["state"] == "done" and ro["version"] == 1
+        assert out["final_versions"] == {"r0": 1, "r1": 1, "r2": 1}
+        # Zero accepted-request loss through drain + kill + relaunch.
+        assert out["admitted"] == self._N and out["lost"] == 0
+        assert out["shed"] == 0 and not out["timed_out"]
+
+        merged = self._events_ok(tmp_path / "ev")
+        self._rid_exact(merged)
+        ro_steps = [r for r in merged if r["type"] == "rollout_step"]
+        assert [r["replica"] for r in ro_steps
+                if r["phase"] == "swap_failed"] == ["r1"]
+        assert [r["replica"] for r in ro_steps
+                if r["phase"] == "relaunched"] == ["r1"]
+        # The relaunch participates in the mixed-version window.
+        v = goodput.fleet_stats(merged)["versions"]
+        assert v["by_replica"] == {"r0": 1, "r1": 1, "r2": 1}

@@ -1,0 +1,506 @@
+"""tpuframe.serve.rollout: live weight rollout, canary gating, rollback.
+
+Unit tier of PR 17 (the chaos-tier subprocess proofs live in
+tests/test_chaos.py::TestRollingUpdate):
+
+  - committed_world() hardening — the watch seam the controller polls:
+    a mid-commit dir, a quarantined ``step_N.corrupt`` and a torn
+    manifest are all invisible/None, so a partial upload can NEVER
+    trigger a rollout; a committed checkpoint from a different world
+    size is reported faithfully (serving params are world-invariant)
+  - LMEngine.swap_params — the ONE sanctioned swap seam validates tree
+    structure and leaf shapes/dtypes before rebinding
+  - swap_parity_check — a hot-swapped engine matches a cold-started one
+    token-for-token on every serve bucket, at zero new compile-cache
+    misses (the recompile-free floor, asserted not assumed)
+  - router version/canary plumbing — version gauge scraped into the
+    handle, the seeded canary traffic split, drain_replica/readmit
+  - gate_compare — the obs-compare rc contract (0 promote / 1 regress /
+    2 no overlap), participate-only-when-both
+  - the controller state machine on the in-process _SimFleet: phase
+    ordering, bounded mixed-version window accounting, poisoned-canary
+    auto-rollback naming the failing metric, starved-gate rollback
+    (never promote blind)
+  - fleet_stats rollout accounting from the typed events
+"""
+
+import json
+import os
+
+import pytest
+
+from tpuframe.ckpt.checkpoint import committed_world
+from tpuframe.obs import events, goodput
+from tpuframe.serve import rollout as rollout_lib
+from tpuframe.serve.rollout import (
+    GATE_METRICS,
+    RolloutController,
+    _drive_sim_rollout,
+    _SimFleet,
+    gate_compare,
+)
+from tpuframe.serve.router import Router
+
+
+@pytest.fixture(autouse=True)
+def _clean_rollout_env(monkeypatch):
+    for k in (rollout_lib.ENV_WATCH, rollout_lib.ENV_CANARY_FRAC,
+              rollout_lib.ENV_GATE):
+        monkeypatch.delenv(k, raising=False)
+    events.close()
+    yield
+    events.close()
+
+
+# ---------------------------------------------------------------------------
+# The watch seam: committed_world() hardening.
+# ---------------------------------------------------------------------------
+
+def _write_step(root, step, *, manifest=True, commit=True, world=None,
+                torn=False, suffix=""):
+    d = root / f"step_{step:08d}{suffix}"
+    d.mkdir(parents=True, exist_ok=True)
+    if manifest:
+        body = json.dumps({"step": step, "world": world or
+                           {"processes": 1, "devices": 1}})
+        if torn:
+            body = body[: len(body) // 2]
+        (d / "manifest.json").write_text(body)
+    if commit:
+        (d / "COMMIT").write_text("ok\n")
+    return d
+
+
+class TestCommittedWorldHardening:
+    def test_mid_commit_dir_is_invisible(self, tmp_path):
+        # Manifest present, COMMIT not yet written: an async save still
+        # uploading.  The peek must see NOTHING.
+        _write_step(tmp_path, 1, commit=False)
+        assert committed_world(str(tmp_path)) is None
+
+    def test_quarantined_corrupt_dir_is_invisible(self, tmp_path):
+        _write_step(tmp_path, 1, suffix=".corrupt")
+        assert committed_world(str(tmp_path)) is None
+        # ... and never shadows a good older step.
+        _write_step(tmp_path, 1)
+        _write_step(tmp_path, 2, suffix=".corrupt")
+        info = committed_world(str(tmp_path))
+        assert info is not None and info["step"] == 1
+
+    def test_torn_manifest_is_none_not_crash(self, tmp_path):
+        _write_step(tmp_path, 1, torn=True)
+        assert committed_world(str(tmp_path)) is None
+
+    def test_different_world_size_reported_faithfully(self, tmp_path):
+        # A checkpoint written by a 4-process/16-device trainer is a
+        # fine rollout source — serving params are replicated and
+        # reassemble world-size invariantly.  The peek reports it as-is.
+        _write_step(tmp_path, 3, world={"processes": 4, "devices": 16})
+        info = committed_world(str(tmp_path))
+        assert info == {"step": 3, "processes": 4, "devices": 16}
+
+    def test_watcher_never_triggers_on_partial_upload(self, tmp_path):
+        """Regression: the controller's poll over a directory holding
+        only a mid-commit dir / torn sidecar must never start a roll."""
+        fleet = _SimFleet(2)
+        router = Router(list(fleet.reps), transport=fleet.transport,
+                        scrape_interval_s=1e9)
+        ctl = RolloutController(router, transport=fleet.transport,
+                                watch_dir=str(tmp_path),
+                                watch_interval_s=0.0)
+        _write_step(tmp_path, 1, commit=False)          # mid-commit
+        _write_step(tmp_path, 2, torn=True)             # torn sidecar
+        _write_step(tmp_path, 3, suffix=".corrupt")     # quarantined
+        for _ in range(3):
+            assert ctl.tick() is False
+        assert ctl.state == "idle" and ctl.target is None
+        # A NEWER good checkpoint commits (COMMIT written last, like the
+        # real writer) -> triggers.  (Step 2's torn-but-committed
+        # sidecar keeps shadowing step 1: newest-committed is the only
+        # candidate, and unreadable-newest means "no rollout", never
+        # "fall back to an older version".)
+        _write_step(tmp_path, 4)
+        assert ctl.tick() is True
+        assert ctl.state == "rolling" and ctl.target == 4
+        assert ctl.world == {"step": 4, "processes": 1, "devices": 1}
+
+    def test_watcher_ignores_stale_and_current_versions(self, tmp_path):
+        fleet = _SimFleet(2)
+        router = Router(list(fleet.reps), transport=fleet.transport,
+                        scrape_interval_s=1e9)
+        ctl = RolloutController(router, transport=fleet.transport,
+                                watch_dir=str(tmp_path),
+                                watch_interval_s=0.0, current_version=5)
+        _write_step(tmp_path, 5)   # == current: no-op
+        _write_step(tmp_path, 4)   # older: no-op
+        assert ctl.tick() is False and ctl.state == "idle"
+
+
+# ---------------------------------------------------------------------------
+# The swap seam + hot-vs-cold parity (real engine, CPU).
+# ---------------------------------------------------------------------------
+
+class TestSwapSeam:
+    def _tiny_engine(self):
+        from tpuframe.models.transformer_lm import LMConfig
+        from tpuframe.serve.engine import LMEngine
+
+        cfg = LMConfig.tiny()
+        return cfg, LMEngine(cfg, slots=2, prompt_buckets=(16,),
+                             decode_block=16, max_context=48, seed=0)
+
+    def test_swap_params_rejects_wrong_tree(self):
+        _cfg, eng = self._tiny_engine()
+        with pytest.raises(ValueError, match="tree structure"):
+            eng.swap_params({"not": "the same tree"})
+
+    def test_swap_params_rejects_wrong_leaf_shape(self):
+        import jax
+
+        _cfg, eng = self._tiny_engine()
+        bad = jax.tree.map(lambda a: a[..., :1] if a.ndim else a,
+                           eng.params)
+        with pytest.raises(ValueError, match="compiled for"):
+            eng.swap_params(bad)
+
+    def test_swap_params_rebinds_matching_weights(self):
+        import jax
+        import jax.numpy as jnp
+
+        _cfg, eng = self._tiny_engine()
+        new = jax.tree.map(lambda a: jnp.zeros_like(a), eng.params)
+        eng.swap_params(new)
+        leaf = jax.tree.leaves(eng.params)[0]
+        assert float(jnp.abs(leaf).sum()) == 0.0
+
+    def test_hot_swap_matches_cold_start_at_zero_misses(self):
+        """Satellite 4: per serve bucket, a hot-swapped engine streams
+        the same tokens as an engine cold-started on the new weights —
+        and the swap itself costs zero compile-cache misses."""
+        from tpuframe.models.transformer_lm import LMConfig
+        from tpuframe.serve.engine import swap_parity_check
+
+        problems = swap_parity_check(LMConfig.tiny(), buckets=(16, 32),
+                                     decode_tokens=4, seed=0)
+        assert problems == []
+
+
+# ---------------------------------------------------------------------------
+# Router plumbing: version scrape, canary split, drain/readmit.
+# ---------------------------------------------------------------------------
+
+class TestRouterVersionAndCanary:
+    def _fleet_router(self, n=3, **kw):
+        fleet = _SimFleet(n)
+        kw.setdefault("scrape_interval_s", 0.0)
+        kw.setdefault("hedge_ms", 0.0)
+        router = Router(list(fleet.reps), transport=fleet.transport, **kw)
+        return fleet, router
+
+    def test_version_gauge_scraped_into_handle(self):
+        fleet, router = self._fleet_router(2)
+        router.step()
+        assert [rep.version for rep in router.replicas] == [0, 0]
+        # Replica 1 swaps; the next scrape sees it.
+        list(fleet.reps.values())[1]["version"] = 7
+        for rep in router.replicas:
+            rep.last_scrape_t = -1e18
+        router.step()
+        assert [rep.version for rep in router.replicas] == [0, 7]
+        assert router.summary()["versions"] == {"r0": 0, "r1": 7}
+
+    def test_canary_split_is_seeded_and_proportional(self):
+        _fleet, router = self._fleet_router(2,
+                                            max_inflight_per_replica=10**6)
+        router.set_canary("r0", 0.3, seed=123)
+        picks = [router._pick().name for _ in range(400)]
+        frac = picks.count("r0") / len(picks)
+        assert 0.2 < frac < 0.4
+        # Same seed -> identical sequence (deterministic traffic split).
+        router.set_canary("r0", 0.3, seed=123)
+        assert [router._pick().name for _ in range(400)] == picks
+
+    def test_canary_split_yields_to_availability(self):
+        # Canary armed but the non-canary pool has no capacity: traffic
+        # still flows (the split is a preference, not an outage).
+        _fleet, router = self._fleet_router(2)
+        router.set_canary("r0", 0.0, seed=1)   # all traffic to "rest"
+        router._replica("r1").state = "draining"
+        assert router._pick().name == "r0"
+
+    def test_drain_and_readmit_round_trip(self):
+        _fleet, router = self._fleet_router(2)
+        assert router.drain_replica("r0", reason="rollout:v1")
+        assert router._replica("r0").state == "draining"
+        assert router._pick().name == "r1"
+        assert router.readmit("r0")
+        assert router._replica("r0").state == "ok"
+        assert not router.drain_replica("nope", reason="x")
+        assert not router.readmit("nope")
+
+
+# ---------------------------------------------------------------------------
+# The promotion gate.
+# ---------------------------------------------------------------------------
+
+def _reqs(replica, ttft, tpot, n=8):
+    return [{"type": "router_request", "id": i, "replica": replica,
+             "ttft_ms": ttft} for i in range(n)] + \
+           [{"type": "serve_request", "id": i, "ttft_ms": ttft,
+             "tpot_ms": tpot, "output_tokens": 4} for i in range(n)]
+
+
+class TestGateCompare:
+    def test_rc0_on_parity(self):
+        rc, res = gate_compare(_reqs("r1", 10.0, 2.0),
+                               _reqs("r0", 10.5, 2.1), pct=25.0)
+        assert rc == 0
+        assert set(GATE_METRICS) <= set(res["metrics"])
+
+    def test_rc1_names_the_failing_metric(self):
+        rc, res = gate_compare(_reqs("r1", 10.0, 2.0),
+                               _reqs("r0", 40.0, 2.0), pct=25.0)
+        assert rc == 1
+        bad = [r["metric"] for r in res["regressions"]]
+        assert "serve_ttft_p90_ms" in bad and "router_ttft_p90_ms" in bad
+        assert "serve_tpot_p90_ms" not in bad
+
+    def test_rc2_when_either_side_is_blind(self):
+        assert gate_compare(_reqs("r1", 10.0, 2.0), [], pct=25.0)[0] == 2
+        assert gate_compare([], _reqs("r0", 10.0, 2.0), pct=25.0)[0] == 2
+
+    def test_participates_only_when_both_carry_tpot(self):
+        # Baseline without TPOT: a canary TPOT regression cannot fire —
+        # but TTFT still participates (per-metric, not per-stream).
+        base = [{"type": "router_request", "id": i, "replica": "r1",
+                 "ttft_ms": 10.0} for i in range(8)]
+        rc, res = gate_compare(base, _reqs("r0", 10.0, 99.0), pct=25.0)
+        assert rc == 0
+        assert "serve_tpot_p90_ms" not in res["metrics"]
+        assert "router_ttft_p90_ms" in res["metrics"]
+
+
+# ---------------------------------------------------------------------------
+# Controller state machine on the simulated fleet.
+# ---------------------------------------------------------------------------
+
+class TestControllerStateMachine:
+    def test_clean_roll_phase_order_and_versions(self):
+        ctl, router, fleet = _drive_sim_rollout(gate_pct=50.0)
+        assert ctl.state == "done"
+        assert {rep["version"] for rep in fleet.reps.values()} == {1}
+        assert ctl.swap_compile_misses == 0
+        assert ctl.window_s is not None and ctl.window_s >= 0.0
+        assert router.counters["admitted"] == router.counters["completed"]
+        by_rep: dict = {}
+        for _t, rep, phase in ctl.history:
+            by_rep.setdefault(rep, []).append(phase)
+        for rep, phases in by_rep.items():
+            core = [p for p in phases
+                    if p in ("drain", "swapped", "readmitted")]
+            assert core == ["drain", "swapped", "readmitted"], (rep, phases)
+        # Canary first, promoted exactly once, before the rest rolled.
+        flat = [(rep, ph) for _t, rep, ph in ctl.history]
+        assert flat[0][0] == "r0"
+        assert [p for _r, p in flat].count("promoted") == 1
+
+    def test_poisoned_canary_rolls_back_naming_metric(self):
+        ctl, _router, fleet = _drive_sim_rollout(poisoned_ttft_ms=500.0,
+                                                 gate_pct=50.0)
+        assert ctl.state == "aborted"
+        assert ctl.abort_metric in GATE_METRICS
+        assert {rep["version"] for rep in fleet.reps.values()} == {0}
+        # The canary was moved and moved BACK through the same seam.
+        canary_swaps = [v for url, v in fleet.swaps
+                        if url.endswith("/r0")]
+        assert canary_swaps == [1, 0]
+        phases = [p for _t, r, p in ctl.history if r == "r0"]
+        assert phases[-1] == "rolled_back"
+
+    def test_starved_gate_rolls_back_instead_of_promoting(self):
+        """A bake that never collects both sides must NOT promote."""
+        fleet = _SimFleet(2)
+        router = Router(list(fleet.reps), transport=fleet.transport,
+                        scrape_interval_s=0.0, hedge_ms=0.0)
+        clock = [0.0]
+        ctl = RolloutController(
+            router, transport=fleet.transport, clock=lambda: clock[0],
+            current_version=0, canary_frac=0.5, gate_pct=25.0,
+            bake_min_samples=5, bake_timeout_s=1.0, drain_timeout_s=10.0,
+            poll_interval_s=0.0)
+        ctl.start(1)
+        for _ in range(50):
+            if ctl.state == "bake":
+                break
+            clock[0] += 0.01
+            ctl.tick()
+        assert ctl.state == "bake"
+        clock[0] += 5.0          # deadline passes with zero traffic
+        for _ in range(20):
+            ctl.tick()
+            if ctl.done():
+                break
+        assert ctl.state == "aborted"
+        assert ctl.abort_metric == "insufficient_data"
+        assert {rep["version"] for rep in fleet.reps.values()} == {0}
+
+    def test_gate_disabled_promotes_without_bake(self):
+        ctl, _router, fleet = _drive_sim_rollout(gate_pct=0.0)
+        assert ctl.state == "done"
+        assert {rep["version"] for rep in fleet.reps.values()} == {1}
+
+    def test_single_replica_fleet_skips_canary(self):
+        ctl, _router, fleet = _drive_sim_rollout(n=1, gate_pct=50.0)
+        assert ctl.state == "done"
+        assert {rep["version"] for rep in fleet.reps.values()} == {1}
+        assert all(p != "promoted" for _t, _r, p in ctl.history)
+
+    def test_env_knob_resolution(self, monkeypatch):
+        monkeypatch.setenv(rollout_lib.ENV_CANARY_FRAC, "0.5")
+        monkeypatch.setenv(rollout_lib.ENV_GATE, "10")
+        monkeypatch.setenv(rollout_lib.ENV_WATCH, "/ck/dir")
+        assert rollout_lib.resolve_canary_frac() == 0.5
+        assert rollout_lib.resolve_gate_pct() == 10.0
+        assert rollout_lib.resolve_watch_dir() == "/ck/dir"
+        monkeypatch.setenv(rollout_lib.ENV_CANARY_FRAC, "junk")
+        monkeypatch.setenv(rollout_lib.ENV_GATE, "-3")
+        assert rollout_lib.resolve_canary_frac() == \
+            rollout_lib.DEFAULT_CANARY_FRAC
+        assert rollout_lib.resolve_gate_pct() == 0.0
+
+    def test_check_is_clean(self):
+        assert rollout_lib.check() == []
+
+
+# ---------------------------------------------------------------------------
+# Offline accounting: fleet_stats reads the rollout story back.
+# ---------------------------------------------------------------------------
+
+class TestFleetStatsRollout:
+    def _base(self, t, typ, **kw):
+        return {"t": t, "type": typ, **kw}
+
+    def test_mixed_window_and_versions(self):
+        evs = [
+            self._base(1.0, "router_admit", id=0),
+            self._base(1.1, "router_request", id=0, replica="r0",
+                       ttft_ms=5.0),
+            self._base(2.0, "rollout_step", replica="r0", version=1,
+                       phase="swapped"),
+            self._base(2.5, "rollout_step", replica="r1", version=1,
+                       phase="relaunched"),
+            self._base(3.0, "rollout_step", replica="r2", version=1,
+                       phase="swapped"),
+            self._base(3.1, "rollout_done", version=1, replicas=3),
+        ]
+        fs = goodput.fleet_stats(evs)
+        v = fs["versions"]
+        assert v["by_replica"] == {"r0": 1, "r1": 1, "r2": 1}
+        assert v["target"] == 1 and not v["aborted"]
+        assert v["mixed_window_s"] == 1.0
+
+    def test_abort_and_rollback_accounting(self):
+        evs = [
+            self._base(1.0, "router_admit", id=0),
+            self._base(1.1, "router_request", id=0, replica="r1",
+                       ttft_ms=5.0),
+            self._base(2.0, "rollout_step", replica="r0", version=1,
+                       phase="swapped"),
+            self._base(3.0, "rollout_abort", version=1,
+                       metric="serve_ttft_p90_ms", reason="regressed"),
+            self._base(3.5, "rollout_step", replica="r0", version=0,
+                       phase="rolled_back"),
+        ]
+        v = goodput.fleet_stats(evs)["versions"]
+        assert v["aborted"] and v["abort_metric"] == "serve_ttft_p90_ms"
+        # rolled_back updates the replica's version but must NOT widen
+        # the mixed window (only swapped/relaunched timestamps do).
+        assert v["by_replica"] == {"r0": 0}
+        assert v["mixed_window_s"] == 0.0
+
+    def test_no_rollout_traffic_keeps_versions_none(self):
+        evs = [self._base(1.0, "router_admit", id=0),
+               self._base(1.1, "router_request", id=0, replica="r0",
+                          ttft_ms=5.0)]
+        assert goodput.fleet_stats(evs)["versions"] is None
+
+    def test_rollout_events_schema_registered(self):
+        for etype in rollout_lib.ROLLOUT_EVENT_TYPES:
+            assert etype in events.REQUIRED_FIELDS
+
+
+# ---------------------------------------------------------------------------
+# Fault seams (satellite 1's grammar half).
+# ---------------------------------------------------------------------------
+
+def test_rollout_fault_seams_are_deterministic():
+    from tpuframe.resilience import faults
+
+    (f,) = faults.parse("slow_canary:times=1000:delay_s=0.05")
+    assert f.kind == "slow" and f.times == 1000 and f.delay_s == 0.05
+    (g,) = faults.parse("crash_during_swap:rank=1")
+    assert g.kind == "crash" and g.rank == 1
+    for seam, kind in (("slow_canary", "slow"),
+                       ("crash_during_swap", "crash")):
+        (h,) = faults.parse(seam)
+        assert h.kind == kind
+
+
+# ---------------------------------------------------------------------------
+# TF121: the live weight-swap seam lint (satellite 6).
+# ---------------------------------------------------------------------------
+
+class TestTF121:
+    RAW = "def apply(engine, p):\n    engine.params = p\n"
+
+    def _lint(self, src, path):
+        from tpuframe.analysis import source_lint
+
+        return [f for f in source_lint.lint_source(src, path)
+                if f.rule == "TF121"]
+
+    def test_raw_params_write_flagged_in_rollout(self):
+        assert len(self._lint(self.RAW,
+                              "tpuframe/serve/rollout.py")) == 1
+
+    def test_raw_params_write_flagged_in_replica(self):
+        assert len(self._lint(self.RAW,
+                              "tpuframe/serve/replica.py")) == 1
+
+    def test_setattr_spelling_flagged(self):
+        src = "def apply(e, p):\n    setattr(e, 'params', p)\n"
+        assert len(self._lint(src, "tpuframe/serve/rollout.py")) == 1
+
+    def test_augassign_flagged(self):
+        src = "def nudge(e, d):\n    e.params += d\n"
+        assert len(self._lint(src, "tpuframe/serve/replica.py")) == 1
+
+    def test_sanctioned_swap_call_clean(self):
+        src = "def apply(engine, p):\n    engine.swap_params(p)\n"
+        assert self._lint(src, "tpuframe/serve/rollout.py") == []
+
+    def test_engine_hosts_the_seam(self):
+        # engine.py IS the seam — swap_params' own `self.params = ...`
+        # must not be in scope (and nor is any other module).
+        assert self._lint(self.RAW, "tpuframe/serve/engine.py") == []
+        assert self._lint(self.RAW, "tpuframe/train.py") == []
+
+    def test_reading_params_is_fine(self):
+        src = ("def misses(engine):\n"
+               "    leaves = engine.params\n"
+               "    return leaves\n")
+        assert self._lint(src, "tpuframe/serve/rollout.py") == []
+
+    def test_suppression_honoured(self):
+        src = ("def fixture(e, p):\n"
+               "    e.params = p  # tf-lint: ok[TF121]\n")
+        assert self._lint(src, "tpuframe/serve/rollout.py") == []
+
+    def test_tree_is_clean(self):
+        from pathlib import Path
+
+        from tpuframe.analysis import source_lint
+
+        findings = [f for f in source_lint.lint_paths(
+            [Path("tpuframe")]) if f.rule == "TF121"]
+        assert findings == [], "\n".join(map(str, findings))

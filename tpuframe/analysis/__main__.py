@@ -43,7 +43,15 @@ non-zero on any finding:
      ``--compare`` contract (schema keys, rc codes, the schedule
      section), so a report-schema change that strands the differ fails
      CI before it ships;
-  10. plan self-check — the pinned ``tune plan`` report
+  10. rollout self-check — the live-rollout controller
+      (:mod:`tpuframe.serve.rollout`) replays its full state machine on
+      a simulated fleet (drain→swap→readmit ordering, zero loss, zero
+      compile misses, all replicas on the target version), runs the
+      TF121 swap-seam lint over the tree, checks the rollout event
+      registrations and the ``gate_compare`` rc contract, and seeds a
+      poisoned canary that MUST auto-roll back naming the failing
+      metric — the promotion gate refuses to run blind;
+  11. plan self-check — the pinned ``tune plan`` report
      (``perf/results/plan_report_*``) must schema-validate, its ranking
      must re-derive from its own rows with every ranked candidate
      detector-clean, a seeded best/worst cost swap must flip the
@@ -56,7 +64,7 @@ non-zero on any finding:
 ``--compare A.json B.json`` diffs two such reports for structural
 collective regressions (rc 1 regression / 0 clean / 2 no overlap — the
 ``obs compare`` contract) without touching jax at all; ``--selfcheck``
-runs only legs 9 and 10 (jax-free but for the version stamp).
+runs only legs 9 and 11 (jax-free but for the version stamp).
 
 Strategies this interpreter cannot express (see
 :class:`~tpuframe.analysis.strategies.Unavailable`) print as SKIP and do
@@ -305,6 +313,16 @@ def _run_router_check() -> int:
     return len(problems)
 
 
+def _run_rollout_check() -> int:
+    from tpuframe.serve import rollout
+
+    problems = rollout.check()
+    for p in problems:
+        print(f"ROLLOUT {p}")
+    print(f"[analysis] rollout self-check: {len(problems)} problem(s)")
+    return len(problems)
+
+
 def _run_obs_check() -> int:
     # Through the real CLI entry point, not an import — the gate then
     # also catches a broken ``python -m tpuframe.obs`` invocation.
@@ -388,6 +406,7 @@ def main(argv=None) -> int:
         n_findings += _run_mem_check()
         n_findings += _run_serve_check()
         n_findings += _run_router_check()
+        n_findings += _run_rollout_check()
         n_findings += _run_zero1_check()
         n_findings += _run_elastic_check()
         n_findings += _run_quantwire_check()

@@ -147,6 +147,16 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          record is no longer auto-derived from the grammar and the
          planner cannot enumerate it.  Out-of-repo experiment plugins
          suppress with ``# tf-lint: ok[TF120]`` and a reason.
+  TF121  live weight mutation outside the sanctioned swap seam — an
+         assignment to (or ``setattr`` of) a ``.params`` attribute in
+         the rollout-bearing modules (``serve/rollout.py``,
+         ``serve/replica.py``).  ``LMEngine.swap_params()`` is the ONE
+         way live weights change: it validates tree structure and
+         leaf shapes/dtypes against what the AOT table was compiled
+         for, so the zero-recompile hot-swap floor holds by
+         construction.  A raw ``engine.params = ...`` skips that check
+         and can silently poison every compiled program; test fixtures
+         suppress with ``# tf-lint: ok[TF121]`` and a reason.
 
 Scope: TF101/TF102 only fire *inside functions known to be traced*
 (decorated with ``jax.jit``/``pmap``/``shard_map`` or passed to
@@ -223,6 +233,11 @@ RULES = {
              "write) outside analysis/strategies.py's "
              "register_spec_strategy seam — a hand-wired builder "
              "bypasses spec lowering and the planner's enumeration",
+    "TF121": "live weight mutation (.params assignment / setattr) in "
+             "the rollout modules (serve/rollout.py, serve/replica.py) "
+             "outside the engine.swap_params() seam — skips the "
+             "tree/shape/dtype validation that keeps hot swaps "
+             "recompile-free",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -357,6 +372,13 @@ _MESH_EXEMPT_SUFFIXES = ("parallel/mesh.py", "parallel/pspec.py")
 # entry goes through register_spec_strategy so its budget/schedule
 # record derives from the spec grammar and `tune plan` can enumerate it.
 _STRATEGY_EXEMPT_SUFFIXES = ("analysis/strategies.py",)
+
+# TF121: the live weight-swap seam.  engine.py hosts swap_params() (the
+# validating setter); the rollout-bearing modules above it must never
+# rebind a ``.params`` attribute directly — that is exactly the bypass
+# that turns a checkpoint from the wrong model into a silent poisoning
+# of every compiled program.
+_SWAP_SCOPE_SUFFIXES = ("serve/rollout.py", "serve/replica.py")
 _NET_CALL_DOTTED = {"socket.socket", "socket.create_connection"}
 _NET_CALL_TAILS = {"urlopen", "HTTPConnection", "HTTPSConnection"}
 
@@ -570,6 +592,7 @@ class FileContext:
         self.mesh_scope = not norm.endswith(_MESH_EXEMPT_SUFFIXES)
         self.strategy_scope = not norm.endswith(
             _STRATEGY_EXEMPT_SUFFIXES)
+        self.swap_scope = norm.endswith(_SWAP_SCOPE_SUFFIXES)
         self.lock_scope = any(p in norm for p in _LOCK_DISCIPLINE_PARTS)
         self.wire_scope = norm.endswith(_WIRE_SEAM_SUFFIXES)
         self.world_scope = not any(p in norm
@@ -1024,6 +1047,44 @@ def _tf120_strategy_seam(ctx: FileContext, node, fn):
                          "spec), or suppress with tf-lint: ok[TF120] "
                          "and a reason", fn)
                 return
+
+
+@_node_rule
+def _tf121_swap_seam(ctx: FileContext, node, fn):
+    """Live weights mutated behind the swap seam's back: an assignment
+    to any ``.params`` attribute — or a ``setattr(x, "params", ...)`` —
+    inside the rollout-bearing modules.  The engine's ``swap_params()``
+    is the one sanctioned setter because it validates the incoming tree
+    structure and every leaf's shape/dtype against what the AOT table
+    was compiled for; a raw rebind skips that and the compile-cache
+    hit floor (and worse, numerical sanity) silently goes with it."""
+    if not ctx.swap_scope:
+        return
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for tgt in targets:
+            if isinstance(tgt, ast.Attribute) and tgt.attr == "params":
+                ctx.emit(
+                    "TF121", node,
+                    f"direct write to `{_dotted(tgt)}` bypasses the "
+                    f"validating swap seam — go through "
+                    f"engine.swap_params(new_params) (checks tree "
+                    f"structure and leaf shapes/dtypes against the "
+                    f"compiled AOT table), or suppress with tf-lint: "
+                    f"ok[TF121] and a reason", fn)
+                return
+        return
+    if isinstance(node, ast.Call):
+        callee = _dotted(node.func)
+        if (callee.rsplit(".", 1)[-1] == "setattr" and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and node.args[1].value == "params"):
+            ctx.emit(
+                "TF121", node,
+                "setattr(..., \"params\", ...) bypasses the validating "
+                "swap seam — go through engine.swap_params(new_params), "
+                "or suppress with tf-lint: ok[TF121] and a reason", fn)
 
 
 @_fn_rule

@@ -75,6 +75,14 @@ Event types (see ``REQUIRED_FIELDS`` for the per-type contract):
                  TTFT ms, winning replica, output tokens)
   router_summary end-of-run fleet rollup (completed/shed/hedged/
                  redispatched counts, replicas seen)
+  rollout_step   the rollout controller moved one replica through one
+                 phase of a rolling weight update (replica, target
+                 version, phase ∈ drain/swapped/swap_failed/relaunched/
+                 readmitted/promoted/rolled_back)
+  rollout_done   a rolling update completed: every replica is on the
+                 new version (version, replicas, mixed-version window s)
+  rollout_abort  the rollout was rolled back — the canary gate caught a
+                 regression (version, the failing metric, reason)
   ============== ========================================================
 
 Emission is *best-effort everywhere*: ``emit()`` is a no-op until
@@ -139,6 +147,9 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "router_drain": ("replica", "reason"),
     "router_request": ("id", "replica", "ttft_ms"),
     "router_summary": ("requests", "shed"),
+    "rollout_step": ("replica", "version", "phase"),
+    "rollout_done": ("version", "replicas"),
+    "rollout_abort": ("version", "metric", "reason"),
 }
 
 _ENVELOPE = ("schema", "type", "t", "host", "proc", "attempt")

@@ -563,6 +563,36 @@ def fleet_stats(events: list) -> dict | None:
     for r in done:
         name = str(r.get("replica"))
         by_replica[name] = by_replica.get(name, 0) + 1
+
+    # Live-rollout accounting (PR 17): final per-replica weights version
+    # and the mixed-version window — first replica on the new version to
+    # last replica on it (the boundedness the rollout controller
+    # proves).  None when the log carries no rollout traffic.
+    versions = None
+    ro_steps = [r for r in events if r.get("type") == "rollout_step"]
+    ro_done = next((r for r in reversed(events)
+                    if r.get("type") == "rollout_done"), None)
+    ro_abort = next((r for r in reversed(events)
+                     if r.get("type") == "rollout_abort"), None)
+    if ro_steps or ro_done or ro_abort:
+        by_rep_version: dict = {}
+        swap_ts = []
+        for r in ro_steps:
+            phase = r.get("phase")
+            if phase in ("swapped", "relaunched"):
+                by_rep_version[str(r.get("replica"))] = r.get("version")
+                if r.get("t") is not None:
+                    swap_ts.append(float(r["t"]))
+            elif phase == "rolled_back":
+                by_rep_version[str(r.get("replica"))] = r.get("version")
+        versions = {
+            "by_replica": dict(sorted(by_rep_version.items())),
+            "target": (ro_done or ro_abort or {}).get("version"),
+            "mixed_window_s": round(max(swap_ts) - min(swap_ts), 3)
+            if len(swap_ts) >= 2 else 0.0,
+            "aborted": ro_abort is not None,
+            "abort_metric": ro_abort.get("metric") if ro_abort else None,
+        }
     return {
         "requests": len(done),
         "admitted": admits,
@@ -573,6 +603,7 @@ def fleet_stats(events: list) -> dict | None:
         "drains": [{"replica": r.get("replica"),
                     "reason": r.get("reason")} for r in drains],
         "by_replica": dict(sorted(by_replica.items())),
+        "versions": versions,
         "ttft_ms": {q: round(_pct(ttft, v), 3) for q, v in
                     (("p50", 0.5), ("p90", 0.9), ("p99", 0.99))}
         if ttft else None,

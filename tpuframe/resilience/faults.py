@@ -40,6 +40,17 @@ Seams are named injection points the framework calls into:
                 serving, so ``/healthz`` flips 503 (the stall model)
   replica_slow  same seam, default kind ``slow`` — a straggler replica
                 (sleeps ``delay_s``; the hedging model)
+  slow_canary   the replica main loop, once per iteration, but ONLY
+                while the replica is serving a weights version it was
+                not launched with (default kind ``slow`` — the poisoned-
+                canary model: the new version is slower than the old,
+                and the rollout gate must catch it and roll back)
+  crash_during_swap
+                inside the replica's weight-swap application, after the
+                swap was accepted but before the new version is live
+                (default kind ``crash`` — proves a replica killed
+                mid-swap is drained, redispatched and relaunched on the
+                NEW version with zero admitted-request loss)
   ============  ======================================================
 
 Kinds: ``ioerror`` (raise a retryable :class:`InjectedFault`), ``slow``
@@ -80,7 +91,7 @@ _KINDS = ("ioerror", "slow", "corrupt", "torn", "crash", "sigterm",
 _SEAMS = ("gcs_read", "gcs_write", "gcs_list", "gcs_stat", "gcs_delete",
           "ckpt_shard", "host", "slow_gcs", "crash_during_upload",
           "sigterm_pending_upload", "replica_crash", "replica_hang",
-          "replica_slow")
+          "replica_slow", "slow_canary", "crash_during_swap")
 # The checkpoint-pipeline seams read more naturally with their purpose as
 # the default kind — ``slow_gcs`` without ``:kind=`` means slow, not a
 # spelled-the-seam-name-but-raises-ioerror surprise.  Same for the
@@ -88,7 +99,8 @@ _SEAMS = ("gcs_read", "gcs_write", "gcs_list", "gcs_stat", "gcs_delete",
 _SEAM_DEFAULT_KIND = {"slow_gcs": "slow", "crash_during_upload": "crash",
                       "sigterm_pending_upload": "sigterm",
                       "replica_crash": "crash", "replica_hang": "hang",
-                      "replica_slow": "slow"}
+                      "replica_slow": "slow", "slow_canary": "slow",
+                      "crash_during_swap": "crash"}
 _CRASH_RC = 42
 
 

@@ -205,6 +205,38 @@ class LMEngine:
         table["insert"] = self._insert
         return table
 
+    def swap_params(self, new_params) -> None:
+        """The ONE sanctioned live weight-swap seam (lint TF121).
+
+        Hot-swaps the served weights without touching the AOT table:
+        every executable takes ``params`` as a call argument, so
+        rebinding the attribute is the whole swap — zero recompiles by
+        construction, which is exactly the compile-cache hit floor the
+        rollout controller asserts.  The new tree must match the old one
+        leaf-for-leaf in shape and dtype (a serving fleet's params are
+        replicated, so a checkpoint written at a different world size
+        reassembles to this same replicated tree — the world-size
+        invariance the elastic restore path guarantees; only the flat
+        ZeRO-1 *optimizer* moments ever reshard, and serving never
+        loads those).  A mismatched tree means the checkpoint is for a
+        different model: refuse loudly rather than serve garbage."""
+        import jax
+
+        old_leaves, old_def = jax.tree.flatten(self.params)
+        new_leaves, new_def = jax.tree.flatten(new_params)
+        if old_def != new_def:
+            raise ValueError(
+                "swap_params: new weights have a different tree "
+                "structure — this checkpoint is not for this model")
+        for i, (a, b) in enumerate(zip(old_leaves, new_leaves)):
+            if a.shape != b.shape or a.dtype != b.dtype:
+                raise ValueError(
+                    f"swap_params: leaf {i} is {b.shape}/{b.dtype}, "
+                    f"engine compiled for {a.shape}/{a.dtype} — a "
+                    f"shape-changing update needs a new engine, not a "
+                    f"hot swap")
+        self.params = new_params
+
     # --- serving ops -------------------------------------------------------
 
     def prefill(self, token_ids) -> tuple:
@@ -299,6 +331,64 @@ class BertClassifier:
         probs = np.asarray(self._classify[bucket](
             self.params, jnp.asarray(padded), jnp.asarray(mask))[0])
         return int(probs.argmax()), probs
+
+
+def swap_parity_check(cfg, *, buckets, decode_tokens: int = 4,
+                      seed: int = 0, decode_block: int = 16) -> list:
+    """The hot-swap analogue of :func:`golden_parity_check`: an engine
+    swapped onto new weights must produce, for every serve bucket (full
+    and ragged prompt), exactly the token streams of an engine
+    cold-started on those weights — AND the swap itself must cost zero
+    compile-cache misses (the AOT table is untouched; params are call
+    arguments).  Returns problem strings; [] means the swap is
+    transparent."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpuframe.obs import metrics
+
+    buckets = tuple(sorted(buckets))
+    max_context = max(buckets) + decode_tokens + decode_block
+    hot = LMEngine(cfg, slots=2, prompt_buckets=buckets,
+                   decode_block=decode_block, max_context=max_context,
+                   seed=seed)
+    new_params = hot.model.init(
+        jax.random.key(seed + 1),
+        jnp.zeros((1, min(buckets)), jnp.int32))["params"]
+    cold = LMEngine(cfg, new_params, slots=2, prompt_buckets=buckets,
+                    decode_block=decode_block, max_context=max_context)
+
+    misses_before = metrics.counters().get("compile_cache.misses", 0)
+    hot.swap_params(new_params)
+
+    problems = []
+
+    def stream(engine, ids):
+        engine.reset()
+        first, pcache, length = engine.prefill(ids)
+        engine.insert(0, pcache, length, first)
+        toks = [first]
+        for _ in range(decode_tokens):
+            toks.append(int(engine.decode_step()[0]))
+        return toks
+
+    for bucket in buckets:
+        for prompt_len in sorted({bucket, max(2, bucket - 3)}):
+            ids = [int(t) for t in jax.random.randint(
+                jax.random.key(seed + bucket + prompt_len),
+                (prompt_len,), 0, cfg.vocab_size)]
+            got, want = stream(hot, ids), stream(cold, ids)
+            if got != want:
+                problems.append(
+                    f"bucket {bucket} prompt_len {prompt_len}: "
+                    f"hot-swapped stream {got} != cold-start {want}")
+
+    misses_after = metrics.counters().get("compile_cache.misses", 0)
+    if misses_after != misses_before:
+        problems.append(
+            f"swap cost {misses_after - misses_before} compile-cache "
+            f"miss(es) — the hot-swap path must never recompile")
+    return problems
 
 
 # ---------------------------------------------------------------------------

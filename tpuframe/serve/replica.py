@@ -13,6 +13,15 @@ TF113):
   ``/generate``  POST ``{"rid", "prompt", "max_new_tokens"}`` → blocks
                  until the scheduler retires the request, returns
                  ``{"rid", "tokens", "ttft_ms", "tpot_ms", "proc"}``
+  ``/swap_weights``
+                 POST ``{"version"[, "seed"]}`` → blocks until the main
+                 loop applies the hot swap through the engine's
+                 sanctioned ``swap_params`` seam (TF121), returns
+                 ``{"version", "compile_cache_misses"}``.  The replica
+                 also publishes the label-free
+                 ``tpuframe_weights_version`` gauge on ``/metrics`` —
+                 the router scrapes it, which is how the rollout
+                 controller proves the mixed-version window is bounded.
 
 Threading contract: the exporter's HTTP worker threads only parse,
 enqueue into the inbox and wait on an event — the *main* thread is the
@@ -32,7 +41,13 @@ Chaos seams (``resilience/faults.py``): the step loop fires
 ``replica_slow`` / ``replica_hang`` / ``replica_crash`` once per
 iteration with the fault step pinned to the scheduler step count, so
 ``TPUFRAME_FAULTS="replica_crash:step=3:rank=1"`` deterministically
-kills replica 1 after its third scheduler step.
+kills replica 1 after its third scheduler step.  Two rollout seams ride
+the same loop: ``slow_canary`` fires per iteration but ONLY while the
+replica serves a weights version it was not launched with (the
+poisoned-canary model — armed fleet-wide, it slows exactly the canary),
+and ``crash_during_swap`` fires inside the swap application, after the
+swap was accepted but before the new version is live (the mid-swap
+kill the supervisor must relaunch on the NEW version).
 
 The :class:`FakeEngine` is the pure-host stand-in for fleet tests and
 the selfcheck smoke: deterministic token streams that are a function of
@@ -60,6 +75,16 @@ READY_PREFIX = "TPUFRAME_REPLICA_READY"
 
 # Fired once per main-loop iteration, cheap no-ops unless armed.
 _FAULT_SEAMS = ("replica_slow", "replica_hang", "replica_crash")
+
+
+def _compile_misses() -> int:
+    """The compile-cache miss counter without forcing a jax import (the
+    FakeEngine replica stays jax-free): the counter only exists once
+    ``tpuframe.obs.metrics`` is loaded, which any real engine pulls in."""
+    mod = sys.modules.get("tpuframe.obs.metrics")
+    if mod is None:
+        return 0
+    return int(mod.counters().get("compile_cache.misses", 0))
 
 
 class FakeEngine:
@@ -108,7 +133,8 @@ class Replica:
     """The serving fleet's worker: scheduler main loop + exporter surface."""
 
     def __init__(self, engine, *, stall_timeout_s: float = 2.0,
-                 handler_timeout_s: float = 120.0, clock=time.monotonic):
+                 handler_timeout_s: float = 120.0, clock=time.monotonic,
+                 weights_version: int = 0):
         self.engine = engine
         self._clock = clock
         self.stall_timeout_s = stall_timeout_s
@@ -120,9 +146,24 @@ class Replica:
         self._resolved = 0                   # prefix of scheduler.completed
         self._draining = False
         self._last_beat = clock()
+        # The served weights version (checkpoint step for real weights).
+        # ``_launch_version`` is what this process booted with — the
+        # slow_canary seam keys on the difference, so a fault armed
+        # fleet-wide slows exactly the replicas serving NEW weights.
+        self.weights_version = int(weights_version)
+        self._launch_version = int(weights_version)
+        self._swap_inbox: list = []          # swap jobs (dicts)
         self.exporter = obs_exporter.start_from_env(health=self.healthy)
         if self.exporter is not None:
             self.exporter.add_handler("/generate", self.handle_generate)
+            self.exporter.add_handler("/swap_weights", self.handle_swap)
+            self.exporter.add_collector(self._version_sample)
+
+    def _version_sample(self):
+        # Label-free on purpose: the router's parse_gauges reads only
+        # label-free lines off the scrape.
+        return [("tpuframe_weights_version", {},
+                 float(self.weights_version))]
 
     # -- health / drain ---------------------------------------------------
 
@@ -177,7 +218,65 @@ class Replica:
             "proc": os.environ.get("TPUFRAME_PROCESS_ID", "0"),
         }).encode()
 
+    def handle_swap(self, body: bytes):
+        """POST /swap_weights — runs on an exporter HTTP worker thread.
+        Like /generate it only parses, enqueues and waits: the MAIN loop
+        owns the engine, so the swap is applied between scheduler steps
+        (never mid-decode) and the only-main-thread-touches-the-engine
+        contract holds."""
+        try:
+            msg = json.loads(body.decode() or "{}")
+            version = int(msg["version"])
+            seed = msg.get("seed")
+            seed = None if seed is None else int(seed)
+        except (KeyError, ValueError, TypeError) as e:
+            return 400, json.dumps({"error": f"bad swap: {e}"}).encode()
+        job = {"version": version, "seed": seed, "result": None,
+               "done": threading.Event()}
+        with self._inbox_lock:
+            self._swap_inbox.append(job)
+        if not job["done"].wait(self.handler_timeout_s):
+            return 504, json.dumps(
+                {"error": "timed out waiting for the swap"}).encode()
+        if "error" in (job["result"] or {}):
+            return 500, json.dumps(job["result"]).encode()
+        return 200, json.dumps(job["result"]).encode()
+
     # -- the main-loop side ------------------------------------------------
+
+    def _apply_swaps(self) -> None:
+        """Apply queued weight swaps on the MAIN loop, between scheduler
+        steps.  The ``crash_during_swap`` seam fires after the swap was
+        accepted but before the version flips — the window where a kill
+        must leave the supervisor relaunching on the NEW version."""
+        with self._inbox_lock:
+            jobs, self._swap_inbox = self._swap_inbox, []
+        for job in jobs:
+            try:
+                faults.fire("crash_during_swap")
+                misses0 = _compile_misses()
+                if job["seed"] is not None:
+                    # Real-weights path: regenerate params (stand-in for
+                    # a checkpoint restore; replicated params reassemble
+                    # world-size invariantly) and hot-swap them through
+                    # the engine's one sanctioned seam.
+                    import jax
+                    import jax.numpy as jnp
+
+                    new_params = self.engine.model.init(
+                        jax.random.key(job["seed"]),
+                        jnp.zeros((1, min(self.engine.prompt_buckets)),
+                                  jnp.int32))["params"]
+                    self.engine.swap_params(new_params)
+                self.weights_version = job["version"]
+                job["result"] = {
+                    "version": self.weights_version,
+                    "compile_cache_misses": _compile_misses() - misses0,
+                }
+            except Exception as e:  # noqa: BLE001 — a refused swap (bad
+                # tree/shape) must answer 500, not kill the serving loop
+                job["result"] = {"error": f"{type(e).__name__}: {e}"}
+            job["done"].set()
 
     def _pump_inbox(self) -> int:
         with self._inbox_lock:
@@ -209,6 +308,11 @@ class Replica:
             faults.set_step(sched.step_count)
             for seam in _FAULT_SEAMS:
                 faults.fire(seam)
+            if self.weights_version != self._launch_version:
+                # Scoped to the NEW version by construction: arm the
+                # fault fleet-wide and only the swapped canary slows.
+                faults.fire("slow_canary")
+            self._apply_swaps()
             self._pump_inbox()
             if sched.has_work():
                 sched.step()
@@ -242,6 +346,9 @@ def main(argv=None) -> int:
                     help="exit after this much idle time (orphan guard)")
     ap.add_argument("--ready-file", default=None,
                     help="write the READY line (bound port) here")
+    ap.add_argument("--weights-version", type=int, default=0,
+                    help="version this replica boots on (a relaunch "
+                         "after a mid-swap kill passes the NEW one)")
     args = ap.parse_args(argv)
 
     faults.reset_from_env()
@@ -258,7 +365,8 @@ def main(argv=None) -> int:
                           prompt_buckets=buckets, decode_block=16,
                           max_context=max(buckets) + 32)
 
-    replica = Replica(engine, stall_timeout_s=args.stall_timeout_s)
+    replica = Replica(engine, stall_timeout_s=args.stall_timeout_s,
+                      weights_version=args.weights_version)
     signal.signal(signal.SIGTERM, replica.drain)
     if replica.exporter is None or replica.exporter.port is None:
         print("[replica] no scrape endpoint — set TPUFRAME_METRICS_PORT "

@@ -50,6 +50,7 @@ from __future__ import annotations
 import json
 import os
 import queue
+import random
 import subprocess
 import sys
 import threading
@@ -153,6 +154,11 @@ class ReplicaHandle:
     active_slots: float = 0.0
     last_scrape_t: float = -1e18
     inflight: set = field(default_factory=set)   # rids dispatched here
+    # Served weights version, scraped off the replica's label-free
+    # ``tpuframe_weights_version`` gauge; None until first seen.  The
+    # rollout controller reads this to prove the mixed-version window
+    # is bounded (and the canary constraint routes on it).
+    version: int | None = None
 
 
 @dataclass
@@ -226,6 +232,12 @@ class Router:
                          "hedged": 0, "redispatched": 0, "duplicates": 0,
                          "dispatch_errors": 0, "drains": 0}
         self._done_q: queue.SimpleQueue = queue.SimpleQueue()
+        # Canary constraint (rollout controller): while set, a seeded
+        # fraction of fresh placements is steered onto the canary
+        # replica and the rest onto the old-version pool.
+        self._canary_name: str | None = None
+        self._canary_frac = 0.0
+        self._canary_rng = random.Random(0)
 
     # -- admission ---------------------------------------------------------
 
@@ -270,12 +282,28 @@ class Router:
                 return rep
         return None
 
+    def set_canary(self, name: str, frac: float, *, seed: int = 0) -> None:
+        """Arm the canary placement constraint: a seeded ``frac`` of
+        fresh placements lands on replica ``name`` (the new version),
+        the rest on the old-version pool — the version constraint the
+        rollout gate's old-vs-new comparison needs."""
+        self._canary_name = name
+        self._canary_frac = min(1.0, max(0.0, float(frac)))
+        self._canary_rng = random.Random(seed)
+
+    def clear_canary(self) -> None:
+        self._canary_name = None
+        self._canary_frac = 0.0
+
     def _pick(self, exclude_rid: int | None = None
               ) -> ReplicaHandle | None:
         """Least-loaded healthy replica with dispatch capacity, never one
         already holding this rid (a hedge/redispatch must race a
-        *different* replica)."""
-        best = None
+        *different* replica).  Under an armed canary constraint the
+        eligible pool is first split canary/rest and one seeded draw
+        selects the side — so the traffic fraction is deterministic
+        given the seed and the dispatch order."""
+        eligible = []
         for rep in self.replicas:
             if rep.state != "ok":
                 continue
@@ -283,10 +311,43 @@ class Router:
                 continue
             if len(rep.inflight) >= self.max_inflight_per_replica:
                 continue
+            eligible.append(rep)
+        if self._canary_name is not None:
+            canary = [r for r in eligible if r.name == self._canary_name]
+            rest = [r for r in eligible if r.name != self._canary_name]
+            if canary and rest:
+                draw = self._canary_rng.random()
+                eligible = canary if draw < self._canary_frac else rest
+            # One side empty: fall through on whatever has capacity —
+            # availability beats the traffic split.
+        best = None
+        for rep in eligible:
             load = (len(rep.inflight), rep.queue_depth)
             if best is None or load < best[0]:
                 best = (load, rep)
         return None if best is None else best[1]
+
+    def drain_replica(self, name: str, *, reason: str) -> bool:
+        """Operator/rollout-initiated drain: same sticky state and
+        redispatch contract as a health-detected one — no new
+        dispatches, in-flight work requeued, originals keep racing."""
+        rep = self._replica(name)
+        if rep is None:
+            return False
+        self._mark_draining(rep, reason=reason)
+        return True
+
+    def readmit(self, name: str) -> bool:
+        """Undo a sticky drain after the rollout controller swapped and
+        re-verified the replica: back to "ok", with the scrape clock
+        reset so the next step() re-reads its health and version gauge
+        immediately."""
+        rep = self._replica(name)
+        if rep is None:
+            return False
+        rep.state = "ok"
+        rep.last_scrape_t = -1e18
+        return True
 
     def _launch(self, req: RoutedRequest, rep: ReplicaHandle, *,
                 cause: str) -> None:
@@ -413,11 +474,14 @@ class Router:
                 gauges = parse_gauges(
                     text if isinstance(text, str) else "",
                     ("tpuframe_serve_queue_depth",
-                     "tpuframe_serve_active_slots"))
+                     "tpuframe_serve_active_slots",
+                     "tpuframe_weights_version"))
                 rep.queue_depth = gauges.get("tpuframe_serve_queue_depth",
                                              rep.queue_depth)
                 rep.active_slots = gauges.get(
                     "tpuframe_serve_active_slots", rep.active_slots)
+                if "tpuframe_weights_version" in gauges:
+                    rep.version = int(gauges["tpuframe_weights_version"])
             except Exception:  # noqa: BLE001 — the load signal is
                 pass  # best-effort; /healthz above is authoritative
 
@@ -459,12 +523,15 @@ class Router:
 
     def run(self, requests, *, timeout_s: float = 60.0,
             arrival_speedup: float = 1.0, poll_s: float = 0.002,
-            log=None) -> dict:
+            on_tick=None, log=None) -> dict:
         """Drive the loadgen's seeded schedule through the fleet: submit
         each request once the wall clock passes its ``arrival_t`` (virtual
         seconds scaled by ``arrival_speedup``), tick the router until
         everything admitted has retired (or ``timeout_s`` trips — counted
-        as lost, never silently)."""
+        as lost, never silently).  ``on_tick()`` (if given) runs once per
+        loop after ``step()`` — the rollout controller's drive seam; when
+        it returns a truthy "keep running" the loop also waits for it,
+        not just for the request backlog."""
         todo = sorted(requests, key=lambda r: r.arrival_t)
         t0 = self._clock()
         i = 0
@@ -477,7 +544,8 @@ class Router:
                 i += 1
                 self.submit(r.rid, r.prompt, r.max_new_tokens)
             self.step()
-            if i >= len(todo) and not self.has_work():
+            tick_busy = bool(on_tick()) if on_tick is not None else False
+            if i >= len(todo) and not self.has_work() and not tick_busy:
                 break
             if now > timeout_s:
                 timed_out = True
@@ -508,6 +576,7 @@ class Router:
             "dispatch_errors": self.counters["dispatch_errors"],
             "drains": self.counters["drains"],
             "replicas": len(self.replicas),
+            "versions": {rep.name: rep.version for rep in self.replicas},
             "ttft_ms": {q: round(_pct(ttft, v), 3) for q, v in
                         (("p50", 0.5), ("p90", 0.9), ("p99", 0.99))}
             if ttft else None,
@@ -527,12 +596,16 @@ class Router:
 
 def _spawn_replica(rank: int, *, tmpdir: str, events_dir: str | None,
                    engine: str, slots: int, step_delay_ms: float,
-                   stall_timeout_s: float, faults_spec: str | None):
+                   stall_timeout_s: float, faults_spec: str | None,
+                   weights_version: int = 0, port: int = 0):
     ready = os.path.join(tmpdir, f"ready.{rank}")
     log_path = os.path.join(tmpdir, f"replica.{rank}.log")
     env = dict(os.environ)
     env.update({
-        "TPUFRAME_METRICS_PORT": "0",        # ephemeral; port via READY
+        # 0 = ephemeral (port read back via READY); a relaunch after a
+        # mid-swap kill passes the dead replica's port so the router's
+        # URL stays valid.
+        "TPUFRAME_METRICS_PORT": str(port),
         "TPUFRAME_PROCESS_ID": str(rank),
         "JAX_PLATFORMS": "cpu",
         "PALLAS_AXON_POOL_IPS": "",
@@ -552,6 +625,7 @@ def _spawn_replica(rank: int, *, tmpdir: str, events_dir: str | None,
            "--engine", engine, "--slots", str(slots),
            "--step-delay-ms", str(step_delay_ms),
            "--stall-timeout-s", str(stall_timeout_s),
+           "--weights-version", str(weights_version),
            "--max-idle-s", "60", "--ready-file", ready]
     log_fh = open(log_path, "wb")
     proc = subprocess.Popen(cmd, env=env, stdout=log_fh, stderr=log_fh)

@@ -840,6 +840,11 @@ def _train_impl(cfg: TrainConfig, *, trace_dir: str | None = None,
     if compile_cache.safe_for_key_outputs():
         compile_cache.enable()
     else:
+        # Disarm, don't just decline: an in-process LMEngine (colocated
+        # serving, the swap-seam tests) enables the cache for its own
+        # key-free programs, and a cache hit on the train step's keyed
+        # outputs would abort.
+        compile_cache.disable()
         print("[tpuframe] compile cache: disabled (this jax aborts on "
               "cached executables with typed-PRNG-key outputs)",
               file=sys.stderr)

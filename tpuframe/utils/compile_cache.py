@@ -81,6 +81,20 @@ def reset_cache() -> bool:
         return False
 
 
+def disable() -> None:
+    """Actively disarm the persistent cache for this process: clear the
+    configured dir and drop the latched singleton so the next compile
+    re-initializes cacheless.  Callers that merely *decline* to enable()
+    are not safe — another in-process component (an LMEngine built by a
+    colocated-serving test, say) may have enabled the cache already, and
+    a cache hit on a keyed-output executable is a hard C++ abort on
+    jax < 0.6."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    reset_cache()
+
+
 def default_cache_dir() -> str:
     return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), ".xla_cache")
