@@ -5,8 +5,12 @@ The decisive assertions lower the SAME many-tensor train step at different
 TPUFRAME_FUSION_THRESHOLD values and count ``all-reduce`` ops in the
 optimized HLO: threshold 0 → one collective per gradient leaf (Horovod's
 fusion-off semantics); a large threshold → the leaves ride a handful of
-packed buffers.  The golden-loss test then proves the packing is
-semantics-preserving against the default implicit pmean-of-loss path."""
+packed buffers.  The golden-loss tests then prove the packing is
+semantics-preserving against the default implicit pmean-of-loss path —
+including the staged (overlapped) pass and its ZeRO-1 composition — and
+the bucket census pins the HLO collective count arithmetically:
+``bucket_census`` predicts exactly how many gradient all-reduces the
+compiled program carries at every threshold."""
 
 import re
 
@@ -16,9 +20,11 @@ import numpy as np
 import optax
 import pytest
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from tpuframe.parallel import fusion, mesh as mesh_lib, step as step_lib
+from tpuframe.parallel import zero1
+from tpuframe.tune import db as tune_db
 
 
 def _bucket_sizes(shapes_dtypes, threshold):
@@ -46,8 +52,26 @@ class TestBucketize:
                   ((4,), jnp.float32)]
         assert _bucket_sizes(shapes, 64) == [1, 1, 1]
 
+    def test_census_accounts_every_leaf_and_byte(self):
+        leaves = [jax.ShapeDtypeStruct((25,), jnp.float32)] * 4 + \
+                 [jax.ShapeDtypeStruct((8,), jnp.bfloat16)]
+        census = fusion.bucket_census(leaves, 250)
+        assert census["n_leaves"] == 5
+        assert sum(r["leaves"] for r in census["buckets"]) == 5
+        assert census["total_bytes"] == 4 * 100 + 16
+        assert census["total_bytes"] == \
+            sum(r["bytes"] for r in census["buckets"])
+        # dtype boundary respected even under a roomy threshold
+        assert census["buckets"][-1]["dtype"] == "bfloat16"
+
+    def test_census_nonpositive_threshold_is_per_leaf(self):
+        leaves = [jax.ShapeDtypeStruct((25,), jnp.float32)] * 3
+        assert fusion.bucket_census(leaves, 0)["n_buckets"] == 3
+
 
 class TestFusedPsum:
+    # step_lib._shard_map (not jax.shard_map): the wrapper serves the
+    # jax-0.4.37 floor via jax.experimental.shard_map(check_rep=False).
     def test_matches_per_leaf_psum(self, mesh8):
         tree = {
             "a": jnp.arange(24, dtype=jnp.float32).reshape(2, 12),
@@ -60,7 +84,7 @@ class TestFusedPsum:
             plain = jax.tree.map(lambda l: lax.psum(l, "data"), x)
             return fused, plain
 
-        fused, plain = jax.jit(jax.shard_map(
+        fused, plain = jax.jit(step_lib._shard_map(
             body, mesh=mesh8, in_specs=P(), out_specs=P()))(tree)
         for k in tree:
             np.testing.assert_array_equal(np.asarray(fused[k]),
@@ -68,13 +92,60 @@ class TestFusedPsum:
 
     def test_mean_divides_by_axis_size(self, mesh8):
         x = {"w": jnp.ones((4,), jnp.float32)}
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(step_lib._shard_map(
             lambda t: fusion.fused_pmean(t, "data", threshold_bytes=0),
             mesh=mesh8, in_specs=P(), out_specs=P()))(x)
         np.testing.assert_allclose(np.asarray(out["w"]), np.ones(4))
 
+    def test_staged_matches_sync_reference(self, mesh8):
+        # The overlapped pass is the same math as the sync pack — the
+        # psum-linearity identity the fusion gate leg also pins.
+        tree = {
+            "a": jnp.arange(24, dtype=jnp.float32).reshape(2, 12),
+            "b": jnp.ones((70,), jnp.float32) * 3,
+            "c": jnp.full((3, 2), 2.0, jnp.bfloat16),
+        }
 
-def _many_tensor_step(mesh, fusion_threshold):
+        def body(x):
+            return (fusion.staged_psum(x, "data", threshold_bytes=128),
+                    fusion.fused_psum(x, "data", threshold_bytes=128))
+
+        staged, packed = jax.jit(step_lib._shard_map(
+            body, mesh=mesh8, in_specs=P(), out_specs=P()))(tree)
+        for k in tree:
+            np.testing.assert_allclose(np.asarray(staged[k]),
+                                       np.asarray(packed[k]),
+                                       rtol=1e-6, atol=1e-6)
+
+
+class TestScatterPacking:
+    # The ZeRO-1 composition's shard-aligned packing: reduce-scatter
+    # shard k of the packed buffer must equal the concatenation of each
+    # leaf's own shard k, or the bucketed update would mix leaves.
+    def test_pack_for_scatter_shard_alignment(self):
+        n = 4
+        flats = [jnp.arange(8, dtype=jnp.float32),
+                 jnp.arange(100, 112, dtype=jnp.float32)]
+        chunks = [f.size // n for f in flats]
+        packed = fusion.pack_for_scatter(flats, n)
+        assert packed.size == sum(f.size for f in flats)
+        rows = packed.reshape(n, -1)
+        for k in range(n):
+            expect = jnp.concatenate([f.reshape(n, -1)[k] for f in flats])
+            np.testing.assert_array_equal(np.asarray(rows[k]),
+                                          np.asarray(expect))
+        # split_scattered undoes one shard row into per-leaf shards
+        parts = fusion.split_scattered(rows[1], chunks)
+        for f, part in zip(flats, parts):
+            np.testing.assert_array_equal(np.asarray(part),
+                                          np.asarray(f.reshape(n, -1)[1]))
+        # split_gathered undoes the full gathered buffer into full leaves
+        full = fusion.split_gathered(packed, n, chunks)
+        for f, got in zip(flats, full):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(f))
+
+
+def _many_tensor_step(mesh, fusion_threshold, weight_update="replicated"):
     """A 12-leaf model (BERT-in-miniature: many small params)."""
     layers = [(jnp.zeros((16, 16), jnp.float32), jnp.zeros((16,), jnp.float32))
               for _ in range(6)]
@@ -88,10 +159,14 @@ def _many_tensor_step(mesh, fusion_threshold):
         return jnp.mean((y - batch["t"]) ** 2), ({}, {})
 
     step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
-                                    fusion_threshold=fusion_threshold)
-    state = step_lib.TrainState.create(params, tx)
-    if mesh is not None:
-        state = step_lib.replicate_state(state, mesh)
+                                    fusion_threshold=fusion_threshold,
+                                    weight_update=weight_update)
+    if weight_update == "zero1":
+        state = zero1.make_state(params, tx, mesh)
+    else:
+        state = step_lib.TrainState.create(params, tx)
+        if mesh is not None:
+            state = step_lib.replicate_state(state, mesh)
     rng = np.random.default_rng(0)
     batch = {"x": rng.normal(size=(16, 16)).astype(np.float32),
              "t": rng.normal(size=(16, 16)).astype(np.float32)}
@@ -99,6 +174,16 @@ def _many_tensor_step(mesh, fusion_threshold):
         batch = jax.tree.map(
             lambda a: jax.device_put(a, mesh_lib.batch_sharding(mesh)), batch)
     return step, state, batch
+
+
+def _grad_leaf_structs():
+    """ShapeDtypeStructs of _many_tensor_step's gradient leaves, in
+    jax.tree.flatten order — what bucket_census predicts buckets from."""
+    structs = []
+    for _ in range(6):
+        structs.append(jax.ShapeDtypeStruct((16,), jnp.float32))   # b
+        structs.append(jax.ShapeDtypeStruct((16, 16), jnp.float32))  # w
+    return structs
 
 
 def _all_reduce_stats(step, state, batch):
@@ -141,6 +226,26 @@ def test_threshold_changes_compiled_hlo(mesh8):
     assert s0 != sN
 
 
+def test_bucket_census_pins_all_reduce_count(mesh8):
+    # The census is not advisory: at every threshold the compiled HLO
+    # must carry exactly n_buckets gradient all-reduces (plus a constant
+    # metric overhead independent of the threshold).  A scheduler change
+    # that merges or fragments the staged buckets breaks this pin.
+    structs = _grad_leaf_structs()
+    offsets = set()
+    counts = []
+    for threshold in (256, 2048, 64 << 20):
+        census = fusion.bucket_census(structs, threshold)
+        ops, _, _ = _all_reduce_stats(*_many_tensor_step(mesh8, threshold))
+        offsets.add(ops - census["n_buckets"])
+        counts.append(census["n_buckets"])
+    assert len(offsets) == 1, (
+        f"gradient all-reduce count drifted from the census: "
+        f"offsets {offsets} over buckets {counts}")
+    assert counts[0] > counts[1] > counts[2], counts
+    assert offsets.pop() >= 0
+
+
 def test_implicit_path_is_grouped_per_leaf(mesh8):
     # fusion_threshold=None keeps the implicit pmean-of-loss program: the
     # autodiff transpose reduces each leaf, and XLA groups them into (a)
@@ -148,7 +253,12 @@ def test_implicit_path_is_grouped_per_leaf(mesh8):
     # scheduling level without the packing copy.  Pin the shape so a
     # regression that fragments or repacks the default program is caught.
     ops, operands, largest = _all_reduce_stats(*_many_tensor_step(mesh8, None))
-    assert ops <= 2, f"default path fragmented into {ops} all-reduce ops"
+    if step_lib._LEGACY_SHARD_MAP:
+        # the 0.4.x lowering keeps one all-reduce per leaf instead of
+        # the variadic grouping — still per-leaf, never repacked
+        assert ops >= 13, f"legacy path repacked into {ops} ops"
+    else:
+        assert ops <= 2, f"default path fragmented into {ops} all-reduce ops"
     assert operands >= 13  # 12 grad leaves + loss, individually visible
 
 
@@ -168,13 +278,118 @@ def test_fusion_golden_loss(mesh8):
     assert ref[-1] < ref[0]
 
 
+N_GOLDEN_STEPS = 50
+
+
+@pytest.mark.parametrize("weight_update", ["replicated", "zero1"])
+def test_staged_fusion_golden_loss_50_steps(mesh8, weight_update):
+    # The staged overlapped pass (and its ZeRO-1 bucketed scatter/gather
+    # composition) reproduces the unfused trajectory over a real run
+    # length — the same 50-step bar the zero1 equivalence tests hold.
+    def run(threshold):
+        step, state, batch = _many_tensor_step(mesh8, threshold,
+                                               weight_update=weight_update)
+        out = []
+        for _ in range(N_GOLDEN_STEPS):
+            state, m = step(state, batch)
+            out.append(float(m["loss"]))
+        return out
+
+    golden = run(None)
+    fused = run(2048)  # several buckets: the staged path, genuinely staged
+    np.testing.assert_allclose(fused, golden, rtol=1e-5, atol=1e-6)
+    assert golden[-1] < golden[0], "training should make progress"
+
+
+def test_registry_threshold_matches_strategies():
+    # strategies.py duplicates the constant to stay jax-free at import;
+    # the two must never drift.
+    from tpuframe.analysis import strategies
+
+    assert strategies._FUSED_REGISTRY_THRESHOLD == fusion.REGISTRY_THRESHOLD
+
+
+def test_seeded_overlap_positive_and_static_check():
+    # The live gate must fail the all-exposed declared_overlapped seed —
+    # a gate that cannot see a wasted async window is blind.
+    assert fusion.seeded_overlap_positive() == []
+    assert fusion.check_static() == []
+
+
 def test_env_knob_reaches_step_threshold(monkeypatch):
     from tpuframe.parallel import tuning
 
+    assert fusion.ENV_VAR == tuning.ENV_KNOB
     monkeypatch.setenv(tuning.ENV_KNOB, str(32 << 20))
     assert tuning.step_threshold() == 32 << 20
     monkeypatch.delenv(tuning.ENV_KNOB)
     assert tuning.step_threshold() is None
+
+
+# ----------------------------------------------------------------------
+# resolution precedence: env > tune DB (generation-gated) > default
+# ----------------------------------------------------------------------
+
+class TestResolution:
+    @pytest.fixture(autouse=True)
+    def clean_env(self, monkeypatch):
+        monkeypatch.delenv(fusion.ENV_VAR, raising=False)
+        monkeypatch.delenv("TPUFRAME_TUNE_GEN", raising=False)
+        monkeypatch.delenv("PALLAS_AXON_TPU_GEN", raising=False)
+        monkeypatch.setenv("TPUFRAME_TUNE_DB", "off")
+
+    def _seed_db(self, tmp_path, monkeypatch, value):
+        path = str(tmp_path / "tune_db.json")
+        db = tune_db.TuningDB(path)
+        db.add({"program": "train_resnet50_b512",
+                "family": "fusion_threshold",
+                "fingerprint": "fp0", "topology": "v5e:2x2",
+                "generation": "v5e",
+                "config": {"fusion_threshold": value, "batch": 512},
+                "predicted": {"predicted_ms": 5.0,
+                              "overlap_potential": 1.0}})
+        db.save()
+        monkeypatch.setenv("TPUFRAME_TUNE_DB", path)
+
+    def test_default_is_per_leaf_none(self):
+        assert fusion.resolve() == (None, "default")
+        assert fusion.resolve(default=131072) == (131072, "default")
+
+    def test_env_override_wins(self, tmp_path, monkeypatch):
+        self._seed_db(tmp_path, monkeypatch, 1 << 20)
+        monkeypatch.setenv("TPUFRAME_TUNE_GEN", "v5e")
+        monkeypatch.setenv(fusion.ENV_VAR, str(64 << 10))
+        assert fusion.resolve(program="train_resnet50_b512") == \
+            (64 << 10, "env")
+
+    def test_env_bogus_value_raises(self, monkeypatch):
+        monkeypatch.setenv(fusion.ENV_VAR, "lots")
+        with pytest.raises(ValueError, match="TPUFRAME_FUSION_THRESHOLD"):
+            fusion.resolve()
+
+    def test_db_winner_engages_with_generation(self, tmp_path,
+                                               monkeypatch):
+        self._seed_db(tmp_path, monkeypatch, 1 << 20)
+        monkeypatch.setenv("TPUFRAME_TUNE_GEN", "v5e")
+        assert fusion.resolve(program="train_resnet50_b512") == \
+            (1 << 20, "tune_db")
+        # family fallback for a program the sweep never compiled verbatim
+        assert fusion.resolve(program="train_resnet50_b1024",
+                              family="fusion_threshold") == \
+            (1 << 20, "tune_db")
+
+    def test_no_generation_means_default(self, tmp_path, monkeypatch):
+        # the tier-1 guarantee: CPU runs never see DB layout decisions
+        self._seed_db(tmp_path, monkeypatch, 1 << 20)
+        assert fusion.resolve(program="train_resnet50_b512") == \
+            (None, "default")
+
+    def test_stale_db_value_falls_back(self, tmp_path, monkeypatch):
+        # a stale/bogus DB row must never break a run — silent demotion
+        self._seed_db(tmp_path, monkeypatch, "not-an-int")
+        monkeypatch.setenv("TPUFRAME_TUNE_GEN", "v5e")
+        assert fusion.resolve(program="train_resnet50_b512") == \
+            (None, "default")
 
 
 def test_hvd_average_gradients_honors_fusion_knob(mesh8, monkeypatch):
@@ -191,20 +406,23 @@ def test_hvd_average_gradients_honors_fusion_knob(mesh8, monkeypatch):
     }
 
     def body(x):
-        # pvary so leaves are genuinely per-replica (the hand-built-grads
-        # case in average_gradients' contract).
-        x = jax.tree.map(
-            lambda l: lax.pcast(l, ("data",), to="varying"), x)
+        # pvary (where this jax has it) so leaves are genuinely
+        # per-replica — the hand-built-grads case in average_gradients'
+        # contract.  The legacy shard_map wrapper runs check_rep=False,
+        # where every leaf is already local/varying.
+        if hasattr(lax, "pcast"):
+            x = jax.tree.map(
+                lambda l: lax.pcast(l, ("data",), to="varying"), x)
         return collectives.average_gradients(x, axis="data")
 
     monkeypatch.delenv(tuning.ENV_KNOB, raising=False)
-    run = jax.jit(jax.shard_map(body, mesh=mesh8, in_specs=P(),
-                                out_specs=P()))
+    run = jax.jit(step_lib._shard_map(body, mesh=mesh8, in_specs=P(),
+                                      out_specs=P()))
     ref = run(tree)  # knob unset: per-leaf pmean
 
     monkeypatch.setenv(tuning.ENV_KNOB, str(1 << 20))
-    run2 = jax.jit(jax.shard_map(body, mesh=mesh8, in_specs=P(),
-                                 out_specs=P()))
+    run2 = jax.jit(step_lib._shard_map(body, mesh=mesh8, in_specs=P(),
+                                       out_specs=P()))
     got = run2(tree)
     for k in tree:
         np.testing.assert_array_equal(np.asarray(got[k]),

@@ -6,11 +6,12 @@ under ``tests/fixtures/hlo/`` are real optimized-HLO modules compiled
 once on an 8-device CPU mesh (regenerate with
 ``tests/fixtures/regen_hlo.py``), and the seeded positives are
 hand-written HLO snippets each detector must flag — every detector is
-proven against both a known-bad program and the nine known-clean
-strategy programs.  The schedule plane (async start/done pairing,
+proven against both a known-bad program and every known-clean
+strategy program.  The schedule plane (async start/done pairing,
 overlap windows, liveness peaks) is additionally proven on seeded
 *async* HLO, because CPU-compiled fixtures contain only sync
-collectives.
+collectives.  The fused strategies sign ``declared_overlapped`` and so
+run the exposed-comm detector as a LIVE gate, not report-only.
 """
 
 import gzip
@@ -648,14 +649,52 @@ def test_seeded_liveness_drift_positive():
 
 @pytest.mark.parametrize("name", sorted(GOLDENS["strategies"]))
 def test_golden_fixtures_schedule_clean(name):
-    """Every fixture passes the exposed-comm detector as shipped (no
-    strategy declares overlap today — CPU HLO is all-sync), and its
-    async pairing has no problems."""
+    """Every fixture passes the exposed-comm detector in report-only
+    mode, and its async pairing has no problems."""
     graph = parse_graph(_fixture_text(name))
     assert shardflow.detect_exposed_comm(graph, False) == []
     for comp in graph.computations.values():
         _, problems = comp.pair_async()
         assert problems == []
+
+
+_FUSED_FIXTURES = sorted(n for n in GOLDENS["strategies"] if "fused" in n)
+
+
+@pytest.mark.parametrize("name", _FUSED_FIXTURES)
+def test_fused_fixtures_pass_live_gate_with_interior_windows(name):
+    """The fused strategies sign ``declared_overlapped=True``, which
+    turns exposed-comm into a LIVE gate for them.  On the all-sync CPU
+    fixture the declaration survives only because every gated window
+    has legally interleavable interior compute — so assert both halves:
+    the gate is clean AND the windows are provably non-empty.  A fusion
+    regression that packs everything into one end-of-step bucket (no
+    interior compute left) fails here."""
+    entry = GOLDENS["strategies"][name]
+    floor = entry["schedule"]["ignore_below"]
+    graph = parse_graph(_fixture_text(name))
+    assert shardflow.detect_exposed_comm(graph, True,
+                                         ignore_below=floor) == []
+    # nonzero-interior-window: the pinned schedule record agrees with a
+    # fresh derivation, and both show real interleavable work.
+    sched = entry["schedule"]
+    assert sched["interleavable_bytes"] > 0, name
+    assert sched["exposed_above_floor"] > 0, name  # sync CPU: exposed, hidden-able
+    fresh = shardflow.derive_schedule_entry(graph, ignore_below=floor)
+    assert fresh["interleavable_bytes"] == sched["interleavable_bytes"]
+    # and at least one gated window individually carries interior compute
+    windows = [w for comp in graph.computations.values()
+               for w in cg.schedule_view(comp).windows
+               if w.bytes >= floor]
+    assert windows and all(w.interleavable_compute > 0 for w in windows)
+
+
+def test_fused_fixture_set_is_complete():
+    """Both signed strategies (dp and dp-zero1) regenerated into the
+    goldens — a regen that silently drops one fails loudly here, not as
+    a skipped parametrization."""
+    assert _FUSED_FIXTURES == ["spec:dp=*+fused131072",
+                               "spec:dp=*+zero1+fused131072"]
 
 
 def test_fixtures_match_checked_in_derived_schedule():

@@ -577,6 +577,18 @@ class TestPlanner:
         assert plan._scaled_topology("v5e:2x2", 2) == "v5e:2x4"
         assert plan._scaled_topology("v4:2x2x2", 4) == "v4:2x2x8"
 
+    def test_enumerate_candidates_includes_fused_variants(self):
+        """The planner carries the bucketed-fusion modifiers (dp and
+        dp+zero1) at the registry threshold, on every slice count — so
+        overlap potential participates in predicted_total_ms ranking."""
+        for n_slices in (1, 2):
+            cands = plan.enumerate_candidates(8, n_slices)
+            fused = [c for c in cands if "fusion_threshold" in c]
+            assert len(fused) == 2
+            assert all(c["fusion_threshold"] == 131072 for c in fused)
+            assert {c.get("weight_update") for c in fused} == \
+                {None, "zero1"}
+
     def test_rank_rows_excludes_inadmissible_and_is_total(self):
         rows = _plan_report()["candidates"]
         ranking = plan.rank_rows(rows)

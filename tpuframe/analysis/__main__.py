@@ -59,12 +59,21 @@ non-zero on any finding:
      pinned PERF verdicts (§18/§20/§23) must re-derive AND hold
      (``tpuframe.tune.plan.check``; version-skew skips itself like
      ``--emit-budgets``).
+  12. fusion self-check — the bucketed-fusion pass
+     (:mod:`tpuframe.parallel.fusion`) checks its env-knob parse, its
+     bucket-census arithmetic (ordered partition, kind-homogeneous,
+     byte-cap), seeds an all-exposed but ``declared_overlapped``
+     program that ``detect_exposed_comm`` MUST fail (the live gate
+     refuses to run blind), and on a multi-device backend pins the
+     psum-linearity identity: per-leaf, packed, and staged reductions
+     agree to 1e-6.
 
 ``--json PATH`` writes the whole gate outcome as a schema-pinned report;
 ``--compare A.json B.json`` diffs two such reports for structural
 collective regressions (rc 1 regression / 0 clean / 2 no overlap — the
 ``obs compare`` contract) without touching jax at all; ``--selfcheck``
-runs only legs 9 and 11 (jax-free but for the version stamp).
+runs only legs 9 and 11 plus fusion's jax-free subset (version stamp
+aside, no backend).
 
 Strategies this interpreter cannot express (see
 :class:`~tpuframe.analysis.strategies.Unavailable`) print as SKIP and do
@@ -260,6 +269,29 @@ def _run_zero1_check() -> int:
     return len(problems)
 
 
+def _run_fusion_check() -> int:
+    from tpuframe.parallel import fusion
+
+    problems = fusion.check()
+    for p in problems:
+        print(f"FUSION {p}")
+    print(f"[analysis] fusion self-check: {len(problems)} problem(s)")
+    return len(problems)
+
+
+def _run_fusion_static() -> int:
+    # Jax-free subset: env-knob parse, bucket-census arithmetic, the
+    # seeded zero-overlap positive against the live exposed-comm gate.
+    from tpuframe.parallel import fusion
+
+    problems = fusion.check_static()
+    for p in problems:
+        print(f"FUSION {p}")
+    print(f"[analysis] fusion static self-check: {len(problems)} "
+          f"problem(s)")
+    return len(problems)
+
+
 def _run_elastic_check() -> int:
     from tpuframe import elastic
 
@@ -367,7 +399,8 @@ def main(argv=None) -> int:
     if args.selfcheck:
         # Also jax-free: golden-pair + schema validation, plus the
         # planner-report pin (version-skew skips itself).
-        return 1 if (_run_flow_selfcheck() + _run_plan_check()) else 0
+        return 1 if (_run_flow_selfcheck() + _run_plan_check()
+                     + _run_fusion_static()) else 0
 
     if (args.emit_budgets or args.emit_schedule) and args.strategy:
         print("[analysis] --emit-budgets/--emit-schedule regenerate the "
@@ -408,6 +441,7 @@ def main(argv=None) -> int:
         n_findings += _run_router_check()
         n_findings += _run_rollout_check()
         n_findings += _run_zero1_check()
+        n_findings += _run_fusion_check()
         n_findings += _run_elastic_check()
         n_findings += _run_quantwire_check()
         n_findings += _run_pspec_check()

@@ -108,6 +108,24 @@ def dp_budget(param_bytes: int, name: str = "dp") -> CommBudget:
     )
 
 
+def fused_dp_budget(param_bytes: int,
+                    name: str = "dp-fused") -> CommBudget:
+    """Plain DP with the explicit bucketed-fusion pass
+    (tpuframe.parallel.fusion's staged psum): the same single class of
+    collective as :func:`dp_budget` — gradient all-reduce ≲ param bytes
+    — but emitted as one op per ≤threshold-byte bucket instead of the
+    combiner's grouping, so the floor drops to 1 KiB: EVERY bucket is a
+    declared window the schedule records pin (the nonzero-interior
+    contract), not just the ones over the 64 KiB scalar floor."""
+    return CommBudget(
+        name=name,
+        allowed={"all-reduce": int(2.0 * param_bytes)},
+        ignore_below=1024,
+        notes="bucketed grad all-reduce (staged fusion pass) + metric "
+              "scalars; every bucket counts above the 1 KiB floor",
+    )
+
+
 def zero1_budget(padded_param_bytes: int, name: str = "dp-zero1") -> CommBudget:
     """ZeRO-1 weight-update sharding (arXiv:2004.13336, the zero1 path):
     the gradient all-reduce is REPLACED by reduce-scatter (grads in — the

@@ -216,12 +216,18 @@ def audit_compiled(compiled) -> CollectiveReport:
     return parse_collectives(compiled.as_text())
 
 
-def audit_jitted(jitted, *example_args) -> tuple[CollectiveReport, object]:
+def audit_jitted(jitted, *example_args,
+                 compiler_options: dict | None = None
+                 ) -> tuple[CollectiveReport, object]:
     """Lower + backend-compile ``jitted`` on its example args (shapes
     only — ``jax.ShapeDtypeStruct`` leaves are fine) and audit the
     optimized HLO.  Returns ``(report, compiled)`` so callers can chain
-    donation/memory checks on the same artifact."""
-    compiled = jitted.lower(*example_args).compile()
+    donation/memory checks on the same artifact.  ``compiler_options``
+    ride the compile request (the TF106-sanctioned per-compile path —
+    no XLA_FLAGS mutation)."""
+    lowered = jitted.lower(*example_args)
+    compiled = (lowered.compile(compiler_options=compiler_options)
+                if compiler_options else lowered.compile())
     return audit_compiled(compiled), compiled
 
 

@@ -389,27 +389,55 @@ def census_cross_check(graph: cg.CollectiveGraph,
 def detect_exposed_comm(graph: cg.CollectiveGraph,
                         declared_overlapped: bool,
                         *, ignore_below: int = 0) -> list[str]:
-    """(e) exposed communication: collective starts with a zero overlap
-    window (or sync collectives, which block by construction).
+    """(e) exposed communication — a LIVE gate for declared-overlapped
+    strategies, report-only for everyone else.
 
     Async pairing problems — a ``-start`` whose ``-done`` the chase
     cannot find — are findings REGARDLESS of the declaration: a blind
-    window is a parser/schedule bug, not a policy choice.  The exposure
-    finding itself only fires on strategies that declare themselves
-    overlapped; everyone else gets the count in the schedule record and
-    the overlap score, not a gate failure."""
+    window is a parser/schedule bug, not a policy choice.  For a
+    declared-overlapped strategy the gate polices what the fusion pass
+    CONTROLS, not what the backend chooses to lower:
+
+    - an async start consumed back-to-back (zero-op window) always
+      fails — the pass opened a window and wasted it;
+    - a synchronous collective fails when the same program contains ANY
+      async window — the backend demonstrably can split, so an unsplit
+      collective is the pass's miss;
+    - on an all-synchronous program (CPU XLA emits no async collective
+      forms at all — PERF §21/§26) sync emission is not attributable to
+      the pass, so it fails only when the window ALSO has zero legally
+      interleavable compute: a declaration with nothing to hide behind
+      is vacuously false.  Exposure still lands in the schedule record
+      and the overlap score either way.
+
+    Undeclared strategies only get the counts in the schedule record —
+    never a gate failure."""
     findings: list[str] = []
+    views = []
     for comp in graph.computations.values():
         view = cg.schedule_view(comp)
         findings.extend(view.problems)
-        if not declared_overlapped:
-            continue
+        views.append((comp, view))
+    if not declared_overlapped:
+        return findings
+    backend_splits = any(w.is_async for _, v in views for w in v.windows)
+    for comp, view in views:
         for w in view.windows:
             if w.bytes < ignore_below or not w.exposed:
                 continue
-            what = ("consumed back-to-back (zero-op start->done window)"
-                    if w.is_async else
-                    "emitted synchronous (no start/done split at all)")
+            if w.is_async:
+                what = "consumed back-to-back (zero-op start->done window)"
+            elif backend_splits:
+                what = ("emitted synchronous (no start/done split) in a "
+                        "program whose backend emits async forms")
+            elif w.interleavable_compute == 0:
+                what = ("emitted synchronous with ZERO legally "
+                        "interleavable compute — nothing to overlap with")
+            else:
+                # Sync-only backend, interleavable compute present: the
+                # declaration is honest about the program; exposure is
+                # recorded and scored, not gated.
+                continue
             findings.append(
                 f"exposed communication in %{comp.name}: {w.kind} "
                 f"%{w.name} ({w.bytes} B) is {what} but the strategy "

@@ -157,6 +157,17 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          construction.  A raw ``engine.params = ...`` skips that check
          and can silently poison every compiled program; test fixtures
          suppress with ``# tf-lint: ok[TF121]`` and a reason.
+  TF122  ``declared_overlapped=True`` signed outside the strategy seam —
+         the keyword passed (truthy) to ``StrategyMeta(...)`` or
+         ``register_spec_strategy(...)`` anywhere but
+         ``analysis/strategies.py``.  The declaration is a live
+         contract, not metadata: ``shardflow.detect_exposed_comm``
+         turns from report-only into a hard gate for strategies that
+         carry it, so signing it is reserved to the one module whose
+         registrations the fixture/schedule pins actually cover.  A
+         strategy signed elsewhere would flip the gate on a program
+         nothing pins; seeded-positive test rigs suppress with
+         ``# tf-lint: ok[TF122]`` and a reason.
 
 Scope: TF101/TF102 only fire *inside functions known to be traced*
 (decorated with ``jax.jit``/``pmap``/``shard_map`` or passed to
@@ -238,6 +249,11 @@ RULES = {
              "outside the engine.swap_params() seam — skips the "
              "tree/shape/dtype validation that keeps hot swaps "
              "recompile-free",
+    "TF122": "declared_overlapped=True signed outside "
+             "analysis/strategies.py — the overlap declaration arms "
+             "shardflow's exposed-comm hard gate, and only the strategy "
+             "seam's registrations are covered by the pinned "
+             "fixtures/schedules",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -1085,6 +1101,39 @@ def _tf121_swap_seam(ctx: FileContext, node, fn):
                 "setattr(..., \"params\", ...) bypasses the validating "
                 "swap seam — go through engine.swap_params(new_params), "
                 "or suppress with tf-lint: ok[TF121] and a reason", fn)
+
+
+@_node_rule
+def _tf122_overlap_contract(ctx: FileContext, node, fn):
+    """``declared_overlapped`` signed behind the strategy seam's back: a
+    truthy (or dynamic) value for the keyword in a ``StrategyMeta(...)``
+    or ``register_spec_strategy(...)`` call outside
+    ``analysis/strategies.py``.  The declaration arms
+    ``detect_exposed_comm`` as a hard gate, so the ONLY sanctioned call
+    sites are the seam's own registrations — the ones whose compiled
+    schedules the fixture pins actually watch.  Shares TF120's scope
+    flag: the seam module itself is exempt."""
+    if not ctx.strategy_scope or not isinstance(node, ast.Call):
+        return
+    callee = _dotted(node.func)
+    tail = callee.rsplit(".", 1)[-1]
+    if tail not in ("StrategyMeta", "register_spec_strategy"):
+        return
+    for kw in node.keywords:
+        if kw.arg != "declared_overlapped":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and not v.value:
+            return  # explicit False/None — not a signing
+        ctx.emit("TF122", node,
+                 f"`{callee}(..., declared_overlapped=...)` signs the "
+                 f"overlap contract outside analysis/strategies.py — "
+                 f"the declaration turns shardflow's exposed-comm "
+                 f"detector into a hard gate, and only the strategy "
+                 f"seam's registrations are covered by the pinned "
+                 f"schedule fixtures; register through the seam, or "
+                 f"suppress with tf-lint: ok[TF122] and a reason", fn)
+        return
 
 
 @_fn_rule

@@ -207,7 +207,8 @@ def _lm_pieces(batch: int = 8, seq: int = 32, **cfg_kw):
 def _spec_budget(spec, pb: int, n_devices: int, *, weight_update: str,
                  wire_format: str, padded: int | None, ab: int = 0,
                  seq_mode: str | None = None,
-                 grad_reduce: str | None = None):
+                 grad_reduce: str | None = None,
+                 fusion_threshold: int | None = None):
     """The declared CommBudget for a composed spec — the same per-kind
     ceilings the hand-wired family declared, picked by axis/modifier;
     the byte-exact pin lives in ``derived_budgets.json`` either way."""
@@ -229,9 +230,13 @@ def _spec_budget(spec, pb: int, n_devices: int, *, weight_update: str,
     if weight_update == "zero1" and wire_format == "int8-block":
         return budgets_lib.zero1_int8_budget(padded, n_devices)
     if weight_update == "zero1":
+        # Bucketed fusion keeps the exact pad-to-multiple wire bytes —
+        # the zero1 ceilings hold unchanged, fused or not.
         return budgets_lib.zero1_budget(padded)
     if wire_format == "int8-block":
         return budgets_lib.dp_int8_budget(pb, n_devices)
+    if fusion_threshold is not None:
+        return budgets_lib.fused_dp_budget(pb)
     return budgets_lib.dp_budget(pb)
 
 
@@ -311,6 +316,8 @@ def _build_from_spec(spec_text: str, n_devices: int, *,
                      wire_format: str | None = None,
                      seq_mode: str | None = None,
                      grad_reduce: str | None = None,
+                     fusion_threshold: int | None = None,
+                     declared_overlapped: bool = False,
                      devices=None):
     """Generic spec-lowered builder: ``spec_text`` (the
     ``TPUFRAME_SPEC`` grammar) -> hierarchical mesh -> lowered step.
@@ -319,7 +326,10 @@ def _build_from_spec(spec_text: str, n_devices: int, *,
     size), never a violation.  ``devices`` overrides the device list
     (the planner passes compile-only topology devices); ``seq_mode``
     picks ring vs Ulysses attention for ``sp`` specs; ``grad_reduce``
-    threads the adasum modifier."""
+    threads the adasum modifier; ``fusion_threshold`` threads the
+    bucketed-fusion modifier (tpuframe.parallel.fusion's staged pass),
+    and ``declared_overlapped`` signs the overlap contract the
+    exposed-comm detector then enforces live."""
     import dataclasses
 
     import jax
@@ -338,10 +348,11 @@ def _build_from_spec(spec_text: str, n_devices: int, *,
     wire = wire_format or "fp"
     if spec.pp > 1:
         if (weight_update != "replicated" or wire != "fp"
-                or seq_mode or grad_reduce):
+                or seq_mode or grad_reduce or fusion_threshold is not None):
             raise pspec.SpecError(
                 f"spec '{spec.canonical()}': the GPipe lowering takes no "
-                f"modifiers — zero1/wire/seq_mode/adasum do not compose")
+                f"modifiers — zero1/wire/seq_mode/adasum/fusion do not "
+                f"compose")
         return _pp_build(spec, mesh)
     if spec.ep > 1:
         _, loss_fn, tx, (state, batch), pb, ab = _moe_pieces()
@@ -366,25 +377,29 @@ def _build_from_spec(spec_text: str, n_devices: int, *,
         tp_rules = tp_lib.rules_for_model("transformer-lm")
     kwargs = pspec.lower(spec, mesh, state, weight_update=weight_update,
                          wire_format=wire, tp_rules=tp_rules,
-                         grad_reduce=grad_reduce)
+                         grad_reduce=grad_reduce,
+                         fusion_threshold=fusion_threshold)
     step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
                                     **kwargs)
     budget = _spec_budget(spec, pb, n_devices, weight_update=weight_update,
                           wire_format=wire, padded=padded, ab=ab,
-                          seq_mode=seq_mode, grad_reduce=grad_reduce)
+                          seq_mode=seq_mode, grad_reduce=grad_reduce,
+                          fusion_threshold=fusion_threshold)
     shardings = kwargs.get("state_shardings")
     return (step, (state, batch), budget, pb,
             _meta(mesh,
                   wire_format="int8-block" if wire == "int8-block"
                   else "fp",
                   declared_leaves=(_declared_leaves(state, shardings)
-                                   if shardings is not None else ())))
+                                   if shardings is not None else ()),
+                  declared_overlapped=declared_overlapped))
 
 
 def _spec_name(spec_text: str, *, weight_update: str = "replicated",
                wire_format: str | None = None,
                seq_mode: str | None = None,
-               grad_reduce: str | None = None) -> str:
+               grad_reduce: str | None = None,
+               fusion_threshold: int | None = None) -> str:
     """Canonical strategy name for a composed spec: the spec's canonical
     spelling under a ``spec:`` prefix plus any modifiers — stable, so an
     auto-derived budget can be pinned in ``derived_budgets.json``."""
@@ -399,6 +414,8 @@ def _spec_name(spec_text: str, *, weight_update: str = "replicated",
         name += f"+{seq_mode}"
     if grad_reduce:
         name += f"+{grad_reduce}"
+    if fusion_threshold is not None:
+        name += f"+fused{int(fusion_threshold)}"
     return name
 
 
@@ -406,22 +423,29 @@ def register_spec_strategy(spec_text: str, *,
                            weight_update: str = "replicated",
                            wire_format: str | None = None,
                            seq_mode: str | None = None,
-                           grad_reduce: str | None = None) -> str:
+                           grad_reduce: str | None = None,
+                           fusion_threshold: int | None = None,
+                           declared_overlapped: bool = False) -> str:
     """Register a composed parallelism spec as a dynamic analysis
     strategy.  The name is the spec's canonical spelling under a
     ``spec:`` prefix (plus any modifiers) — stable, so its auto-derived
     budget can be pinned in ``derived_budgets.json`` like any named
     strategy's.  This is the ONE seam through which strategies enter the
-    registry (TF120 lints everything else)."""
+    registry (TF120 lints everything else), and the ONE module allowed
+    to sign ``declared_overlapped=True`` (TF122 lints everything else) —
+    a strategy cannot claim compute/communication overlap without going
+    through the audited fusion registration below."""
     import functools
 
     name = _spec_name(spec_text, weight_update=weight_update,
                       wire_format=wire_format, seq_mode=seq_mode,
-                      grad_reduce=grad_reduce)
+                      grad_reduce=grad_reduce,
+                      fusion_threshold=fusion_threshold)
     STRATEGIES[name] = functools.partial(
         _build_from_spec, spec_text, weight_update=weight_update,
         wire_format=wire_format, seq_mode=seq_mode,
-        grad_reduce=grad_reduce)
+        grad_reduce=grad_reduce, fusion_threshold=fusion_threshold,
+        declared_overlapped=declared_overlapped)
     return name
 
 
@@ -600,12 +624,49 @@ STRATEGIES = {
     "serve-dp-decode": _build_serve_decode,
 }
 
+#: Bucket threshold the fused registry variants pin — mirrors
+#: ``fusion.REGISTRY_THRESHOLD`` (duplicated so this module stays
+#: jax-free at import; tests/test_fusion.py asserts the two agree).
+_FUSED_REGISTRY_THRESHOLD = 128 * 1024
+
+#: The overlapped bucketed-fusion registrations (ISSUE 18): the staged
+#: pass (fusion.staged_psum / the bucketed zero1 scatter-gather) signs
+#: the ``declared_overlapped`` contract, flipping detect_exposed_comm
+#: from report-only to a live gate for exactly these two programs.
+#: These are the ONLY sanctioned ``declared_overlapped=True`` call
+#: sites — TF122 fails the gate on any other (see source_lint).
+DP_FUSED = register_spec_strategy(
+    "dp=*", fusion_threshold=_FUSED_REGISTRY_THRESHOLD,
+    declared_overlapped=True)
+DP_ZERO1_FUSED = register_spec_strategy(
+    "dp=*", weight_update="zero1",
+    fusion_threshold=_FUSED_REGISTRY_THRESHOLD,
+    declared_overlapped=True)
+
+
+def _overlap_compile_opts(meta) -> dict | None:
+    """A strategy that signs ``declared_overlapped`` owns its bucketing:
+    the staged fusion pass already packed the gradient wire, so XLA's
+    all-reduce combiner is asked to keep its hands off via the generic
+    DebugOptions field ("gpu" is historical naming — see
+    parallel/tuning.py).  Backends that read the field (CPU XLA here)
+    honor it; the v5e libtpu pin accepts-but-ignores it and re-merges
+    the buckets into one end-of-step collective anyway (no ``xla_tpu_*``
+    spelling exists: "No such compile option"), so on that backend the
+    live gate (correctly) rules the declaration vacuously false —
+    PERF.md §26 records the measurement.  Rides the compile request
+    per-compile (the TF106-sanctioned path), never XLA_FLAGS."""
+    if meta is None or not getattr(meta, "declared_overlapped", False):
+        return None
+    return {"xla_gpu_all_reduce_combine_threshold_bytes": 0}
+
 
 def audit_spec(spec_text: str, *, n_devices: int,
                weight_update: str = "replicated",
                wire_format: str | None = None,
                seq_mode: str | None = None,
                grad_reduce: str | None = None,
+               fusion_threshold: int | None = None,
                devices=None, name: str | None = None) -> StrategyAudit:
     """Audit an UNREGISTERED spec candidate — the ``tune plan`` seam.
 
@@ -614,18 +675,25 @@ def audit_spec(spec_text: str, *, n_devices: int,
     an optional explicit device list so the planner can compile against
     ``pspec.topology_devices`` instead of the local backend.  The
     planner enumerating hundreds of candidates goes through here so it
-    never hand-builds a :class:`StrategyMeta` (TF120's rule)."""
+    never hand-builds a :class:`StrategyMeta` (TF120's rule).  A
+    ``fusion_threshold`` candidate runs the staged bucketed pass and is
+    automatically declared overlapped — the same contract the registered
+    fused variants sign."""
     label = name or _spec_name(spec_text, weight_update=weight_update,
                                wire_format=wire_format, seq_mode=seq_mode,
-                               grad_reduce=grad_reduce)
+                               grad_reduce=grad_reduce,
+                               fusion_threshold=fusion_threshold)
     try:
         if devices is None:
             _require_devices(n_devices)
         step, example, budget, pb, meta = _build_from_spec(
             spec_text, n_devices, weight_update=weight_update,
             wire_format=wire_format, seq_mode=seq_mode,
-            grad_reduce=grad_reduce, devices=devices)
-        report, compiled = hlo_audit.audit_jitted(step, *example)
+            grad_reduce=grad_reduce, fusion_threshold=fusion_threshold,
+            declared_overlapped=fusion_threshold is not None,
+            devices=devices)
+        report, compiled = hlo_audit.audit_jitted(
+            step, *example, compiler_options=_overlap_compile_opts(meta))
     except Unavailable as e:
         return StrategyAudit(name=label, status="unavailable",
                              reason=str(e))
@@ -649,7 +717,8 @@ def audit_strategy(name: str, n_devices: int = 8) -> StrategyAudit:
     try:
         _require_devices(n_devices)
         step, example, budget, pb, meta = STRATEGIES[name](n_devices)
-        report, compiled = hlo_audit.audit_jitted(step, *example)
+        report, compiled = hlo_audit.audit_jitted(
+            step, *example, compiler_options=_overlap_compile_opts(meta))
     except Unavailable as e:
         return StrategyAudit(name=name, status="unavailable",
                              reason=str(e))

@@ -45,6 +45,10 @@ Event types (see ``REQUIRED_FIELDS`` for the per-type contract):
                  (mode replicated|zero1, resolution source, shard count)
   wire_format    gradient-path collective wire format chosen for the
                  step program (format fp|int8-block, resolution source)
+  fusion_threshold
+                 gradient-fusion bucket threshold chosen for the step
+                 program (threshold bytes or null for per-leaf,
+                 resolution source env|tune_db|default)
   pspec          declarative parallelism spec the run's mesh was built
                  from (canonical spec string, resolution source)
   elastic_resize world size changed across a relaunch boundary (n_from,
@@ -131,6 +135,7 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "remat_policy": ("policy", "source"),
     "weight_update": ("mode", "source"),
     "wire_format": ("format", "source"),
+    "fusion_threshold": ("threshold", "source"),
     "pspec": ("spec", "source"),
     "elastic_resize": ("n_from", "n_to", "policy"),
     "run_end": ("final_step", "wall_s", "goodput"),

@@ -108,7 +108,7 @@ def average_gradients(grads: PyTree, axis: AxisName = "data") -> PyTree:
         return grads
 
     def _avg(g):
-        vma = jax.typeof(g).vma
+        vma = _leaf_vma(g, names)
         varying = [a for a in names if a in vma]
         presummed = [a for a in names if a not in vma]
         out = lax.pmean(g, varying) if varying else g
@@ -129,7 +129,7 @@ def sum_gradients(grads: PyTree, axis: AxisName = "data") -> PyTree:
         return grads
 
     def _sum(g):
-        vma = jax.typeof(g).vma
+        vma = _leaf_vma(g, names)
         varying = [a for a in names if a in vma]
         return lax.psum(g, varying) if varying else g
 
@@ -152,7 +152,7 @@ def _maybe_fused_reduce(grads: PyTree, names, per_leaf, *, mean: bool) -> PyTree
 
     leaves, treedef = jax.tree.flatten(grads)
     fused_idx = [i for i, g in enumerate(leaves)
-                 if all(a in jax.typeof(g).vma for a in names)]
+                 if all(a in _leaf_vma(g, names) for a in names)]
     out = {i: per_leaf(leaves[i])
            for i in set(range(len(leaves))) - set(fused_idx)}
     if fused_idx:
@@ -175,6 +175,16 @@ def allgather(x: jax.Array, axis: AxisName = "data", *, tiled: bool = True) -> j
 # jax >= 0.6 vma machinery (mirrors zero1._HAS_VMA): all_gather_invariant
 # exists and can mark a gather's result replication-invariant.
 _HAS_VMA = hasattr(jax, "typeof") and hasattr(lax, "pcast")
+
+
+def _leaf_vma(g, names):
+    """The axes ``g`` is varying over, for the gradient-reduce routing.
+    On the pre-vma legacy shard_map (check_rep=False) nothing tracks
+    replication, and every leaf arrives local — i.e. varying over every
+    bound axis — so the compat answer is ``names`` itself."""
+    if _HAS_VMA:
+        return jax.typeof(g).vma
+    return frozenset(names)
 
 
 def allgather_invariant(x: jax.Array, axis: AxisName = "data", *,

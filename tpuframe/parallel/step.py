@@ -137,7 +137,9 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     # implicit reduction, so the reduction must be explicit.
     legacy_local = bool(axes) and _LEGACY_SHARD_MAP and not explicit
     diff_params = state.params
-    if explicit or ((zero1 or wire_local) and not _LEGACY_SHARD_MAP):
+    if (explicit or zero1 or wire_local) and not _LEGACY_SHARD_MAP:
+        # Legacy shard_map needs no pcast (and has none): check_rep=False
+        # already differentiates to LOCAL grads with no implicit psum.
         diff_params = jax.tree.map(
             lambda p: lax.pcast(p, axes, to="varying"), state.params)
 
@@ -172,6 +174,9 @@ def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, weight_update,
         # zero1.sharded_update's reduce-scatter performs the one and only
         # gradient-sized reduction.  Scalars (loss/metrics) and BN stats
         # still pmean (all under the audit's scalar floor).
+        # ``fusion_threshold`` buckets that reduce-scatter (and the param
+        # all-gather out) — same padded bytes, n_buckets collectives
+        # instead of n_leaves, issued before any shard is consumed.
         from tpuframe.parallel import zero1 as zero1_lib
 
         if reduce_grads:
@@ -182,7 +187,7 @@ def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, weight_update,
                              state.params)
         params, opt_state, grad_norm = zero1_lib.sharded_update(
             tx, axes, state.params, state.opt_state, grads,
-            wire_format=wire_format)
+            wire_format=wire_format, fusion_threshold=fusion_threshold)
         metrics = dict(metrics)
         metrics["loss"] = loss
         metrics["grad_norm"] = grad_norm
@@ -197,8 +202,8 @@ def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, weight_update,
         elif fusion_threshold is not None:
             from tpuframe.parallel import fusion
 
-            grads = fusion.fused_pmean(grads, axes,
-                                       threshold_bytes=fusion_threshold)
+            grads = fusion.staged_pmean(grads, axes,
+                                        threshold_bytes=fusion_threshold)
         elif wire_format == "int8-block":
             from tpuframe.parallel import quantwire
 
@@ -372,10 +377,12 @@ def make_train_step(
     update → tiled all-gather, and the optimizer state lives sharded
     (build it with ``zero1.make_state``; ``TrainState.create``'s
     replicated layout is rejected at trace time).  shard_map mode with a
-    mesh only; element-wise optimizers only; does not compose with
-    ``fusion_threshold``/``adasum`` (both are all-gradient wire patterns
-    the sharded update replaces) or ``state_shardings`` (auto-SPMD ZeRO-3
-    already shards the update).  Resolution (env
+    mesh only; element-wise optimizers only; composes with
+    ``fusion_threshold`` (the sharded update's reduce-scatter/all-gather
+    go bucketed — same padded bytes, fewer collectives, issued before
+    any shard is consumed) but not with ``adasum`` (an all-gradient wire
+    pattern the sharded update replaces) or ``state_shardings``
+    (auto-SPMD ZeRO-3 already shards the update).  Resolution (env
     ``TPUFRAME_WEIGHT_UPDATE`` > tuning DB > default) is the caller's job
     via ``zero1.resolve``.
 
@@ -424,10 +431,6 @@ def make_train_step(
             raise ValueError("weight_update='zero1' does not compose with "
                              "adasum — the butterfly needs full gradients "
                              "on every replica")
-        if fusion_threshold is not None:
-            raise ValueError("weight_update='zero1' replaces the gradient "
-                             "all-reduce entirely — fusion buffers have "
-                             "nothing to pack")
         if mode != "shard_map":
             raise ValueError("weight_update='zero1' needs shard_map mode")
     if remat_policy:

@@ -343,7 +343,9 @@ def _gather_full(shard: jax.Array, bound: tuple[str, ...]) -> jax.Array:
 def sharded_update(tx: optax.GradientTransformation, axes,
                    params: PyTree, opt_state: PyTree,
                    grads: PyTree, *,
-                   wire_format: str = "fp") -> tuple[PyTree, PyTree, jax.Array]:
+                   wire_format: str = "fp",
+                   fusion_threshold: int | None = None,
+                   ) -> tuple[PyTree, PyTree, jax.Array]:
     """reduce-scatter → 1/n optimizer update → all-gather.
 
     Called from the step tail with LOCAL per-replica gradients (the step
@@ -365,7 +367,17 @@ def sharded_update(tx: optax.GradientTransformation, axes,
     bounded by one quantization step of the (small) update, and the
     invariant-old + invariant-gather sum stays replication-invariant.
     Leaves under ``quantwire.MIN_QUANT_ELEMS`` keep the fp wire on both
-    sides (the derived-budget floors are sized to ignore them)."""
+    sides (the derived-budget floors are sized to ignore them).
+
+    ``fusion_threshold`` (fp wire only — ``make_train_step`` rejects the
+    int8 combination) buckets BOTH gradient-sized collectives Horovod-
+    style (:mod:`tpuframe.parallel.fusion`): padded flat grads pack
+    shard-aligned (``fusion.pack_for_scatter``) into ≤threshold-byte
+    buffers, ONE reduce-scatter per bucket in, ONE all-gather per bucket
+    out, every bucket's collective issued before any bucket is consumed.
+    Wire bytes are EXACTLY the per-leaf path's pad-to-multiple totals
+    (the zero1 budget holds unchanged); only the op count drops from
+    n_leaves to n_buckets."""
     bound = collectives._bound_axes(axes)
     if not bound:
         # World of 1 (unmapped): the sharded path degenerates to the
@@ -393,12 +405,42 @@ def sharded_update(tx: optax.GradientTransformation, axes,
     # — the wire cost the dp-zero1 CommBudget declares), averaging over
     # the world.  Zero padding reduces to zero.  On the int8 wire the
     # operand is the s8 payload + scales instead (~1/4 the bytes).
+    # With ``fusion_threshold`` the leaves pack into shard-aligned
+    # buckets first — one scatter per bucket, all issued before any
+    # shard is unpacked.
     def scatter(g):
         if quantized(g):
             return quantwire.reduce_scatter_mean(flat_pad(g), bound)
         return collectives.reduce_scatter(flat_pad(g), bound, average=True)
 
-    gshard = jax.tree.map(scatter, grads)
+    fused = fusion_threshold is not None and wire_format == "fp"
+    if fused:
+        from tpuframe.parallel import fusion
+
+        g_leaves, g_def = jax.tree.flatten(grads)
+        g_flat = [flat_pad(g) for g in g_leaves]
+        buckets = fusion._bucketize(g_flat, fusion_threshold)
+        issued = []
+        for bucket in buckets:
+            if len(bucket) == 1:
+                issued.append(collectives.reduce_scatter(
+                    g_flat[bucket[0]], bound, average=True))
+            else:
+                issued.append(collectives.reduce_scatter(
+                    fusion.pack_for_scatter([g_flat[i] for i in bucket], n),
+                    bound, average=True))
+        g_out = [None] * len(g_leaves)
+        for shard, bucket in zip(issued, buckets):
+            if len(bucket) == 1:
+                g_out[bucket[0]] = shard
+                continue
+            parts = fusion.split_scattered(
+                shard, [g_flat[i].size // n for i in bucket])
+            for i, part in zip(bucket, parts):
+                g_out[i] = part
+        gshard = jax.tree.unflatten(g_def, g_out)
+    else:
+        gshard = jax.tree.map(scatter, grads)
     # Params are replicated, so each replica's shard is a free local
     # slice at the same row-major linear index the scatter used.
     def param_shard(t):
@@ -428,7 +470,34 @@ def sharded_update(tx: optax.GradientTransformation, axes,
             full = _gather_full(shard, bound)
         return full[:_size(like)].reshape(like.shape)
 
-    new_params = jax.tree.map(regather, pshard, new_pshard, params)
+    if fused:
+        # Params out, bucketed: the same buckets the scatter used (grads
+        # were cast to param dtype upstream, so kinds match), one
+        # all-gather per bucket, every gather issued before any unpack.
+        p_leaves = jax.tree.leaves(params)
+        s_leaves, s_def = jax.tree.flatten(new_pshard)
+        gathered = []
+        for bucket in buckets:
+            if len(bucket) == 1:
+                gathered.append(_gather_full(s_leaves[bucket[0]], bound))
+            else:
+                gathered.append(_gather_full(
+                    jnp.concatenate([s_leaves[i] for i in bucket]), bound))
+        p_out = [None] * len(p_leaves)
+        for full, bucket in zip(gathered, buckets):
+            if len(bucket) == 1:
+                i = bucket[0]
+                p_out[i] = full[:_size(p_leaves[i])].reshape(
+                    p_leaves[i].shape)
+                continue
+            parts = fusion.split_gathered(
+                full, n, [g_flat[i].size // n for i in bucket])
+            for i, part in zip(bucket, parts):
+                p_out[i] = part[:_size(p_leaves[i])].reshape(
+                    p_leaves[i].shape)
+        new_params = jax.tree.unflatten(s_def, p_out)
+    else:
+        new_params = jax.tree.map(regather, pshard, new_pshard, params)
     return new_params, new_opt, grad_norm
 
 

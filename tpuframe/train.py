@@ -154,6 +154,10 @@ class Harness:
     # (format, resolution source) from tpuframe.parallel.quantwire.resolve
     # — ("fp", "default") when nothing elected a quantized wire.
     wire_format: tuple = ("fp", "default")
+    # (bucket threshold bytes, resolution source) from
+    # tpuframe.parallel.fusion.resolve — (None, "default") when nothing
+    # elected bucketed gradient fusion (per-leaf collectives).
+    fusion_threshold: tuple = (None, "default")
     # (canonical spec string, resolution source) from
     # tpuframe.parallel.pspec.resolve — (None, "default") when the mesh
     # came from the config rather than a TPUFRAME_SPEC declaration.
@@ -162,6 +166,30 @@ class Harness:
     # (committed checkpoint world ≠ current world), or None.  Emitted as
     # the typed ``elastic_resize`` run event.
     elastic_resize: dict | None = None
+
+
+def _resolved_fusion(cfg: TrainConfig) -> tuple:
+    """The step program's gradient-fusion bucket threshold with its
+    provenance: TPUFRAME_FUSION_THRESHOLD env > the tuning DB's
+    generation-gated ``fusion_threshold`` sweep winner > None
+    (per-leaf).  One shared resolution for :func:`build_harness` and
+    :func:`_lm_reduce_axis`, so the explicit-fusion step mode and its
+    local-loss requirement cannot disagree about whether fusion is on."""
+    from tpuframe.parallel import fusion as fusion_lib
+    from tpuframe.parallel import quantwire
+
+    model_tag = cfg.model.replace("-", "_")
+    program = f"train_{model_tag}_b{cfg.global_batch}"
+    threshold, source = fusion_lib.resolve(program=program,
+                                           family="fusion_threshold")
+    if threshold is not None and source != "env":
+        wf, wf_src = quantwire.resolve(program=program,
+                                       family=f"wire_format_{model_tag}")
+        if wf != "fp" and wf_src == "env":
+            # An explicit env-elected quantized wire owns the gradient
+            # path; the advisory DB-elected bucket threshold yields.
+            threshold, source = None, "default"
+    return threshold, source
 
 
 def build_harness(cfg: TrainConfig) -> Harness:
@@ -360,6 +388,10 @@ def build_harness(cfg: TrainConfig) -> Harness:
                  or cfg.grad_reduce == "adasum")):
         wire_format, wf_source = "fp", "default"
 
+    # GPipe pp takes no gradient-fusion modifier; the knob resolves (and
+    # can be DB-elected) only on the shard_map branch below.
+    fusion_threshold, ft_source = None, "default"
+
     if use_pp:
         # Pipeline parallelism: ScanBlockLM blocks + opt state sharded over
         # the pipe axis, GPipe microbatching (tpuframe.parallel.pp_lm).
@@ -424,7 +456,6 @@ def build_harness(cfg: TrainConfig) -> Harness:
                 state = step_lib.replicate_state(state, mesh)
 
         loss_fn = make_loss_fn(cfg, model)
-        from tpuframe.parallel import tuning
         from tpuframe.tune import db as tune_db
         from tpuframe.utils import xla_opts as xla_opts_lib
 
@@ -436,7 +467,18 @@ def build_harness(cfg: TrainConfig) -> Harness:
         if xla_opts is None:
             xla_opts = tune_db.resolve_xla_opts(cfg.name,
                                                 family="train_step")
-        fusion_threshold = tuning.step_threshold()
+        # Gradient-fusion bucket threshold: same resolution shape as the
+        # other knobs — TPUFRAME_FUSION_THRESHOLD env wins, else the
+        # DB's generation-gated fusion_threshold sweep winner, else
+        # per-leaf (the helper also yields a DB-elected threshold to an
+        # env-elected quantized wire).  A DB-elected threshold serves
+        # the shard_map gradient path only: where the step ignores the
+        # knob (unmapped jit, auto-SPMD sharded state) it demotes
+        # silently.
+        fusion_threshold, ft_source = _resolved_fusion(cfg)
+        if (fusion_threshold is not None and ft_source != "env"
+                and (mesh is None or use_sharded_state)):
+            fusion_threshold, ft_source = None, "default"
         if (wire_format != "fp" and wf_source != "env"
                 and (fusion_threshold or cfg.grad_reduce == "adasum")):
             # Explicit-fusion mode reduces bucket-by-bucket inside the
@@ -487,6 +529,7 @@ def build_harness(cfg: TrainConfig) -> Harness:
                    remat_policy=(remat_policy, remat_source),
                    weight_update=(weight_update, wu_source),
                    wire_format=(wire_format, wf_source),
+                   fusion_threshold=(fusion_threshold, ft_source),
                    pspec=(spec.canonical() if spec is not None else None,
                           spec_source),
                    elastic_resize=elastic_resize)
@@ -508,12 +551,10 @@ def _lm_reduce_axis(cfg: TrainConfig, *, for_grad: bool):
     # takes the explicit path: shard_map mode (distributed, no sharded-state
     # axes).  Unmapped jit and auto-SPMD ignore the fusion knob and reduce
     # globally by construction; a psum with unbound axes is a no-op there.
-    from tpuframe.parallel import tuning
-
     sharded_state = (cfg.mesh.fsdp > 1 or cfg.mesh.model > 1
                      or cfg.mesh.expert > 1)
     shard_map_mode = cfg.distributed and not sharded_state
-    explicit = shard_map_mode and (tuning.step_threshold() is not None
+    explicit = shard_map_mode and (_resolved_fusion(cfg)[0] is not None
                                    or cfg.accum_steps > 1
                                    or cfg.grad_reduce == "adasum")
     if not explicit:
@@ -1071,6 +1112,13 @@ def _train_impl(cfg: TrainConfig, *, trace_dir: str | None = None,
         # the predicted byte drop landed.
         events_lib.emit("wire_format", format=h.wire_format[0],
                         source=h.wire_format[1])
+        # Gradient-fusion provenance, same contract: which bucket
+        # threshold the step actually compiled with (None = per-leaf)
+        # and who elected it — the analyzer joins this with the
+        # schedule plane's interior-window records to attribute
+        # overlap-score deltas to the knob that moved them.
+        events_lib.emit("fusion_threshold", threshold=h.fusion_threshold[0],
+                        source=h.fusion_threshold[1])
         # Parallelism-spec provenance: which declarative spec (if any)
         # the run's mesh was lowered from and who elected it — joins
         # the run manifest's mesh dict to the TPUFRAME_SPEC grammar, so

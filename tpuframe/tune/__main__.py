@@ -6,6 +6,7 @@
     python -m tpuframe.tune sweep --serve               # serving decode grid
     python -m tpuframe.tune sweep --zero1               # weight-update sharding
     python -m tpuframe.tune sweep --wire                # wire-format search
+    python -m tpuframe.tune sweep --fusion              # fusion bucket grid
     python -m tpuframe.tune show                        # ranked DB contents
     python -m tpuframe.tune check                       # CI self-check
 
@@ -71,10 +72,28 @@ def _cmd_sweep(args) -> int:
                           report_path=args.report,
                           batch=args.wire_batch)
         return 0
+    if args.fusion:
+        search.fusion_sweep(args.topology, db_path=args.db,
+                            report_path=args.report,
+                            batch=args.fusion_batch,
+                            thresholds=tuple(args.fusion_thresholds))
+        return 0
     search.sweep(args.topology, db_path=args.db, report_path=args.report,
                  seq=args.seq, head_dim=args.head_dim,
                  blocks=tuple(args.blocks),
                  bench_batches=tuple(args.bench_batches))
+    return 0
+
+
+def _cmd_fusion_probe(args) -> int:
+    import json
+
+    from tpuframe.tune import search
+
+    row = search._fusion_probe_row(args.topology, args.program,
+                                   args.batch, args.threshold, args.floor)
+    with open(args.out, "w") as f:
+        json.dump(row, f)
     return 0
 
 
@@ -160,6 +179,15 @@ def main(argv=None) -> int:
                          "donated ResNet-50 DP + BERT ZeRO-1 train steps "
                          "(wire_format_* families)")
     sw.add_argument("--wire-batch", type=int, default=512)
+    sw.add_argument("--fusion", action="store_true",
+                    help="sweep gradient-fusion bucket thresholds over "
+                         "the donated ResNet-50 DP train step, ranked by "
+                         "overlap score + compiled wire bytes "
+                         "(fusion_threshold family)")
+    sw.add_argument("--fusion-batch", type=int, default=512)
+    sw.add_argument("--fusion-thresholds", type=int, nargs="+",
+                    default=[16384, 32768, 65536, 131072, 262144],
+                    metavar="BYTES")
     sw.add_argument("--remat-policies", nargs="+", default=None,
                     metavar="POLICY")
     sw.set_defaults(fn=_cmd_sweep)
@@ -176,6 +204,19 @@ def main(argv=None) -> int:
                     "(default: <repo>/tune_db.json)")
     pl.add_argument("--report", default=None)
     pl.set_defaults(fn=_cmd_plan)
+
+    # Hidden worker: one fusion candidate per process, because libtpu's
+    # fusion emitter can SIGABRT on a bucket shape and the parent sweep
+    # must survive to record the crash (fusion_sweep spawns these; the
+    # parent holds the AOT lock, so the probe never takes it).
+    fp = sub.add_parser("_fusion-probe")
+    fp.add_argument("--topology", default="v5e:2x2")
+    fp.add_argument("--program", default="resnet50")
+    fp.add_argument("--batch", type=int, default=512)
+    fp.add_argument("--floor", type=int, default=1024)
+    fp.add_argument("--threshold", type=int, default=None)
+    fp.add_argument("--out", required=True)
+    fp.set_defaults(fn=_cmd_fusion_probe)
 
     sh = sub.add_parser("show", help="print ranked DB contents")
     sh.add_argument("--db", default=None)

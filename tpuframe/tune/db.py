@@ -144,6 +144,8 @@ class Record:
             env["TPUFRAME_WEIGHT_UPDATE"] = str(cfg["weight_update"])
         if "wire_format" in cfg:
             env["TPUFRAME_WIRE_FORMAT"] = str(cfg["wire_format"])
+        if "fusion_threshold" in cfg:
+            env["TPUFRAME_FUSION_THRESHOLD"] = str(cfg["fusion_threshold"])
         if "spec" in cfg:
             env["TPUFRAME_SPEC"] = str(cfg["spec"])
         if "decode_block" in cfg:
@@ -434,6 +436,34 @@ def resolve_wire_format(program: str,
         return None
     fmt = rec.config.get("wire_format")
     return str(fmt) if fmt else None
+
+
+def resolve_fusion_threshold(program: str,
+                             family: str | None = None) -> int | None:
+    """Gradient-fusion bucket threshold (bytes) for ``program``: None
+    unless the DB has a swept ``fusion_threshold`` winner for the target
+    generation.  Callers apply ``TPUFRAME_FUSION_THRESHOLD`` themselves
+    FIRST via :func:`tpuframe.parallel.fusion.resolve` — when the env
+    var is set this returns None so the override is unambiguous."""
+    if os.environ.get("TPUFRAME_FUSION_THRESHOLD", "").strip():
+        return None
+    gen = target_generation()
+    if gen is None:
+        return None
+    db = _open_for_resolution()
+    if db is None:
+        return None
+    rec = db.best(program=program, generation=gen)
+    if (rec is None or "fusion_threshold" not in rec.config) \
+            and family is not None:
+        rec = db.best(family=family, generation=gen)
+    if rec is None:
+        return None
+    threshold = rec.config.get("fusion_threshold")
+    try:
+        return int(threshold) if threshold is not None else None
+    except (TypeError, ValueError):
+        return None
 
 
 def resolve_spec(program: str,

@@ -92,8 +92,9 @@ def enumerate_candidates(n_devices: int, n_slices: int = 1) -> list:
     Specs are written with the ``dp=*`` wildcard so one grid serves any
     world size; degrees that cannot fit ``n_devices`` are recorded as
     skips by the sweep (the spec is for a different world), never
-    silently dropped.  Modifier candidates (zero1 / int8-block / adasum)
-    ride the plain-dp spec — they are step modifiers, not mesh axes."""
+    silently dropped.  Modifier candidates (zero1 / int8-block / adasum /
+    bucketed fusion) ride the plain-dp spec — they are step modifiers,
+    not mesh axes."""
     tail = f";slices={n_slices}" if n_slices > 1 else ""
     cands = [
         {"spec": "dp=*" + tail},
@@ -102,6 +103,14 @@ def enumerate_candidates(n_devices: int, n_slices: int = 1) -> list:
         {"spec": "dp=*" + tail, "weight_update": "zero1",
          "wire_format": "int8-block"},
         {"spec": "dp=*" + tail, "grad_reduce": "adasum"},
+        # Bucketed-fusion variants: the staged overlapped gradient pass
+        # at the registry threshold (strategies._FUSED_REGISTRY_THRESHOLD
+        # — 128 KiB).  audit_spec signs declared_overlapped for them, so
+        # an inadmissible (all-exposed) lowering is gated out here, not
+        # just reported.
+        {"spec": "dp=*" + tail, "fusion_threshold": 131072},
+        {"spec": "dp=*" + tail, "weight_update": "zero1",
+         "fusion_threshold": 131072},
         {"spec": "dp=*,fsdp=2" + tail},
         {"spec": "dp=*,tp=2" + tail},
         {"spec": "dp=*,tp=4" + tail},
@@ -243,7 +252,8 @@ def plan(topology: str = "v5e:2x2", *, slice_counts=(1, 2),
                 weight_update=cand.get("weight_update", "replicated"),
                 wire_format=cand.get("wire_format"),
                 seq_mode=cand.get("seq_mode"),
-                grad_reduce=cand.get("grad_reduce"))
+                grad_reduce=cand.get("grad_reduce"),
+                fusion_threshold=cand.get("fusion_threshold"))
             base = {"name": audit.name, "spec": cand["spec"],
                     "slices": n_slices, "n_devices": n,
                     "compile_topology": compile_topo,

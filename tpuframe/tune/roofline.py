@@ -68,6 +68,15 @@ def generation_from_topology(topology: str) -> str:
     return topology.split(":", 1)[0].strip().lower()
 
 
+def n_chips_from_topology(topology: str) -> int:
+    """'v5e:2x2' -> 4, without initializing a compile-only backend."""
+    _, _, dims = topology.partition(":")
+    n = 1
+    for d in dims.split("x"):
+        n *= int(d)
+    return n
+
+
 def get_hardware(generation: str) -> Hardware:
     gen = generation.split(":", 1)[0].strip().lower()
     if gen not in HARDWARE:

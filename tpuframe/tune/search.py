@@ -441,7 +441,8 @@ def remat_sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
 
 
 def _zero1_step_compile(topo_devices, program: str, batch: int,
-                        weight_update: str, wire_format: str = "fp"):
+                        weight_update: str, wire_format: str = "fp",
+                        fusion_threshold: int | None = None):
     """AOT-compile one donated train step over the FULL topology under one
     weight-update mode.  Unlike the remat sweep's single-chip rig, the
     collective swap is the whole point here — the reduce-scatter /
@@ -539,11 +540,25 @@ def _zero1_step_compile(topo_devices, program: str, batch: int,
 
     step = step_lib.make_train_step(loss_fn, tx, mesh, donate=True,
                                     weight_update=weight_update,
-                                    wire_format=wire_format)
-    compiled = step.lower(state, batch_structs).compile()
+                                    wire_format=wire_format,
+                                    fusion_threshold=fusion_threshold)
+    lowered = step.lower(state, batch_structs)
+    if fusion_threshold is not None:
+        # The staged pass owns bucketing: hand the XLA all-reduce
+        # combiner off per-compile (strategies._overlap_compile_opts —
+        # same contract).  Honored where the generic DebugOptions field
+        # is read (CPU XLA); the v5e libtpu pin accepts-but-ignores it
+        # and re-merges the buckets regardless, which is why the sweep's
+        # thresholds tie on that backend (PERF.md §26).
+        compiled = lowered.compile(compiler_options={
+            "xla_gpu_all_reduce_combine_threshold_bytes": 0})
+    else:
+        compiled = lowered.compile()
     desc = {"program": f"train_{program}_b{batch}", "n_chips": n,
             "global_batch": batch, "donate": True,
             "weight_update": weight_update, "wire_format": wire_format}
+    if fusion_threshold is not None:
+        desc["fusion_threshold"] = int(fusion_threshold)
     return compiled, desc, opt_bytes, census
 
 
@@ -755,6 +770,191 @@ def wire_sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
         tag = topology.replace(":", "_").replace("x", "")
         report_path = os.path.join(tune_db.repo_root(), "perf", "results",
                                    f"wire_report_{tag}.json")
+    os.makedirs(os.path.dirname(report_path), exist_ok=True)
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    _log(f"report: {report_path}", log)
+    return report
+
+
+def _fusion_probe_row(topology: str, program: str, batch: int,
+                      threshold: int | None, floor: int) -> dict:
+    """Compile + score ONE fusion candidate and return its report row.
+
+    Runs inside a worker subprocess spawned by ``fusion_sweep`` — a
+    bucket shape can abort libtpu's fusion emitter outright (a CHECK
+    failure in ``fusion_emitter.cc``, observed at 256 KiB+ buckets on
+    the ResNet-50 step, PERF §26), and a SIGABRT in-process would take
+    the whole sweep and its partial report down with it.  The parent
+    holds the AOT lock; this helper must not re-take it."""
+    from jax.experimental import topologies
+
+    from tpuframe.analysis import collective_graph as cg
+    from tpuframe.analysis import hlo_audit, shardflow
+
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    gen = roofline.generation_from_topology(topology)
+    topo = topologies.get_topology_desc(topology, platform="tpu")
+    n = len(topo.devices)
+    compiled, _desc, _opt, _census = _zero1_step_compile(
+        topo.devices, program, batch, "replicated",
+        fusion_threshold=threshold)
+    txt = compiled.as_text()
+    pred = roofline.score_compiled(compiled, gen)
+    coll = hlo_audit.parse_collectives(txt)
+    comm = roofline.comm_score(gen, coll.filter(floor), n)
+    total_ms = round(pred["predicted_ms"] + comm["t_ici_ms"], 3)
+    graph = cg.parse_graph(txt)
+    entry = shardflow.derive_schedule_entry(graph, ignore_below=floor)
+    score = shardflow.overlap_score(graph, coll, n_devices=n,
+                                    ignore_below=floor, generation=gen)
+    return {"program": program, "fusion_threshold": threshold,
+            "global_batch": batch,
+            "collectives_above_floor": score["collectives_above_floor"],
+            "comm_bytes": comm["comm_bytes"],
+            "overlap_potential": score["overlap_potential"],
+            "comm_ms": score["comm_ms"],
+            "hideable_ms": score["hideable_ms"],
+            "interleavable_bytes": entry["interleavable_bytes"],
+            "async_pairs": entry["async_pairs"],
+            "predicted_ms": pred["predicted_ms"],
+            "t_ici_ms": comm["t_ici_ms"],
+            "predicted_total_ms": total_ms}
+
+
+def _crash_reason(stderr: str, returncode: int) -> str:
+    """Condense a dead probe's stderr to the line that names the abort."""
+    lines = [ln.strip() for ln in (stderr or "").splitlines() if ln.strip()]
+    for ln in reversed(lines):
+        if "Check failed" in ln or "CHECK failed" in ln:
+            return ln[:300]
+    for ln in reversed(lines):
+        if "Error" in ln or "error" in ln:
+            return ln[:300]
+    tail = lines[-1][:200] if lines else ""
+    return f"probe exited {returncode}" + (f": {tail}" if tail else "")
+
+
+def fusion_sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
+                 report_path: str | None = None, batch: int = 512,
+                 thresholds=(16384, 32768, 65536, 131072, 262144),
+                 log=None) -> dict:
+    """Offline gradient-fusion bucket-threshold search: AOT-compile the
+    donated ResNet-50 DP train step once per ``threshold_bytes`` over
+    the full topology, rank on the schedule plane's ``overlap_score``
+    (how much of each bucket's wire time has legally interleavable
+    compute to hide behind it) plus the compiled wire bytes, and persist
+    the winner to the ``fusion_threshold`` DB family.  Small buckets
+    give the scheduler more interior windows but pay more per-collective
+    latency; huge buckets degenerate to the end-of-backprop sync pack
+    (one window, nothing left to overlap) — the sweep finds the knee.
+    An unfused per-leaf baseline row rides along for comparison but is
+    never the winner.
+
+    Each candidate compiles in its OWN worker subprocess
+    (``python -m tpuframe.tune _fusion-probe``): libtpu's fusion
+    emitter can hard-abort (CHECK failure, SIGABRT) on some bucket
+    shapes, and isolation turns a compiler crash into a recorded
+    ``compile_errors`` row instead of losing the sweep."""
+    import subprocess
+    import tempfile
+
+    import jax  # noqa: F401 — fail fast before holding the lock
+
+    hold_aot_lock()
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    gen = roofline.generation_from_topology(topology)
+    n = roofline.n_chips_from_topology(topology)
+    floor = 1024  # fused_dp_budget's floor — every bucket counts
+    program = "resnet50"
+    _log(f"fusion sweep on {topology} ({n} chips): {program} dp x "
+         f"{list(thresholds)} + unfused baseline", log)
+
+    db_path = db_path or tune_db.default_db_path()
+    db = tune_db.TuningDB.open(db_path) if os.path.exists(db_path) \
+        else tune_db.TuningDB(db_path)
+    report = {"topology": topology, "generation": gen, "n_chips": n,
+              "objective": "overlap_potential desc, then wire bytes "
+                           "and predicted_total_ms asc",
+              "ignore_below": floor,
+              "fusion": {"rows": [], "compile_errors": []}}
+
+    candidates = [None] + [int(t) for t in thresholds]
+    for threshold in candidates:
+        tag = "unfused" if threshold is None else str(threshold)
+        with tempfile.NamedTemporaryFile(suffix=".json",
+                                         delete=False) as tf:
+            out_path = tf.name
+        cmd = [sys.executable, "-m", "tpuframe.tune", "_fusion-probe",
+               "--topology", topology, "--program", program,
+               "--batch", str(batch), "--floor", str(floor),
+               "--out", out_path]
+        if threshold is not None:
+            cmd += ["--threshold", str(threshold)]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=1800)
+            rc, stderr = proc.returncode, proc.stderr
+        except subprocess.TimeoutExpired:
+            rc, stderr = -1, "probe timed out after 1800 s"
+        try:
+            if rc == 0:
+                with open(out_path) as f:
+                    row = json.load(f)
+                report["fusion"]["rows"].append(row)
+                _log(f"  {program}/{tag}: overlap "
+                     f"{row['overlap_potential']}, "
+                     f"{row['collectives_above_floor']} collective(s) "
+                     f"{row['comm_bytes'] / 1e6:.2f} MB, "
+                     f"{row['predicted_total_ms']} ms total", log)
+            else:
+                err = {"program": program, "fusion_threshold": threshold,
+                       "returncode": rc,
+                       "error": _crash_reason(stderr, rc)}
+                report["fusion"]["compile_errors"].append(err)
+                _log(f"  {program}/{tag}: COMPILE CRASH (rc {rc}) "
+                     f"{err['error'][:80]}", log)
+        finally:
+            if os.path.exists(out_path):
+                os.unlink(out_path)
+
+    fused_rows = [r for r in report["fusion"]["rows"]
+                  if r["fusion_threshold"] is not None]
+    fused_rows.sort(key=lambda r: (-(r["overlap_potential"] or 0.0),
+                                   r["comm_bytes"],
+                                   r["predicted_total_ms"]))
+    if fused_rows:
+        w = fused_rows[0]
+        report["winner"] = w
+        pred_w = {"predicted_ms": w["predicted_ms"],
+                  "predicted_total_ms": w["predicted_total_ms"],
+                  "overlap_potential": w["overlap_potential"],
+                  "comm_bytes": w["comm_bytes"], "source": "compiled"}
+        # One winner per program: db.add keys on config, so a re-sweep
+        # electing a different threshold would otherwise leave the old
+        # winner behind and make resolve_fusion_threshold ambiguous.
+        db.data["records"] = [
+            r for r in db.data["records"]
+            if not (r.get("family") == "fusion_threshold"
+                    and r.get("program") == f"train_{program}_b{batch}")]
+        db.add({"program": f"train_{program}_b{batch}",
+                "family": "fusion_threshold",
+                "fingerprint": tune_db.fingerprint(
+                    {"program": f"train_{program}_b{batch}",
+                     "n_chips": n, "global_batch": batch}),
+                "topology": topology, "generation": gen,
+                "config": {"fusion_threshold": w["fusion_threshold"],
+                           "batch": batch},
+                "predicted": pred_w})
+        db.save()
+        _log(f"winner: threshold {w['fusion_threshold']} "
+             f"(overlap {w['overlap_potential']}) -> {db.path} "
+             f"({len(db.data['records'])} records)", log)
+    if report_path is None:
+        tag = topology.replace(":", "_").replace("x", "")
+        report_path = os.path.join(tune_db.repo_root(), "perf", "results",
+                                   f"fusion_report_{tag}.json")
     os.makedirs(os.path.dirname(report_path), exist_ok=True)
     with open(report_path, "w") as f:
         json.dump(report, f, indent=1, sort_keys=True)
