@@ -40,6 +40,7 @@ from tpuframe.ckpt.checkpoint import in_flight_step, latest_step
 from tpuframe.launch import launcher as launcher_mod
 from tpuframe.obs import events, goodput
 from tpuframe.obs import metrics
+from tpuframe.obs import tracing
 from tpuframe.parallel import step as step_lib
 from tpuframe.resilience import RC_PREEMPTED, faults
 from tpuframe.utils import get_config
@@ -579,6 +580,30 @@ class TestFleetChaos:
         entry = cmp["metrics"]["router_ttft_p90_ms"]
         assert entry["a"] > 0 and entry["b"] > 0
 
+        # Tracing through the kill: every admitted rid still
+        # reconstructs to exactly ONE complete request root, every
+        # completed root's wait+queue+prefill sum agrees with its
+        # queue-inclusive TTFT (zero ttft_mismatch — the one-monotonic-
+        # clock reconciliation), and the only anomalies are leaked
+        # serve-side spans on the KILLED replica — the loud orphaned-
+        # work signal the leak detector exists for.
+        findings = tracing.verify_traces(merged)
+        other = [f for f in findings if f["kind"] != "leaked_span"]
+        assert other == [], other
+        leaked = [f for f in findings if f["kind"] == "leaked_span"]
+        assert leaked, "kill left no leaked span — the crash was clean?"
+        assert all(str(f.get("host", "")).endswith("-p1")
+                   for f in leaked), leaked
+        traces = tracing.build_traces(merged)
+        for rec in merged:
+            if rec["type"] == "router_admit":
+                roots = traces[rec["trace"]].complete_roots()
+                assert len(roots) == 1, (rec["id"], len(roots))
+        # The p99 exemplar names a trace the reconstruction can resolve.
+        assert fleet["ttft_exemplars"]["p99"]["trace"] in traces
+        # The no-fault run is anomaly-free end to end.
+        assert tracing.verify_traces(base_merged) == []
+
     def test_replica_crash_seam_is_deterministic(self):
         """The seam grammar: replica_crash defaults to kind=crash and
         honors the step pin — the property the fleet test's kill_step
@@ -702,6 +727,28 @@ class TestRollingUpdate:
         assert v["by_replica"] == {"r0": 1, "r1": 1, "r2": 1}
         assert v["target"] == 1 and not v["aborted"]
         assert 0.0 < v["mixed_window_s"] < 30.0
+
+        # Tracing through the roll is fully clean: no leaks, no
+        # orphans, every admitted rid exactly one complete root, every
+        # phase sum within tolerance of its queue-inclusive TTFT —
+        # drains and re-queues included.
+        assert tracing.verify_traces(merged) == []
+        traces = tracing.build_traces(merged)
+        for rec in merged:
+            if rec["type"] == "router_admit":
+                assert len(traces[rec["trace"]].complete_roots()) == 1
+        # The rollout itself is one force-sampled trace: a complete
+        # root span whose notes carry the per-replica phases.
+        ro_roots = [(tv, sp) for tv in traces.values()
+                    for sp in tv.roots if sp.name == "rollout"]
+        assert len(ro_roots) == 1
+        rtv, ro_root = ro_roots[0]
+        assert ro_root.complete
+        assert ro_root.closed["status"] == "done"
+        assert ro_root.closed["version"] == 1
+        phases = {(n.get("replica"), n["note"]) for n in rtv.notes}
+        assert {("r0", "swapped"), ("r1", "swapped"),
+                ("r2", "swapped")} <= phases
 
     def test_poisoned_canary_auto_rolls_back(self, tmp_path):
         from tpuframe.serve import rollout as rollout_lib

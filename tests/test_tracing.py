@@ -1,0 +1,448 @@
+"""The request-tracing plane (obs/tracing.py) and the SLO sentry
+(obs/slo.py): span emission + the open-span registry, deterministic
+sampling, cross-process trace reconstruction (router -> replica ->
+scheduler), hedge/redispatch span semantics, the verify_traces
+completeness contract with its seeded positives, the TF123 emission-seam
+lint, and the multi-window burn-rate rc contract.  The subprocess chaos
+tiers assert the same invariants at fleet scale in tests/test_chaos.py.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpuframe.obs import events as obs_events
+from tpuframe.obs import goodput, slo, tracing
+from tpuframe.resilience.policy import RetryPolicy
+from tpuframe.serve.router import Router
+
+
+def _no_sleep_policy(**kw):
+    kw.setdefault("max_attempts", 2)
+    kw.setdefault("base_delay_s", 0.001)
+    kw.setdefault("max_delay_s", 0.001)
+    kw.setdefault("attempt_timeout_s", 5.0)
+    kw.setdefault("deadline_s", 10.0)
+    return RetryPolicy(sleep=lambda s: None, **kw)
+
+
+def _drive(router, *, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while router.has_work() and time.monotonic() < deadline:
+        router.step()
+        time.sleep(0.002)
+    assert not router.has_work(), "router did not converge"
+
+
+def _ok_reply(url, payload, timeout_s):
+    if url.endswith("/generate"):
+        return 200, {"rid": payload["rid"], "tokens": [1, 2],
+                     "ttft_ms": 1.0}
+    if url.endswith("/healthz"):
+        return 200, "ok\n"
+    return 200, "tpuframe_serve_queue_depth 0\n# EOF\n"
+
+
+# ---------------------------------------------------------------------------
+# Span API: emission, registry, sampling.
+# ---------------------------------------------------------------------------
+
+class TestSpanAPI:
+    def test_span_events_schema_registered_and_valid(self, tmp_path):
+        obs_events.init(str(tmp_path))
+        try:
+            tid = tracing.mint(0, force=True)
+            sid = tracing.open_span(tid, "request", rid=0)
+            tracing.note(tid, "requeue", span=sid, replica="r0")
+            tracing.close_span(tid, sid, 12.5, status="ok")
+            tracing.span(tid, "queue", parent=sid, ms=3.0)
+        finally:
+            obs_events.close()
+        files = obs_events.event_files(str(tmp_path))
+        assert obs_events.validate_files(files) == []  # schema-clean
+        merged = obs_events.merge(str(tmp_path))
+        types = [e["type"] for e in merged]
+        assert types.count("span_open") == 2
+        assert types.count("span_close") == 2
+        assert types.count("span_note") == 1
+
+    def test_open_span_registry_and_metrics_gauge(self):
+        from tpuframe.obs import exporter
+
+        base = tracing.open_span_count()
+        tid = tracing.mint("gauge-test", force=True)
+        sid = tracing.open_span(tid, "request")
+        try:
+            assert tracing.open_span_count() == base + 1
+            assert (tid, sid, "request") in tracing.open_spans()
+            text = exporter.MetricsExporter().render()
+            assert f"tpuframe_open_spans {base + 1}\n" in text
+        finally:
+            tracing.close_span(tid, sid, 1.0)
+        assert tracing.open_span_count() == base
+
+    def test_atomic_span_pairs_bypass_the_registry(self):
+        base = tracing.open_span_count()
+        tracing.span("tx.0", "queue", ms=1.0)
+        assert tracing.open_span_count() == base
+
+    def test_sampling_knob_deterministic(self, monkeypatch):
+        monkeypatch.setenv(tracing.ENV_SAMPLE, "0.5")
+        assert tracing.resolve_sample() == 0.5
+        picks = [tracing.sampled(rid) for rid in range(200)]
+        assert picks == [tracing.sampled(rid) for rid in range(200)]
+        assert 20 < sum(picks) < 180        # actually samples, not all/none
+        monkeypatch.setenv(tracing.ENV_SAMPLE, "0")
+        assert tracing.mint(7) is None                   # sampled out
+        assert tracing.mint(7, force=True) is not None   # rollouts bypass
+        monkeypatch.setenv(tracing.ENV_SAMPLE, "junk")
+        assert tracing.resolve_sample() == 1.0
+        monkeypatch.setenv(tracing.ENV_SAMPLE, "7")
+        assert tracing.resolve_sample() == 1.0           # clamped
+
+    def test_sampled_out_request_is_untraced_but_served(self, monkeypatch):
+        monkeypatch.setenv(tracing.ENV_SAMPLE, "0")
+        r = Router(["http://a"], transport=_ok_reply, hedge_ms=0,
+                   scrape_interval_s=1e9)
+        r.submit(0, [1])
+        _drive(r)
+        assert r.completed[0].trace is None
+        assert r.counters["completed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Reconstruction + the verify_traces contract.
+# ---------------------------------------------------------------------------
+
+class TestReconstruction:
+    def test_healthy_synthetic_roundtrip(self):
+        evs = tracing._synthetic_trace()
+        assert tracing.verify_traces(evs) == []
+        traces = tracing.build_traces(evs)
+        (tv,) = traces.values()
+        (root,) = tv.complete_roots()
+        assert root.name == "request" and root.ms == 62.0
+        path = [sp.name for sp in tracing.critical_path(root)]
+        assert path == ["request", "attempt", "serve", "decode"]
+        rows = tracing.waterfall(root)
+        assert [r["span"].name for r in rows] == [
+            "request", "attempt", "serve", "queue", "prefill", "decode"]
+        assert [r["depth"] for r in rows] == [0, 1, 2, 3, 3, 3]
+
+    def test_seeded_leaked_span_is_loud(self):
+        evs = [r for r in tracing._synthetic_trace()
+               if not (r["type"] == "span_close"
+                       and r.get("span") == "s1")]
+        kinds = {p["kind"] for p in tracing.verify_traces(evs)}
+        assert "leaked_span" in kinds
+        # ...and through the anomaly sweep (obs anomalies integration).
+        finds = goodput.find_anomalies(evs)
+        assert any(f["kind"] == "leaked_span" for f in finds)
+
+    def test_seeded_orphan_and_missing_root(self):
+        healthy = tracing._synthetic_trace()
+        orphaned = [dict(r, parent="zz")
+                    if r["type"] == "span_open" and r.get("span") == "s1"
+                    else r for r in healthy]
+        kinds = {p["kind"] for p in tracing.verify_traces(orphaned)}
+        assert "orphan_span" in kinds
+        no_spans = [r for r in healthy
+                    if r["type"] not in tracing.SPAN_EVENT_TYPES]
+        kinds = {p["kind"] for p in tracing.verify_traces(no_spans)}
+        assert "missing_root" in kinds
+        unclosed = [r for r in healthy
+                    if not (r["type"] == "span_close"
+                            and r.get("span") == "r0")]
+        kinds = {p["kind"] for p in tracing.verify_traces(unclosed)}
+        assert "incomplete_root" in kinds
+
+    def test_ttft_mismatch_tolerance(self):
+        healthy = tracing._synthetic_trace()
+        drifted = [dict(r, ttft_ms=67.0)
+                   if r["type"] == "span_close" and r.get("span") == "r0"
+                   else r for r in healthy]
+        kinds = {p["kind"] for p in tracing.verify_traces(drifted)}
+        assert "ttft_mismatch" in kinds
+        # within tolerance: rounding drift is not an incident
+        nudged = [dict(r, ttft_ms=19.0)
+                  if r["type"] == "span_close" and r.get("span") == "r0"
+                  else r for r in healthy]
+        assert tracing.verify_traces(nudged, tol_ms=5.0) == []
+
+    def test_training_only_logs_skip_span_sweep(self):
+        # No span events: find_anomalies must not import/flag anything.
+        evs = [{"schema": obs_events.SCHEMA_VERSION, "type": "train_step",
+                "t": 1.0, "host": "h", "proc": 0, "attempt": 0,
+                "step": 1, "loss": 2.0, "step_ms": 3.0}]
+        assert all(f["kind"] not in ("leaked_span", "orphan_span")
+                   for f in goodput.find_anomalies(evs))
+
+
+# ---------------------------------------------------------------------------
+# The cross-process join: router -> replica -> scheduler, in-process.
+# ---------------------------------------------------------------------------
+
+class TestRouterReplicaJoin:
+    def test_trace_joins_router_to_scheduler(self, tmp_path):
+        """The satellite-1 identity pin: one rid, one trace id, minted at
+        Router.submit and visible verbatim on router_admit,
+        router_request AND the replica's serve_request — with the span
+        tree stitched across the /generate payload and every phase
+        accounted (verify_traces clean, exactly one complete root per
+        admitted rid)."""
+        from tpuframe.serve.replica import FakeEngine, Replica
+
+        obs_events.init(str(tmp_path))
+        try:
+            replica = Replica(FakeEngine(slots=2),
+                              handler_timeout_s=10.0)
+            pump = threading.Thread(
+                target=replica.run, kwargs=dict(max_idle_s=30.0),
+                daemon=True)
+            pump.start()
+
+            def transport(url, payload, timeout_s):
+                if url.endswith("/generate"):
+                    status, body = replica.handle_generate(
+                        json.dumps(payload).encode())
+                    return status, json.loads(body.decode())
+                if url.endswith("/healthz"):
+                    return 200, "ok\n"
+                return 200, "tpuframe_serve_queue_depth 0\n# EOF\n"
+
+            r = Router(["http://r0"], transport=transport, hedge_ms=0,
+                       scrape_interval_s=1e9)
+            for rid in range(4):
+                assert r.submit(rid, [rid + 1, 2, 3], max_new_tokens=3)
+            _drive(r)
+            replica.drain()
+            pump.join(10.0)
+            assert not pump.is_alive()
+        finally:
+            obs_events.close()
+
+        merged = obs_events.merge(str(tmp_path))
+        admits = {e["id"]: e["trace"] for e in merged
+                  if e["type"] == "router_admit"}
+        served = {e["id"]: e["trace"] for e in merged
+                  if e["type"] == "serve_request"}
+        routed = {e["id"]: e["trace"] for e in merged
+                  if e["type"] == "router_request"}
+        assert set(admits) == set(served) == set(routed) == {0, 1, 2, 3}
+        assert admits == served == routed       # ONE identity end to end
+
+        assert tracing.verify_traces(merged) == []
+        traces = tracing.build_traces(merged)
+        for rid, tid in admits.items():
+            roots = traces[tid].complete_roots()
+            assert len(roots) == 1, f"rid {rid}: {len(roots)} roots"
+            names = {sp.name for sp in traces[tid].spans.values()}
+            assert {"request", "attempt", "serve", "queue", "prefill",
+                    "decode"} <= names
+
+        # Percentile exemplars resolve to reconstructed traces.
+        fleet = goodput.fleet_stats(merged)
+        ex = fleet["ttft_exemplars"]
+        for q in ("p50", "p90", "p99"):
+            assert ex[q]["trace"] in traces
+        assert tracing.trace_of(merged, 0) == admits[0]
+
+
+# ---------------------------------------------------------------------------
+# Hedge-race and redispatch span semantics.
+# ---------------------------------------------------------------------------
+
+class TestAttemptSpans:
+    def test_hedge_loser_closes_duplicate_under_same_trace(self, tmp_path):
+        release = threading.Event()
+
+        def transport(url, payload, timeout_s):
+            if url.endswith("/generate") and "//a" in url:
+                release.wait(5.0)
+                return 200, {"rid": payload["rid"], "tokens": [9],
+                             "ttft_ms": 99.0}
+            return _ok_reply(url, payload, timeout_s)
+
+        obs_events.init(str(tmp_path))
+        try:
+            r = Router(["http://a", "http://b"], transport=transport,
+                       hedge_ms=30.0, scrape_interval_s=1e9)
+            r.submit(0, [1])
+            _drive(r)
+            release.set()
+            deadline = time.monotonic() + 5.0
+            while (r.counters["duplicates"] < 1
+                   and time.monotonic() < deadline):
+                r.step()
+                time.sleep(0.002)
+            assert r.counters["duplicates"] == 1
+        finally:
+            obs_events.close()
+
+        merged = obs_events.merge(str(tmp_path))
+        traces = tracing.build_traces(merged)
+        assert len(traces) == 1
+        (tv,) = traces.values()
+        (root,) = tv.complete_roots()
+        attempts = [sp for sp in root.children if sp.name == "attempt"]
+        assert len(attempts) == 2           # sibling subtrees, one root
+        assert {a.opened["cause"] for a in attempts} == {"first", "hedge"}
+        winner = [a for a in attempts
+                  if not a.closed.get("duplicate")]
+        loser = [a for a in attempts if a.closed.get("duplicate")]
+        assert len(winner) == 1 and len(loser) == 1
+        assert winner[0].closed["status"] == "ok"
+        assert loser[0].opened["cause"] == "first"  # straggler lost
+        assert tracing.verify_traces(merged) == []  # loser span closed
+
+    def test_redispatch_after_drain_same_root(self, tmp_path):
+        def transport(url, payload, timeout_s):
+            if "//a" in url and url.endswith("/generate"):
+                raise OSError("connection refused")
+            return _ok_reply(url, payload, timeout_s)
+
+        obs_events.init(str(tmp_path))
+        try:
+            r = Router(["http://a", "http://b"], transport=transport,
+                       hedge_ms=0, scrape_interval_s=1e9,
+                       dispatch_policy=_no_sleep_policy())
+            r.submit(0, [1])
+            _drive(r)
+            assert r.summary()["redispatched"] == 1
+        finally:
+            obs_events.close()
+
+        merged = obs_events.merge(str(tmp_path))
+        traces = tracing.build_traces(merged)
+        (tv,) = traces.values()
+        (root,) = tv.complete_roots()
+        attempts = {sp.opened["cause"]: sp for sp in root.children
+                    if sp.name == "attempt"}
+        assert set(attempts) == {"first", "redispatch"}
+        assert attempts["first"].closed["status"] == "error"
+        assert attempts["redispatch"].closed["status"] == "ok"
+        notes = {n["note"] for n in tv.notes}
+        assert notes & {"requeue", "drain_requeue"}
+        assert tracing.verify_traces(merged) == []
+
+
+# ---------------------------------------------------------------------------
+# SLO sentry.
+# ---------------------------------------------------------------------------
+
+def _req(t, ttft):
+    return {"schema": obs_events.SCHEMA_VERSION, "type": "router_request",
+            "t": t, "host": "h-p90", "proc": 0, "attempt": 0,
+            "id": 0, "replica": "r0", "ttft_ms": ttft}
+
+
+class TestSLO:
+    def test_spec_grammar_roundtrip(self):
+        (s,) = slo.parse_slos("ttft<=800ms@99%")
+        assert (s.metric, s.threshold_ms, s.objective) == \
+            ("ttft", 800.0, 0.99)
+        assert str(s) == "ttft<=800ms@99%"
+        both = slo.parse_slos(slo.DEFAULT_SLO)
+        assert [b.metric for b in both] == ["ttft", "tpot"]
+        assert slo.parse_windows("60:14.4,300:6") == [(60.0, 14.4),
+                                                      (300.0, 6.0)]
+
+    @pytest.mark.parametrize("bad", [
+        "ttft<800ms@99%", "latency<=1ms@99%", "ttft<=1ms@0%",
+        "ttft<=1ms@100%", "", "ttft<=1ms"])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            slo.parse_slos(bad)
+
+    def test_rc_contract(self):
+        specs = slo.parse_slos("ttft<=100ms@90%")
+        windows = [(60.0, 1.0)]
+        clean = [_req(0.1 * i, 10.0) for i in range(30)]
+        out = slo.evaluate(clean, specs, windows)
+        assert out["rc"] == 0
+        assert out["slos"][0]["breached"] is False
+        assert out["slos"][0]["windows"][0]["n"] == 30  # full window shown
+        slow = [_req(0.1 * i, 500.0) for i in range(30)]
+        out = slo.evaluate(slow, specs, windows)
+        assert out["rc"] == 1
+        assert out["slos"][0]["windows"][0]["burn"] == pytest.approx(10.0)
+        assert slo.evaluate([], specs, windows)["rc"] == 2
+
+    def test_short_spike_long_window_policy(self):
+        """The multi-window point: one spike trips a tight long-window
+        factor while the tolerant short-window factor absorbs it."""
+        specs = slo.parse_slos("ttft<=100ms@99%")
+        evs = [_req(1.0 * i, 500.0 if i == 7 else 10.0)
+               for i in range(100)]
+        # short window, generous factor: the spike is 1/2 samples in a
+        # 1s window -> burn 50 > 14.4 would breach; pick factor above it
+        out = slo.evaluate(evs, specs, [(1.0, 60.0)])
+        assert out["rc"] == 0
+        out = slo.evaluate(evs, specs, [(99.0, 1.0)])
+        assert out["rc"] == 1               # sustained view: budget blown
+
+    def test_env_resolution(self, monkeypatch):
+        monkeypatch.setenv(slo.ENV_SLO, "ttft<=5ms@50%")
+        monkeypatch.setenv(slo.ENV_WINDOWS, "10:2")
+        assert [str(s) for s in slo.resolve_slos()] == ["ttft<=5ms@50%"]
+        assert slo.resolve_windows() == [(10.0, 2.0)]
+        monkeypatch.delenv(slo.ENV_SLO)
+        assert [str(s) for s in slo.resolve_slos()] == \
+            [str(s) for s in slo.parse_slos(slo.DEFAULT_SLO)]
+
+
+# ---------------------------------------------------------------------------
+# Gate: the TF123 seam lint, the clock pin, check() itself.
+# ---------------------------------------------------------------------------
+
+class TestGate:
+    def test_tf123_span_seam_lint(self):
+        from tpuframe.analysis.source_lint import lint_source
+
+        bad = ("from tpuframe.obs import events\n"
+               "def f(tr):\n"
+               "    events.emit('span_open', trace=tr, span='s1', "
+               "name='x')\n")
+        rules = [f.rule for f in
+                 lint_source(bad, "tpuframe/serve/foo.py")]
+        assert rules == ["TF123"]
+        ok = bad.replace("name='x')",
+                         "name='x')  # tf-lint: ok[TF123]")
+        assert lint_source(ok, "tpuframe/serve/foo.py") == []
+        # The seam itself is exempt; non-span types unaffected.
+        assert lint_source(bad, "tpuframe/obs/tracing.py") == []
+        other = bad.replace("'span_open'", "'router_admit'")
+        assert all(f.rule != "TF123" for f in
+                   lint_source(other, "tpuframe/serve/foo.py"))
+
+    def test_scheduler_default_clock_is_monotonic(self):
+        """Satellite 6: router wait_ms and scheduler queue/prefill spans
+        subtract against the SAME clock family, so the phase sum can be
+        asserted against the queue-inclusive TTFT."""
+        from tpuframe.serve.replica import FakeEngine
+        from tpuframe.serve.scheduler import Scheduler
+
+        assert Scheduler(FakeEngine(slots=1))._clock is time.monotonic
+        assert Router(["http://a"], transport=_ok_reply)._clock \
+            is time.monotonic
+
+    def test_trace_check_is_clean(self):
+        assert tracing.check() == []
+
+    def test_cli_trace_id_positional_paste_back(self, capsys):
+        """The summary's exemplar rows print bare trace ids; `obs trace
+        <dir> <tid>` must accept one pasted straight back (positional,
+        not just --trace), and an unknown id is rc 2."""
+        import pathlib
+
+        from tpuframe.obs.__main__ import _load, main
+
+        d = str(pathlib.Path(tracing.__file__).resolve()
+                .parents[2] / "docs" / "samples" / "traced_fleet")
+        tid = next(iter(tracing.build_traces(_load(d))))
+        assert main(["trace", d, tid]) == 0
+        out = capsys.readouterr().out
+        assert f"trace {tid}:" in out and "critical path:" in out
+        assert main(["trace", d, "tNOPE.0000"]) == 2

@@ -67,6 +67,16 @@ non-zero on any finding:
      refuses to run blind), and on a multi-device backend pins the
      psum-linearity identity: per-leaf, packed, and staged reductions
      agree to 1e-6.
+  13. trace self-check — the request-tracing plane
+     (:mod:`tpuframe.obs.tracing`) cross-pins its span schema against
+     ``obs/events.py``'s registry, runs the TF123 tracing-seam lint
+     over the tree, round-trips a synthetic healthy trace (exactly one
+     complete root, verifier-clean), seeds leaked-span / orphan-span /
+     TTFT-mismatch positives the verifier MUST flag (the trace gate
+     refuses to run blind), reconstructs the golden traced-fleet
+     sample (``docs/samples/traced_fleet/``) clean with a resolvable
+     p99 exemplar, and checks the SLO sentry's default specs and its
+     rc contract (``tpuframe.obs.tracing.check``).
 
 ``--json PATH`` writes the whole gate outcome as a schema-pinned report;
 ``--compare A.json B.json`` diffs two such reports for structural
@@ -355,6 +365,16 @@ def _run_rollout_check() -> int:
     return len(problems)
 
 
+def _run_trace_check() -> int:
+    from tpuframe.obs import tracing
+
+    problems = tracing.check()
+    for p in problems:
+        print(f"TRACE {p}")
+    print(f"[analysis] trace self-check: {len(problems)} problem(s)")
+    return len(problems)
+
+
 def _run_obs_check() -> int:
     # Through the real CLI entry point, not an import — the gate then
     # also catches a broken ``python -m tpuframe.obs`` invocation.
@@ -446,6 +466,7 @@ def main(argv=None) -> int:
         n_findings += _run_quantwire_check()
         n_findings += _run_pspec_check()
         n_findings += _run_plan_check()
+        n_findings += _run_trace_check()
         n_findings += _run_obs_check()
         if args.json:
             _write_json(args.json, audits, lint_findings, args.devices)

@@ -168,6 +168,18 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          strategy signed elsewhere would flip the gate on a program
          nothing pins; seeded-positive test rigs suppress with
          ``# tf-lint: ok[TF122]`` and a reason.
+  TF123  raw span event emitted outside the tracing seam — an
+         ``events.emit("span_open"/"span_close"/"span_note", ...)``
+         call anywhere but ``obs/tracing.py``.  Span records carry
+         invariants the schema alone cannot express: every open must
+         have a matching close (``obs anomalies`` reports leaks), ids
+         come from the process-unique minting counter, and the
+         open-span registry behind the ``tpuframe_open_spans`` gauge
+         is only maintained by ``tracing.open_span``/``close_span``.
+         A hand-rolled emit produces spans the verifier counts as
+         leaked or orphaned; use ``tracing.open_span``/``close_span``/
+         ``span``/``note``, or suppress with ``# tf-lint: ok[TF123]``
+         and a reason (seeded-positive test rigs).
 
 Scope: TF101/TF102 only fire *inside functions known to be traced*
 (decorated with ``jax.jit``/``pmap``/``shard_map`` or passed to
@@ -254,6 +266,11 @@ RULES = {
              "shardflow's exposed-comm hard gate, and only the strategy "
              "seam's registrations are covered by the pinned "
              "fixtures/schedules",
+    "TF123": "raw span event (span_open/span_close/span_note) emitted "
+             "outside obs/tracing.py — bypasses span-id minting and "
+             "the open-span registry, producing spans the trace "
+             "verifier counts as leaked or orphaned; use the "
+             "tracing.open_span/close_span/span/note API",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -395,6 +412,14 @@ _STRATEGY_EXEMPT_SUFFIXES = ("analysis/strategies.py",)
 # that turns a checkpoint from the wrong model into a silent poisoning
 # of every compiled program.
 _SWAP_SCOPE_SUFFIXES = ("serve/rollout.py", "serve/replica.py")
+
+# TF123: the one module allowed to emit raw span records.  The literals
+# mirror obs/tracing.py's SPAN_EVENT_TYPES — no import (same
+# importable-anywhere constraint as _event_type_registry below), and
+# trace.check() cross-pins the two copies via the schema registry.
+_TRACE_SEAM_SUFFIXES = ("obs/tracing.py",)
+_SPAN_EVENT_LITERALS = ("span_open", "span_close", "span_note")
+
 _NET_CALL_DOTTED = {"socket.socket", "socket.create_connection"}
 _NET_CALL_TAILS = {"urlopen", "HTTPConnection", "HTTPSConnection"}
 
@@ -609,6 +634,7 @@ class FileContext:
         self.strategy_scope = not norm.endswith(
             _STRATEGY_EXEMPT_SUFFIXES)
         self.swap_scope = norm.endswith(_SWAP_SCOPE_SUFFIXES)
+        self.trace_scope = not norm.endswith(_TRACE_SEAM_SUFFIXES)
         self.lock_scope = any(p in norm for p in _LOCK_DISCIPLINE_PARTS)
         self.wire_scope = norm.endswith(_WIRE_SEAM_SUFFIXES)
         self.world_scope = not any(p in norm
@@ -1134,6 +1160,34 @@ def _tf122_overlap_contract(ctx: FileContext, node, fn):
                  f"schedule fixtures; register through the seam, or "
                  f"suppress with tf-lint: ok[TF122] and a reason", fn)
         return
+
+
+@_node_rule
+def _tf123_span_seam(ctx: FileContext, node, fn):
+    """Raw span emission behind the tracing seam's back: an
+    ``events.emit("span_open"/"span_close"/"span_note", ...)`` call
+    outside ``obs/tracing.py``.  Span records carry pairing invariants
+    the schema cannot express — a hand-rolled emit skips span-id
+    minting and the open-span registry, so the verifier counts its
+    spans as leaked/orphaned and the ``tpuframe_open_spans`` gauge
+    drifts.  Matches the same receiver shapes as TF112."""
+    if (not ctx.trace_scope
+            or not isinstance(node, ast.Call)
+            or not isinstance(node.func, ast.Attribute)
+            or node.func.attr != "emit"
+            or _dotted(node.func.value).rsplit(".", 1)[-1]
+            not in _EMIT_RECEIVERS
+            or not node.args
+            or not isinstance(node.args[0], ast.Constant)
+            or node.args[0].value not in _SPAN_EVENT_LITERALS):
+        return
+    ctx.emit("TF123", node,
+             f"events.emit({node.args[0].value!r}) outside "
+             f"obs/tracing.py — raw span records bypass span-id "
+             f"minting and the open-span registry (the verifier will "
+             f"count them leaked/orphaned); use tracing.open_span/"
+             f"close_span/span/note, or suppress with "
+             f"tf-lint: ok[TF123] and a reason", fn)
 
 
 @_fn_rule

@@ -20,6 +20,20 @@ single file):
              serve TTFT/TPOT p90 against thresholds; exits 1 when B
              regressed.  With the on-chip relay down, this is how two
              runs' profiles are proven same-or-better offline.
+  trace      per-request waterfalls from the tracing plane's span
+             events: reconstructs every trace from the merged
+             multi-process stream, renders the slowest (or a named
+             --trace/--rid) as an indented waterfall with the critical
+             path, and verifies the completeness contract — every
+             admitted rid resolves to exactly one complete root span,
+             no orphan/leaked spans, phase sums match the recorded
+             queue-inclusive TTFT within --tol-ms.  Exits 1 on any
+             trace anomaly.
+  slo        the tail-latency SLO sentry: evaluates declared TTFT/TPOT
+             objectives (--slo / TPUFRAME_SLO) with multi-window burn
+             rates (--windows / TPUFRAME_SLO_WINDOWS) over the event
+             stream.  Exits 0 all met / 1 breached / 2 no data — the
+             same rc contract as ``compare``.
 
 Examples::
 
@@ -27,6 +41,8 @@ Examples::
     python -m tpuframe.obs anomalies /runs/r7/events --mfu-min 0.3
     python -m tpuframe.obs merge /runs/r7/events -o merged.jsonl
     python -m tpuframe.obs compare /runs/baseline /runs/candidate
+    python -m tpuframe.obs trace /runs/fleet/events --slowest 3
+    python -m tpuframe.obs slo /runs/fleet/events --slo 'ttft<=800ms@99%'
 """
 
 from __future__ import annotations
@@ -38,6 +54,8 @@ import sys
 
 from tpuframe.obs import events as events_lib
 from tpuframe.obs import goodput as goodput_lib
+from tpuframe.obs import slo as slo_lib
+from tpuframe.obs import tracing
 
 
 def _percentile(sorted_vals: list[float], q: float) -> float:
@@ -114,6 +132,24 @@ def _selfcheck_compare() -> list[str]:
     return problems
 
 
+def _selfcheck_trace() -> list[str]:
+    """The tracing plane's golden test: the shipped traced-fleet sample
+    (a real 2-replica fleet run) must reconstruct whole — every admitted
+    rid to one complete root, zero orphans/leaks, phase sums matching
+    the recorded TTFT."""
+    sample = os.path.join(_samples_root(), "traced_fleet")
+    if not events_lib.event_files(sample):
+        return [f"traced-fleet golden sample missing under {sample}"]
+    merged = events_lib.merge(sample)
+    problems = [f"traced_fleet: [{p['kind']}] {p['detail']}"
+                for p in tracing.verify_traces(merged)]
+    traces = tracing.build_traces(merged)
+    if not any(tv.complete_roots() for tv in traces.values()):
+        problems.append("traced_fleet: no complete request root "
+                        "reconstructed")
+    return problems
+
+
 def cmd_selfcheck(directory: str | None) -> int:
     paths = (events_lib.event_files(directory) if directory
              else _sample_paths())
@@ -123,8 +159,10 @@ def cmd_selfcheck(directory: str | None) -> int:
     problems = events_lib.validate_files(paths)
     if directory is None:
         # Default (shipped-samples) mode also proves the compare sentry
-        # against its golden pair.
+        # against its golden pair and the trace reconstructor against
+        # the traced-fleet sample.
         problems += _selfcheck_compare()
+        problems += _selfcheck_trace()
     for p in problems:
         print(f"OBS {p}")
     print(f"[obs] selfcheck: {len(paths)} file(s), "
@@ -220,6 +258,14 @@ def cmd_summarize(directory: str, generation: str | None) -> int:
             pcts = fleet["ttft_ms"]
             print("  router TTFT (ms): " + " ".join(
                 f"{q}={pcts[q]:.2f}" for q in ("p50", "p90", "p99")))
+        if fleet.get("ttft_exemplars"):
+            # Exemplars: the actual request behind each percentile row —
+            # "p99 regressed" becomes "obs trace --trace <id>".
+            for q, ex in fleet["ttft_exemplars"].items():
+                tid = ex.get("trace")
+                link = f"trace {tid}" if tid else "untraced"
+                print(f"  {q} exemplar: rid {ex.get('id')} "
+                      f"({ex['ttft_ms']:.2f} ms, {link})")
     return 0
 
 
@@ -281,6 +327,121 @@ def cmd_compare(args) -> int:
     return 1 if result["regressions"] else 0
 
 
+def _span_label(sp) -> str:
+    fields = dict(sp.opened or {})
+    fields.update(sp.closed or {})
+    extras = []
+    for key in ("replica", "cause", "status", "rid", "tokens"):
+        if fields.get(key) is not None:
+            extras.append(f"{key}={fields[key]}")
+    if fields.get("duplicate"):
+        extras.append("duplicate")
+    name = sp.name or "?"
+    return f"{name}" + (f" [{' '.join(extras)}]" if extras else "")
+
+
+def _print_trace(tid: str, tv, root) -> None:
+    total_ms = root.ms or 0.0
+    head = f"trace {tid}"
+    if root.closed is not None:
+        head += (f": total {total_ms:.2f} ms, "
+                 f"ttft {float(root.closed.get('ttft_ms') or 0):.2f} ms")
+    else:
+        head += ": INCOMPLETE (root never closed)"
+    print(head)
+    t0 = float((root.opened or {}).get("t") or 0.0)
+    width = 40
+    for row in tracing.waterfall(root):
+        sp = row["span"]
+        label = "  " * row["depth"] + _span_label(sp)
+        off_ms = 1e3 * max(0.0, float((sp.opened or {}).get("t") or t0)
+                           - t0)
+        if sp.ms is None:
+            print(f"  {label:<36} |{'?' * width}| OPEN "
+                  f"(+{off_ms:.1f} ms, never closed)")
+            continue
+        if total_ms > 0:
+            start = int(width * min(1.0, off_ms / total_ms))
+            span_w = max(1, int(round(width * min(1.0,
+                                                  sp.ms / total_ms))))
+            bar = (" " * start + "#" * min(span_w, width - start)
+                   ).ljust(width)
+        else:
+            bar = "#".ljust(width)
+        print(f"  {label:<36} |{bar}| {sp.ms:.2f} ms "
+              f"(+{off_ms:.1f})")
+    for rec in tv.notes:
+        print(f"  note: {rec.get('note')} "
+              + " ".join(f"{k}={rec[k]}" for k in ("replica", "reason")
+                         if rec.get(k) is not None))
+    path = tracing.critical_path(root)
+    print("  critical path: " + " -> ".join(
+        f"{sp.name}({sp.ms:.1f}ms)" if sp.ms is not None
+        else f"{sp.name}(open)" for sp in path))
+
+
+def cmd_trace(args) -> int:
+    merged = _load(args.dir)
+    traces = tracing.build_traces(merged)
+    problems = tracing.verify_traces(merged, tol_ms=args.tol_ms)
+    roots = []
+    for tid, tv in traces.items():
+        for sp in tv.roots:
+            if sp.name == "request":
+                roots.append((tid, tv, sp))
+    complete = [x for x in roots if x[2].complete]
+    print(f"traces: {len(traces)} trace(s), {len(roots)} request "
+          f"root(s), {len(complete)} complete")
+    want_tid = args.trace or getattr(args, "trace_id", None)
+    if want_tid is not None:
+        selected = [x for x in roots if x[0] == want_tid]
+        if not selected:
+            print(f"[obs] trace: no trace {want_tid!r} in this stream",
+                  file=sys.stderr)
+            return 2
+    elif args.rid is not None:
+        tid = tracing.trace_of(merged, args.rid)
+        selected = [x for x in roots if x[0] == tid]
+        if not selected:
+            print(f"[obs] trace: rid {args.rid} has no trace (unsampled "
+                  f"or never admitted)", file=sys.stderr)
+            return 2
+    else:
+        selected = sorted(complete,
+                          key=lambda x: -(x[2].ms or 0.0))[:args.slowest]
+    for tid, tv, root in selected:
+        _print_trace(tid, tv, root)
+    for pr in problems:
+        print(f"TRACE-ANOMALY [{pr['kind']}] {pr['detail']}")
+    print(f"[obs] trace: {len(problems)} anomaly(s)")
+    return 1 if problems else 0
+
+
+def cmd_slo(args) -> int:
+    merged = _load(args.dir)
+    try:
+        slos = (slo_lib.parse_slos(args.slo) if args.slo
+                else slo_lib.resolve_slos())
+        windows = (slo_lib.parse_windows(args.windows) if args.windows
+                   else slo_lib.resolve_windows())
+    except ValueError as e:
+        print(f"[obs] slo: {e}", file=sys.stderr)
+        return 2
+    result = slo_lib.evaluate(merged, slos, windows)
+    for row in result["slos"]:
+        status = ("NO DATA" if row["breached"] is None
+                  else "BREACHED" if row["breached"] else "met")
+        print(f"SLO {row['slo']}: {status} ({row['samples']} sample(s), "
+              f"{row['violations']} violation(s))")
+        for w in row["windows"]:
+            mark = "BREACH" if w["breached"] else "ok"
+            print(f"  window {w['window_s']:g}s: worst burn "
+                  f"{w['burn']:.3f} over {w['n']} sample(s) "
+                  f"(max {w['max_burn']:g}) {mark}")
+    print(f"[obs] slo: rc {result['rc']}")
+    return result["rc"]
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="python -m tpuframe.obs",
                                 description=__doc__)
@@ -337,6 +498,36 @@ def main(argv: list[str] | None = None) -> int:
     cp.add_argument("--gen", default=None,
                     help="TPU generation for MFU recompute")
 
+    tp = sub.add_parser("trace",
+                        help="per-request waterfalls + completeness "
+                             "verification from span events")
+    tp.add_argument("dir", help="events directory of a traced fleet run")
+    tp.add_argument("trace_id", nargs="?", default=None,
+                    help="render this trace id (paste from a summary "
+                         "exemplar row); default: the slowest")
+    tp.add_argument("--trace", default=None,
+                    help="render this trace id (default: the slowest)")
+    tp.add_argument("--rid", type=int, default=None,
+                    help="render the trace of this router rid")
+    tp.add_argument("--slowest", type=int, default=3,
+                    help="how many slowest traces to render (default 3)")
+    tp.add_argument("--tol-ms", type=float, default=5.0,
+                    help="phase-sum vs recorded-TTFT tolerance (ms)")
+
+    lp = sub.add_parser("slo",
+                        help="tail-latency SLO sentry (multi-window "
+                             "burn rates); rc 0 met / 1 breach / 2 no "
+                             "data")
+    lp.add_argument("dir", help="events directory to evaluate")
+    lp.add_argument("--slo", default=None,
+                    help="objectives, e.g. 'ttft<=800ms@99%%,"
+                         "tpot<=50ms@95%%' (default: TPUFRAME_SLO or "
+                         f"'{slo_lib.DEFAULT_SLO}')")
+    lp.add_argument("--windows", default=None,
+                    help="window_s:max_burn pairs (default: "
+                         "TPUFRAME_SLO_WINDOWS or "
+                         f"'{slo_lib.DEFAULT_WINDOWS}')")
+
     args = p.parse_args(argv)
     if args.cmd == "summarize":
         if args.selfcheck:
@@ -348,6 +539,10 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_merge(args.dir, args.out)
     if args.cmd == "compare":
         return cmd_compare(args)
+    if args.cmd == "trace":
+        return cmd_trace(args)
+    if args.cmd == "slo":
+        return cmd_slo(args)
     return cmd_anomalies(args.dir, args)
 
 

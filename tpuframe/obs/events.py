@@ -87,6 +87,15 @@ Event types (see ``REQUIRED_FIELDS`` for the per-type contract):
                  new version (version, replicas, mixed-version window s)
   rollout_abort  the rollout was rolled back — the canary gate caught a
                  regression (version, the failing metric, reason)
+  span_open      a trace span opened (trace id, span id, name; parent
+                 span id when not a root) — emitted ONLY through
+                 obs.tracing, the sanctioned span API (lint TF123)
+  span_close     the span closed (trace, span, same-process monotonic
+                 duration ms; outcome fields like status/duplicate/
+                 ttft_ms ride along)
+  span_note      a trace annotation that is not a timed phase (drain
+                 re-queue, rollout swap) — trace id + note text,
+                 optionally anchored to a span
   ============== ========================================================
 
 Emission is *best-effort everywhere*: ``emit()`` is a no-op until
@@ -155,6 +164,12 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "rollout_step": ("replica", "version", "phase"),
     "rollout_done": ("version", "replicas"),
     "rollout_abort": ("version", "metric", "reason"),
+    # Span events are additive within schema v2 (old readers never see
+    # them unless emitted).  obs.tracing.SPAN_REQUIRED_FIELDS pins the
+    # same tuples and trace.check() cross-checks the two copies.
+    "span_open": ("trace", "span", "name"),
+    "span_close": ("trace", "span", "ms"),
+    "span_note": ("trace", "note"),
 }
 
 _ENVELOPE = ("schema", "type", "t", "host", "proc", "attempt")

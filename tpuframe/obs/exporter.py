@@ -133,6 +133,17 @@ class MetricsExporter:
                             float(v)))
         except Exception:  # noqa: BLE001 — counters are best-effort
             pass
+        try:
+            from tpuframe.obs import tracing
+
+            # Live leak signal: spans this process opened and has not
+            # closed.  A replica stuck with unanswered requests shows a
+            # climbing gauge on /metrics long before the offline
+            # leaked-span anomaly sweep ever runs.
+            out.append(("tpuframe_open_spans", {},
+                        float(tracing.open_span_count())))
+        except Exception:  # noqa: BLE001 — best-effort like the counters
+            pass
         with self._lock:
             gauges = list(self._gauges.items())
             collectors = list(self._collectors)

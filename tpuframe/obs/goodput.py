@@ -365,6 +365,12 @@ def find_anomalies(events: list[dict], *, slow_factor: float = 3.0,
         violated partition means a double-charged or lost slice, and
         silently rescaling it would hide the accounting bug the
         invariant exists to catch.
+      leaked_span / orphan_span — tracing-plane failure modes
+        (``obs.tracing.span_anomalies``): a span opened with no close
+        before the stream ended (a request a replica never answered, or
+        a process that died holding it), and a close/child/note whose
+        span or parent was never opened (a propagation bug or torn
+        context).
     """
     findings: list[dict] = []
 
@@ -473,6 +479,13 @@ def find_anomalies(events: list[dict], *, slow_factor: float = 3.0,
                               f"{total:.3f}s but wall is {wall:.3f}s — "
                               f"bucket accounting violated (delta "
                               f"{total - wall:+.3f}s)"})
+
+    if any(r.get("type") in ("span_open", "span_close", "span_note")
+           for r in events):
+        # Lazy on purpose: training-only logs never pay the import.
+        from tpuframe.obs import tracing
+
+        findings.extend(tracing.span_anomalies(events))
     return findings
 
 
@@ -557,8 +570,22 @@ def fleet_stats(events: list) -> dict | None:
     if not (done or admits or sheds or summary is not None):
         return None
 
-    ttft = sorted(float(r["ttft_ms"]) for r in done
-                  if r.get("ttft_ms") is not None)
+    with_ttft = sorted((r for r in done if r.get("ttft_ms") is not None),
+                       key=lambda r: float(r["ttft_ms"]))
+    ttft = [float(r["ttft_ms"]) for r in with_ttft]
+    # Exemplars: each percentile row links the ACTUAL request at that
+    # rank — its trace id (when traced) and rid — so "p99 regressed"
+    # becomes "open this trace's waterfall", not a number with no story.
+    exemplars = None
+    if with_ttft:
+        exemplars = {}
+        for q, frac in (("p50", 0.5), ("p90", 0.9), ("p99", 0.99)):
+            idx = min(len(with_ttft) - 1,
+                      int(round(frac * (len(with_ttft) - 1))))
+            rec = with_ttft[idx]
+            exemplars[q] = {"id": rec.get("id"),
+                            "trace": rec.get("trace"),
+                            "ttft_ms": round(float(rec["ttft_ms"]), 3)}
     by_replica: dict = {}
     for r in done:
         name = str(r.get("replica"))
@@ -607,6 +634,7 @@ def fleet_stats(events: list) -> dict | None:
         "ttft_ms": {q: round(_pct(ttft, v), 3) for q, v in
                     (("p50", 0.5), ("p90", 0.9), ("p99", 0.99))}
         if ttft else None,
+        "ttft_exemplars": exemplars,
     }
 
 

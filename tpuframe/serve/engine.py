@@ -35,6 +35,8 @@ safe on every jax — ``utils.compile_cache.outputs_cache_safe``).
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from tpuframe.serve import kv_cache as kv
@@ -127,6 +129,7 @@ class LMEngine:
         self.cfg = cfg
         self.model = TransformerLM(cfg)
         self.eos_id = eos_id
+        self.last_prefill_ms = 0.0
         self.decode_block = (decode_block if decode_block is not None
                              else kv.resolve_decode_block())
         buckets = (tuple(prompt_buckets) if prompt_buckets is not None
@@ -250,10 +253,16 @@ class LMEngine:
         bucket = kv.bucket_for(len(ids), self.prompt_buckets)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(ids)] = ids
+        t0 = time.monotonic()
         tok, pcache = self._prefill[bucket](
             self.params, jnp.asarray(padded),
             jnp.asarray([len(ids)], jnp.int32))
-        return int(tok[0]), pcache, len(ids)
+        first = int(tok[0])   # host sync: the first token materializes
+        # Host-observed executable time (through the sync above) — the
+        # scheduler's prefill trace span reports it as ``engine_ms`` so
+        # waterfalls split bucket-dispatch overhead from device work.
+        self.last_prefill_ms = 1e3 * (time.monotonic() - t0)
+        return first, pcache, len(ids)
 
     def insert(self, slot: int, pcache, length: int,
                first_token: int) -> None:

@@ -68,6 +68,7 @@ import time
 
 from tpuframe.obs import events as obs_events
 from tpuframe.obs import exporter as obs_exporter
+from tpuframe.obs import tracing
 from tpuframe.resilience import faults
 from tpuframe.serve.scheduler import Request, Scheduler
 
@@ -107,10 +108,13 @@ class FakeEngine:
         self.step_delay_s = step_delay_s
         self.vocab_size = vocab_size
         self._last = [0] * slots
+        self.last_prefill_ms = 0.0
 
     def prefill(self, token_ids):
+        t0 = time.monotonic()
         first = (sum(int(t) for t in token_ids)
                  + 31 * len(token_ids)) % self.vocab_size
+        self.last_prefill_ms = 1e3 * (time.monotonic() - t0)
         return first, ("pcache", len(token_ids)), len(token_ids)
 
     def insert(self, slot, pcache, length, first_token) -> None:
@@ -202,14 +206,34 @@ class Replica:
                           f"{self.engine.prompt_buckets}"}).encode()
         if self._draining:
             return 503, json.dumps({"error": "draining"}).encode()
+        # arrival_t on the SCHEDULER's clock — queue/prefill spans and
+        # the serve_request TTFT are deltas against it, so every
+        # replica-side duration comes from one monotonic clock source.
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new,
-                      arrival_t=time.perf_counter())
+                      arrival_t=self.scheduler._clock())
+        trace = msg.get("trace")
+        if trace is not None:
+            # The router's attempt span id arrives as "span": parenting
+            # the serve span under it stitches the cross-process tree.
+            req.trace = str(trace)
+            req.span = tracing.open_span(req.trace, "serve",
+                                         parent=msg.get("span"), rid=rid)
         done = threading.Event()
         with self._inbox_lock:
             self._inbox.append((req, done))
         if not done.wait(self.handler_timeout_s):
+            # The serve span stays OPEN on purpose: a request the
+            # scheduler never answered is exactly what the leaked-span
+            # anomaly exists to make loud.
             return 504, json.dumps(
                 {"error": "timed out waiting for the scheduler"}).encode()
+        if req.trace is not None and req.span is not None:
+            tracing.close_span(
+                req.trace, req.span,
+                1e3 * max(0.0, self.scheduler._clock() - req.arrival_t),
+                ttft_ms=round(req.ttft_ms() or 0.0, 3),
+                tpot_ms=round(req.tpot_ms(), 3)
+                if req.tpot_ms() is not None else None)
         return 200, json.dumps({
             "rid": rid,
             "tokens": [int(t) for t in req.tokens],

@@ -67,6 +67,7 @@ import os
 import time
 
 from tpuframe.obs import events as obs_events
+from tpuframe.obs import tracing
 from tpuframe.resilience.policy import RetryPolicy
 from tpuframe.serve.router import Router, parse_gauges
 
@@ -208,6 +209,14 @@ class RolloutController:
         self._bake_start_idx = 0
         self._bake_start_t = 0.0
         self._canary_name: str | None = None
+        # Fleet-operation trace (always sampled — one per roll, never
+        # volume): a "rollout" root span open for the whole roll, with
+        # every per-replica phase as a note under it, so a request
+        # waterfall and the swap that delayed it land in one event
+        # stream with the same vocabulary.
+        self.trace: str | None = None
+        self._root_span: str | None = None
+        self._trace_t0 = 0.0
 
     # -- observability ------------------------------------------------------
 
@@ -215,7 +224,18 @@ class RolloutController:
         self.history.append((self._clock(), replica, phase))
         obs_events.emit("rollout_step", replica=replica, version=version,
                         phase=phase)
+        if self.trace is not None:
+            tracing.note(self.trace, phase, span=self._root_span,
+                         replica=replica, version=version)
         self._log(f"rollout: {replica} {phase} (v{version})")
+
+    def _close_trace(self, status: str) -> None:
+        if self.trace is not None and self._root_span is not None:
+            tracing.close_span(
+                self.trace, self._root_span,
+                1e3 * max(0.0, self._clock() - self._trace_t0),
+                status=status, version=self.target)
+            self._root_span = None
 
     def summary(self) -> dict:
         return {
@@ -272,6 +292,11 @@ class RolloutController:
         self._swap_to = self.target
         self._canary_name = (names[0] if self.canary_frac > 0
                              and len(names) > 1 else None)
+        self.trace = tracing.mint(f"rollout-v{self.target}", force=True)
+        self._trace_t0 = self._clock()
+        self._root_span = tracing.open_span(
+            self.trace, "rollout", version=self.target,
+            replicas=len(names))
         self.state = "rolling"
         self._enter_phase("drain")
         self._log(f"rollout: v{self.current_version} -> v{self.target} "
@@ -421,6 +446,7 @@ class RolloutController:
         if self._rollback:
             self.state = "aborted"
             self.router.clear_canary()
+            self._close_trace("aborted")
             return
         name = self._rep_name()
         self._cursor += 1
@@ -460,6 +486,7 @@ class RolloutController:
         obs_events.emit("rollout_done", version=self.target,
                         replicas=len(self._plan),
                         window_s=self.window_s)
+        self._close_trace("done")
         self._log(f"rollout: done — fleet on v{self.target}, "
                   f"mixed-version window {self.window_s}s")
 
@@ -537,6 +564,7 @@ class RolloutController:
             self._enter_phase("drain")
         else:
             self.state = "aborted"
+            self._close_trace("aborted")
 
 
 # ---------------------------------------------------------------------------

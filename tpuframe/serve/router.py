@@ -60,6 +60,7 @@ import urllib.request
 from dataclasses import dataclass, field
 
 from tpuframe.obs import events as obs_events
+from tpuframe.obs import tracing
 from tpuframe.obs.goodput import _pct
 from tpuframe.resilience.policy import RetryPolicy
 
@@ -178,6 +179,11 @@ class RoutedRequest:
     ttft_ms: float | None = None       # router wait + winning replica TTFT
     replica: str | None = None         # winning replica
     result: dict | None = None
+    # Tracing context (None when sampled out): the trace id minted at
+    # admission and the root "request" span every attempt/serve span
+    # parents under.  Rides the dispatch payload into the replica.
+    trace: str | None = None
+    root_span: str | None = None
 
     @property
     def done(self) -> bool:
@@ -232,6 +238,10 @@ class Router:
                          "hedged": 0, "redispatched": 0, "duplicates": 0,
                          "dispatch_errors": 0, "drains": 0}
         self._done_q: queue.SimpleQueue = queue.SimpleQueue()
+        # Attempt spans launched but not yet reaped — lets run() grant a
+        # bounded grace window so late hedge losers close their spans
+        # instead of leaking them into the offline anomaly sweep.
+        self._open_attempts: set[tuple[str, str]] = set()
         # Canary constraint (rollout controller): while set, a seeded
         # fraction of fresh placements is steered onto the canary
         # replica and the rest onto the old-version pool.
@@ -255,11 +265,16 @@ class Router:
                 raise Shed(f"request {rid}: router queue full "
                            f"({depth}/{self.queue_limit})")
             return False
-        self.pending.append(RoutedRequest(
+        req = RoutedRequest(
             rid=rid, prompt=list(prompt),
-            max_new_tokens=int(max_new_tokens), submit_t=self._clock()))
+            max_new_tokens=int(max_new_tokens), submit_t=self._clock(),
+            trace=tracing.mint(rid))
+        if req.trace is not None:
+            req.root_span = tracing.open_span(req.trace, "request",
+                                              rid=rid)
+        self.pending.append(req)
         self.counters["admitted"] += 1
-        obs_events.emit("router_admit", id=rid)
+        obs_events.emit("router_admit", id=rid, trace=req.trace)
         return True
 
     # -- the routing loop --------------------------------------------------
@@ -360,16 +375,30 @@ class Router:
         url = rep.url + "/generate"
         payload = {"rid": req.rid, "prompt": req.prompt,
                    "max_new_tokens": req.max_new_tokens}
+        span = None
+        if req.trace is not None:
+            span = tracing.open_span(req.trace, "attempt",
+                                     parent=req.root_span,
+                                     replica=rep.name, cause=cause)
+            self._open_attempts.add((req.trace, span))
+            # Context propagation: the replica parents its serve span
+            # under this attempt, so a hedge race reconstructs as two
+            # sibling attempt subtrees of one root.
+            payload["trace"] = req.trace
+            payload["span"] = span
+        trace = req.trace
 
         def attempt():
             try:
                 status, body = self.dispatch_policy.call(
                     self._transport, url, payload,
                     self.dispatch_timeout_s, op="router_dispatch")
-                self._done_q.put((req.rid, rep.name, start_t, status, body))
+                self._done_q.put((req.rid, rep.name, start_t, status,
+                                  body, trace, span))
             except Exception as e:  # noqa: BLE001 — retries exhausted or
                 # non-retryable: the loop requeues/marks draining
-                self._done_q.put((req.rid, rep.name, start_t, None, e))
+                self._done_q.put((req.rid, rep.name, start_t, None, e,
+                                  trace, span))
 
         # This thread only does stdlib HTTP + a queue put — it never
         # touches jax or a collective, so the TF111 ordering hazard does
@@ -383,10 +412,19 @@ class Router:
             cause, "router_dispatch")
         obs_events.emit(etype, id=req.rid, replica=rep.name)
 
+    def _close_attempt(self, trace, span, start_t: float, *,
+                       status: str, **fields) -> None:
+        if trace is None or span is None:
+            return
+        self._open_attempts.discard((trace, span))
+        tracing.close_span(trace, span,
+                           1e3 * max(0.0, self._clock() - start_t),
+                           status=status, **fields)
+
     def _reap(self) -> None:
         while True:
             try:
-                rid, rep_name, start_t, status, body = \
+                rid, rep_name, start_t, status, body, trace, span = \
                     self._done_q.get_nowait()
             except queue.Empty:
                 return
@@ -396,14 +434,25 @@ class Router:
             req = self.inflight.get(rid)
             if req is None or req.done:
                 # Hedge/redispatch loser finishing late: first winner
-                # was kept, this one is only counted.
+                # was kept, this one is only counted — and its span
+                # closes ``duplicate=true`` under the same trace.
                 if status == 200:
                     self.counters["duplicates"] += 1
+                    self._close_attempt(trace, span, start_t,
+                                        status="ok", duplicate=True)
+                else:
+                    self._close_attempt(trace, span, start_t,
+                                        status="error", duplicate=True)
                 continue
             req.live -= 1
             if status == 200 and isinstance(body, dict):
+                self._close_attempt(trace, span, start_t, status="ok")
                 self._complete(req, rep_name, start_t, body)
                 continue
+            self._close_attempt(
+                trace, span, start_t, status="error",
+                detail=(type(body).__name__ if status is None
+                        else int(status)))
             self.counters["dispatch_errors"] += 1
             if rep is not None and rep.state == "ok":
                 why = (f"dispatch {type(body).__name__}"
@@ -413,6 +462,9 @@ class Router:
                 # No racing attempt left: back to the queue front.
                 req.requeued = True
                 self.pending.insert(0, req)
+                if req.trace is not None:
+                    tracing.note(req.trace, "requeue",
+                                 span=req.root_span, replica=rep_name)
 
     def _complete(self, req: RoutedRequest, rep_name: str, start_t: float,
                   body: dict) -> None:
@@ -426,9 +478,19 @@ class Router:
             self.pending.remove(req)
         self.completed.append(req)
         self.counters["completed"] += 1
+        if req.trace is not None and req.root_span is not None:
+            # wait_ms + the replica's queue + prefill spans must sum to
+            # this ttft_ms — the invariant verify_traces enforces.
+            tracing.close_span(
+                req.trace, req.root_span,
+                1e3 * max(0.0, req.done_t - req.submit_t),
+                replica=rep_name, ttft_ms=round(req.ttft_ms, 3),
+                wait_ms=round(wait_ms, 3),
+                tokens=len(body.get("tokens") or []))
         obs_events.emit(
             "router_request", id=req.rid, replica=rep_name,
             ttft_ms=round(req.ttft_ms, 3),
+            wait_ms=round(wait_ms, 3), trace=req.trace,
             output_tokens=len(body.get("tokens") or []),
             attempts=req.attempts)
 
@@ -448,6 +510,10 @@ class Router:
                 continue
             req.requeued = True
             self.pending.insert(0, req)
+            if req.trace is not None:
+                tracing.note(req.trace, "drain_requeue",
+                             span=req.root_span, replica=rep.name,
+                             reason=reason)
 
     def _scrape_due(self, now: float) -> None:
         for rep in self.replicas:
@@ -550,6 +616,15 @@ class Router:
             if now > timeout_s:
                 timed_out = True
                 break
+            time.sleep(poll_s)
+        # Bounded grace for late hedge/redispatch losers: their attempt
+        # threads may still be in flight after every request retired;
+        # reap them so their spans close as duplicates instead of
+        # leaking.  Wall clock on purpose — tests inject fake _clocks
+        # that do not advance while we sleep.
+        grace_end = time.monotonic() + 2.0
+        while self._open_attempts and time.monotonic() < grace_end:
+            self._reap()
             time.sleep(poll_s)
         out = self.summary()
         out["submitted"] = i

@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 
 from tpuframe.obs import events as obs_events
 from tpuframe.obs import exporter as obs_exporter
+from tpuframe.obs import tracing
 from tpuframe.obs.goodput import _pct
 
 
@@ -49,6 +50,11 @@ class Request:
     first_token_t: float | None = None
     done_t: float | None = None
     tokens: list = field(default_factory=list)   # generated tokens
+    # Tracing context: trace id propagated in the /generate payload and
+    # the replica-side "serve" span the scheduler's queue/prefill/decode
+    # phase spans parent under.  None when the request is untraced.
+    trace: str | None = None
+    span: str | None = None
 
     @property
     def done(self) -> bool:
@@ -75,7 +81,7 @@ class Scheduler:
     the default is the host monotonic clock.
     """
 
-    def __init__(self, engine, *, clock=time.perf_counter):
+    def __init__(self, engine, *, clock=time.monotonic):
         self.engine = engine
         self._clock = clock
         self.pending: list = []                 # FIFO of Request
@@ -178,10 +184,22 @@ class Scheduler:
                 slot += 1
                 continue
             req = self.pending.pop(0)
+            t_adm = self._clock()
             first_tok, pcache, length = self.engine.prefill(req.prompt)
             self.engine.insert(slot, pcache, length, first_tok)
             req.first_token_t = self._clock()
             req.tokens.append(first_tok)
+            if req.trace is not None:
+                # Phase spans share clock reads with the TTFT record:
+                # arrival -> admit is queue, admit -> first token is
+                # prefill, so queue.ms + prefill.ms == ttft_ms exactly
+                # (modulo rounding) — the verify_traces invariant.
+                tracing.span(req.trace, "queue", parent=req.span,
+                             ms=1e3 * (t_adm - req.arrival_t))
+                tracing.span(req.trace, "prefill", parent=req.span,
+                             ms=1e3 * (req.first_token_t - t_adm),
+                             engine_ms=getattr(self.engine,
+                                               "last_prefill_ms", None))
             self.active[slot] = req
             admitted += 1
             if self._finished(req, first_tok):
@@ -201,8 +219,12 @@ class Scheduler:
         if req.done_t is None:
             req.done_t = self._clock()
         self.completed.append(req)
+        if req.trace is not None and req.first_token_t is not None:
+            tracing.span(req.trace, "decode", parent=req.span,
+                         ms=1e3 * (req.done_t - req.first_token_t),
+                         tokens=len(req.tokens))
         obs_events.emit(
-            "serve_request", id=req.rid,
+            "serve_request", id=req.rid, trace=req.trace,
             prompt_tokens=len(req.prompt),
             output_tokens=len(req.tokens),
             ttft_ms=round(req.ttft_ms() or 0.0, 3),
