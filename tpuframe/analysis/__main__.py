@@ -77,6 +77,15 @@ non-zero on any finding:
      sample (``docs/samples/traced_fleet/``) clean with a resolvable
      p99 exemplar, and checks the SLO sentry's default specs and its
      rc contract (``tpuframe.obs.tracing.check``).
+  14. hier self-check — the hierarchical two-level collective seam
+     (:mod:`tpuframe.parallel.hier`) validates its mode registry and
+     env parsing, pins a seeded flat/two-level HLO pair against the
+     ICI/DCN byte split (the two-level lowering MUST move the
+     cross-slice term down by n_inner), proves the two-level mean
+     equals the flat mean to 1e-6 on a multi-device slice mesh, runs
+     the TF124 cross-slice seam lint over the tree, and seeds a
+     known-bad raw cross-slice collective the lint MUST flag (the
+     seam gate refuses to run blind).
 
 ``--json PATH`` writes the whole gate outcome as a schema-pinned report;
 ``--compare A.json B.json`` diffs two such reports for structural
@@ -322,6 +331,16 @@ def _run_quantwire_check() -> int:
     return len(problems)
 
 
+def _run_hier_check() -> int:
+    from tpuframe.parallel import hier
+
+    problems = hier.check()
+    for p in problems:
+        print(f"HIER {p}")
+    print(f"[analysis] hier self-check: {len(problems)} problem(s)")
+    return len(problems)
+
+
 def _run_pspec_check() -> int:
     from tpuframe.parallel import pspec
 
@@ -464,6 +483,7 @@ def main(argv=None) -> int:
         n_findings += _run_fusion_check()
         n_findings += _run_elastic_check()
         n_findings += _run_quantwire_check()
+        n_findings += _run_hier_check()
         n_findings += _run_pspec_check()
         n_findings += _run_plan_check()
         n_findings += _run_trace_check()

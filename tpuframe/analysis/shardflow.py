@@ -70,6 +70,7 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import re
 from collections import Counter
 
 from tpuframe.analysis import collective_graph as cg
@@ -932,6 +933,47 @@ def comm_split(graph: cg.CollectiveGraph, report, *, mesh_shape: dict,
     }
 
 
+#: one MegaScale DCN transfer: a host-transfer ``send`` whose payload is
+#: the first tuple element and whose rendezvous tag names the collective
+#: it carries, e.g. ``%send = (f32[1025,8,128]{...}, u32[], token[])
+#: send(...), is_host_transfer=true, frontend_attributes={...
+#: _xla_host_transfer_rendezvous="all-reduce.73_3"...}``.
+_MEGASCALE_PAYLOAD_RE = re.compile(
+    r"=\s*\((" + hlo_audit._DTYPE_RE + r")\[([0-9,]*)\]")
+_MEGASCALE_KIND_RE = re.compile(
+    r'_xla_host_transfer_rendezvous="([a-z\-]+)')
+
+
+def megascale_split(hlo_text: str) -> dict:
+    """Cross-slice (DCN) bytes the XLA:TPU backend moved through the
+    MegaScale transport instead of plain collectives.
+
+    On real multi-slice topologies the TPU compiler decomposes a
+    slice-spanning collective itself: the in-slice legs stay HLO
+    collectives (``comm_split`` attributes those) but the DCN hop is
+    lowered to paired host-transfer ``send``/``recv`` custom channels
+    tagged ``_xla_host_transfer_handler_name="xla_megascale_runtime"``
+    — invisible to both the collective graph and ``hlo_audit``.  This
+    counts each such send's payload bytes (s8 payloads count one byte
+    per element — a quantized DCN leg shows its real 4x drop) keyed by
+    the collective kind its rendezvous tag names.  Returns
+    ``{kind: bytes}``; empty for CPU-compiled or single-slice programs,
+    so folding this into a ``comm_split`` DCN column is a no-op there.
+    """
+    out: dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        if " send(" not in line or "is_host_transfer=true" not in line \
+                or "xla_megascale_runtime" not in line:
+            continue
+        payload = _MEGASCALE_PAYLOAD_RE.search(line)
+        kind = _MEGASCALE_KIND_RE.search(line)
+        if not payload or not kind:
+            continue
+        nbytes = hlo_audit._shape_bytes(payload.group(1), payload.group(2))
+        out[kind.group(1)] = out.get(kind.group(1), 0) + int(nbytes)
+    return {k: int(v) for k, v in sorted(out.items())}
+
+
 # ---------------------------------------------------------------------------
 # Per-audit flow check + the gate entry point.
 # ---------------------------------------------------------------------------
@@ -1077,6 +1119,13 @@ def compare_reports(a: dict, b: dict, *,
     exposed above-floor collectives, peak live bytes moving more than
     ``bytes_tol`` (relative), or overlap potential dropping by more
     than 0.10 are regressions.
+
+    Comm-split section (same both-reports gate): DCN bytes growing more
+    than ``bytes_tol`` (relative) — or any collective newly crossing
+    slices on a strategy whose baseline DCN column was zero — is a
+    regression.  One-sided by design: the DCN term is the one the
+    hierarchical lowering exists to crush (PERF §23/§28), so a drop is
+    the intended direction, never flagged.
     """
     lines: list[str] = []
     a_s = {s["name"]: s for s in a.get("strategies", [])
@@ -1148,6 +1197,20 @@ def compare_reports(a: dict, b: dict, *,
                 lines.append(
                     f"REGRESSION {name}: overlap potential "
                     f"{va:.2f} -> {vb:.2f} (dropped > 0.10)")
+        ca, cb = a_s[name].get("comm_split"), b_s[name].get("comm_split")
+        if ca and cb:
+            dcn_a = int(ca.get("dcn_bytes", 0))
+            dcn_b = int(cb.get("dcn_bytes", 0))
+            if dcn_a and (dcn_b - dcn_a) / dcn_a > bytes_tol:
+                regression = True
+                lines.append(
+                    f"REGRESSION {name}: DCN bytes {dcn_a} -> {dcn_b} "
+                    f"({(dcn_b - dcn_a) / dcn_a:+.1%} > +{bytes_tol:.0%})")
+            elif not dcn_a and dcn_b:
+                regression = True
+                lines.append(
+                    f"REGRESSION {name}: DCN bytes 0 -> {dcn_b} — "
+                    f"collectives newly cross slices")
         if not any(ln.startswith(f"REGRESSION {name}:") for ln in lines):
             lines.append(f"ok {name}: collective structure unchanged")
     return (1 if regression else 0), lines
@@ -1208,6 +1271,11 @@ def selfcheck(samples_dir: str = SAMPLES_COMPARE_DIR) -> list[str]:
             "compare selfcheck: base vs. candidate found no "
             "schedule-section regression — the differ lost the "
             "schedule plane")
+    if not any("DCN bytes" in ln for ln in lines):
+        problems.append(
+            "compare selfcheck: base vs. candidate found no comm-split "
+            "regression — the differ lost the DCN plane (the golden "
+            "candidate seeds a slice-crossing dp all-reduce)")
     return problems
 
 

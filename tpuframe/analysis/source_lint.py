@@ -180,6 +180,22 @@ Each rule institutionalizes a defect class rounds 4-5 found by hand:
          leaked or orphaned; use ``tracing.open_span``/``close_span``/
          ``span``/``note``, or suppress with ``# tf-lint: ok[TF123]``
          and a reason (seeded-positive test rigs).
+  TF124  raw cross-slice collective outside the hierarchical seam — a
+         ``lax`` collective whose axis argument names the ``slice``
+         mesh axis (the string literal) anywhere but
+         ``parallel/hier.py``.  The slice axis is the DCN fabric:
+         ``hier.py`` owns every collective that crosses it, because
+         that is where the two-level lowering (in-slice reduce-scatter
+         → 1/n cross-slice exchange → in-slice all-gather) and the
+         per-fabric wire format (``TPUFRAME_WIRE_FORMAT_DCN``) are
+         applied.  A raw ``lax.pmean(g, ("data", "slice"))`` elsewhere
+         ships full-size traffic over DCN behind the seam's back —
+         exactly the term the hierarchy exists to crush — and is
+         invisible to the DCN byte budgets the comm-split auditor
+         pins.  Collectives over computed axis variables are untouched
+         (the seam's own helpers pass those); deliberate raw crossings
+         (scalar control beacons) suppress with ``# tf-lint:
+         ok[TF124]`` and a reason.
 
 Scope: TF101/TF102 only fire *inside functions known to be traced*
 (decorated with ``jax.jit``/``pmap``/``shard_map`` or passed to
@@ -271,6 +287,11 @@ RULES = {
              "the open-span registry, producing spans the trace "
              "verifier counts as leaked or orphaned; use the "
              "tracing.open_span/close_span/span/note API",
+    "TF124": "raw cross-slice collective (a lax collective naming the "
+             "'slice' axis) outside the hierarchical seam "
+             "(parallel/hier.py) — ships full-size traffic over DCN "
+             "behind the two-level lowering and the per-fabric wire "
+             "format, invisible to the pinned DCN byte budgets",
 }
 
 # TF107: per-step code — every call here runs once per step/batch, so
@@ -419,6 +440,19 @@ _SWAP_SCOPE_SUFFIXES = ("serve/rollout.py", "serve/replica.py")
 # trace.check() cross-pins the two copies via the schema registry.
 _TRACE_SEAM_SUFFIXES = ("obs/tracing.py",)
 _SPAN_EVENT_LITERALS = ("span_open", "span_close", "span_note")
+
+# TF124: the hierarchical-collective seam.  hier.py owns every
+# collective that names the ``slice`` (DCN) axis — the two-level
+# lowering and the per-fabric wire format live there; pmean IS in the
+# tails (unlike TF115) because a raw cross-slice pmean is precisely the
+# full-size DCN transfer the seam exists to shrink.  Only the string
+# literal ``"slice"`` is matched: computed axis tuples are how the
+# seam's callers hand their axes down, and those stay untouched.
+_HIER_SEAM_SUFFIXES = ("parallel/hier.py",)
+_HIER_COLLECTIVE_TAILS = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "all_gather",
+    "psum_scatter", "all_to_all",
+}
 
 _NET_CALL_DOTTED = {"socket.socket", "socket.create_connection"}
 _NET_CALL_TAILS = {"urlopen", "HTTPConnection", "HTTPSConnection"}
@@ -637,6 +671,7 @@ class FileContext:
         self.trace_scope = not norm.endswith(_TRACE_SEAM_SUFFIXES)
         self.lock_scope = any(p in norm for p in _LOCK_DISCIPLINE_PARTS)
         self.wire_scope = norm.endswith(_WIRE_SEAM_SUFFIXES)
+        self.hier_scope = not norm.endswith(_HIER_SEAM_SUFFIXES)
         self.world_scope = not any(p in norm
                                    for p in _WORLD_SANCTIONED_PARTS)
         self.sync_scope = (_SYNC_SCOPE_PART in norm
@@ -963,6 +998,34 @@ def _tf115_wire_seam(ctx: FileContext, node, fn):
                  f"resolved wire format — route through the wire "
                  f"dispatch (quantwire/collectives helpers) or suppress "
                  f"with tf-lint: ok[TF115] and a reason", fn)
+
+
+@_node_rule
+def _tf124_slice_seam(ctx: FileContext, node, fn):
+    """A lax collective whose arguments contain the string literal
+    ``"slice"`` — the DCN mesh axis — outside parallel/hier.py.  The
+    literal-only match is deliberate: the seam's callers (step.py,
+    zero1.py) pass computed axis tuples resolved from the mesh, so a
+    bare ``"slice"`` in a collective call is someone hand-routing
+    traffic across the DCN fabric."""
+    if not ctx.hier_scope or not isinstance(node, ast.Call):
+        return
+    callee = _dotted(node.func)
+    if not callee.startswith(("lax.", "jax.lax.")):
+        return
+    if callee.rsplit(".", 1)[-1] not in _HIER_COLLECTIVE_TAILS:
+        return
+    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Constant) and sub.value == "slice":
+                ctx.emit("TF124", node,
+                         f"raw cross-slice `{callee}` names the 'slice' "
+                         f"(DCN) axis outside parallel/hier.py — route "
+                         f"through hier.hier_mean/scatter_mean/gather so "
+                         f"the two-level lowering and the DCN wire "
+                         f"format apply, or suppress with tf-lint: "
+                         f"ok[TF124] and a reason", fn)
+                return
 
 
 @_node_rule

@@ -208,7 +208,10 @@ def _spec_budget(spec, pb: int, n_devices: int, *, weight_update: str,
                  wire_format: str, padded: int | None, ab: int = 0,
                  seq_mode: str | None = None,
                  grad_reduce: str | None = None,
-                 fusion_threshold: int | None = None):
+                 fusion_threshold: int | None = None,
+                 hier: str | None = None,
+                 wire_format_dcn: str | None = None,
+                 n_inner: int = 1):
     """The declared CommBudget for a composed spec — the same per-kind
     ceilings the hand-wired family declared, picked by axis/modifier;
     the byte-exact pin lives in ``derived_budgets.json`` either way."""
@@ -227,6 +230,15 @@ def _spec_budget(spec, pb: int, n_devices: int, *, weight_update: str,
         return budgets_lib.ulysses_sp_budget(pb, ab)
     if grad_reduce == "adasum":
         return budgets_lib.adasum_budget(pb, n_devices)
+    if hier == "hier":
+        dcn_int8 = (wire_format_dcn or "fp") == "int8-block"
+        if weight_update == "zero1":
+            if dcn_int8:
+                return budgets_lib.hier_zero1_int8_budget(padded, n_inner)
+            return budgets_lib.hier_zero1_budget(padded, n_inner)
+        if dcn_int8:
+            return budgets_lib.hier_dp_int8_budget(pb, n_inner)
+        return budgets_lib.hier_dp_budget(pb, n_inner)
     if weight_update == "zero1" and wire_format == "int8-block":
         return budgets_lib.zero1_int8_budget(padded, n_devices)
     if weight_update == "zero1":
@@ -317,6 +329,8 @@ def _build_from_spec(spec_text: str, n_devices: int, *,
                      seq_mode: str | None = None,
                      grad_reduce: str | None = None,
                      fusion_threshold: int | None = None,
+                     hier: str | None = None,
+                     wire_format_dcn: str | None = None,
                      declared_overlapped: bool = False,
                      devices=None):
     """Generic spec-lowered builder: ``spec_text`` (the
@@ -327,8 +341,10 @@ def _build_from_spec(spec_text: str, n_devices: int, *,
     (the planner passes compile-only topology devices); ``seq_mode``
     picks ring vs Ulysses attention for ``sp`` specs; ``grad_reduce``
     threads the adasum modifier; ``fusion_threshold`` threads the
-    bucketed-fusion modifier (tpuframe.parallel.fusion's staged pass),
-    and ``declared_overlapped`` signs the overlap contract the
+    bucketed-fusion modifier (tpuframe.parallel.fusion's staged pass);
+    ``hier``/``wire_format_dcn`` thread the two-level cross-slice
+    lowering and its DCN-leg wire (tpuframe.parallel.hier), and
+    ``declared_overlapped`` signs the overlap contract the
     exposed-comm detector then enforces live."""
     import dataclasses
 
@@ -378,18 +394,32 @@ def _build_from_spec(spec_text: str, n_devices: int, *,
     kwargs = pspec.lower(spec, mesh, state, weight_update=weight_update,
                          wire_format=wire, tp_rules=tp_rules,
                          grad_reduce=grad_reduce,
-                         fusion_threshold=fusion_threshold)
+                         fusion_threshold=fusion_threshold,
+                         hier=hier, wire_format_dcn=wire_format_dcn)
     step = step_lib.make_train_step(loss_fn, tx, mesh, donate=False,
                                     **kwargs)
+    # In-slice world size for the two-level budgets: the batch-axis
+    # product with the slice (DCN) axis divided out — the factor the
+    # lowering's cross-slice leg shrinks by.
+    sizes = dict(mesh.shape)
+    n_slice = int(sizes.get(mesh_lib.SLICE_AXIS, 1))
+    n_batch = 1
+    for a in mesh_lib.batch_axes(mesh):
+        n_batch *= int(sizes.get(a, 1))
+    n_inner = max(1, n_batch // max(n_slice, 1))
     budget = _spec_budget(spec, pb, n_devices, weight_update=weight_update,
                           wire_format=wire, padded=padded, ab=ab,
                           seq_mode=seq_mode, grad_reduce=grad_reduce,
-                          fusion_threshold=fusion_threshold)
+                          fusion_threshold=fusion_threshold,
+                          hier=hier, wire_format_dcn=wire_format_dcn,
+                          n_inner=n_inner)
     shardings = kwargs.get("state_shardings")
+    dcn_int8 = (hier == "hier"
+                and (wire_format_dcn or "fp") == "int8-block")
     return (step, (state, batch), budget, pb,
             _meta(mesh,
-                  wire_format="int8-block" if wire == "int8-block"
-                  else "fp",
+                  wire_format="int8-block"
+                  if (wire == "int8-block" or dcn_int8) else "fp",
                   declared_leaves=(_declared_leaves(state, shardings)
                                    if shardings is not None else ()),
                   declared_overlapped=declared_overlapped))
@@ -399,7 +429,9 @@ def _spec_name(spec_text: str, *, weight_update: str = "replicated",
                wire_format: str | None = None,
                seq_mode: str | None = None,
                grad_reduce: str | None = None,
-               fusion_threshold: int | None = None) -> str:
+               fusion_threshold: int | None = None,
+               hier: str | None = None,
+               wire_format_dcn: str | None = None) -> str:
     """Canonical strategy name for a composed spec: the spec's canonical
     spelling under a ``spec:`` prefix plus any modifiers — stable, so an
     auto-derived budget can be pinned in ``derived_budgets.json``."""
@@ -410,6 +442,10 @@ def _spec_name(spec_text: str, *, weight_update: str = "replicated",
         name += f"+{weight_update}"
     if wire_format:
         name += f"+{wire_format}"
+    if hier:
+        name += f"+{hier}"
+    if wire_format_dcn and wire_format_dcn != "fp":
+        name += "+dcn-int8"
     if seq_mode:
         name += f"+{seq_mode}"
     if grad_reduce:
@@ -425,6 +461,8 @@ def register_spec_strategy(spec_text: str, *,
                            seq_mode: str | None = None,
                            grad_reduce: str | None = None,
                            fusion_threshold: int | None = None,
+                           hier: str | None = None,
+                           wire_format_dcn: str | None = None,
                            declared_overlapped: bool = False) -> str:
     """Register a composed parallelism spec as a dynamic analysis
     strategy.  The name is the spec's canonical spelling under a
@@ -440,11 +478,13 @@ def register_spec_strategy(spec_text: str, *,
     name = _spec_name(spec_text, weight_update=weight_update,
                       wire_format=wire_format, seq_mode=seq_mode,
                       grad_reduce=grad_reduce,
-                      fusion_threshold=fusion_threshold)
+                      fusion_threshold=fusion_threshold,
+                      hier=hier, wire_format_dcn=wire_format_dcn)
     STRATEGIES[name] = functools.partial(
         _build_from_spec, spec_text, weight_update=weight_update,
         wire_format=wire_format, seq_mode=seq_mode,
         grad_reduce=grad_reduce, fusion_threshold=fusion_threshold,
+        hier=hier, wire_format_dcn=wire_format_dcn,
         declared_overlapped=declared_overlapped)
     return name
 
@@ -643,6 +683,26 @@ DP_ZERO1_FUSED = register_spec_strategy(
     fusion_threshold=_FUSED_REGISTRY_THRESHOLD,
     declared_overlapped=True)
 
+#: The hierarchical two-level collective family (ISSUE 20): flat/hier
+#: twins on the pure-DP multi-slice spec so the auto-derived budget pins
+#: document the DCN byte column dropping by n_inner (fp cross-slice leg)
+#: and by ~4·n_inner (int8-block DCN leg) against the SAME spec, model
+#: and world.  The zero1 composition is the acceptance carrier: flat
+#: ZeRO-1 pays two full-size DCN collectives per step (rs in, ag out),
+#: the two-level int8 shape two s8 shard-size ones.
+_HIER_SPEC = "dp=*;slices=2"
+HIER_FLAT = register_spec_strategy(_HIER_SPEC)
+HIER_DP = register_spec_strategy(_HIER_SPEC, hier="hier")
+HIER_DP_INT8 = register_spec_strategy(
+    _HIER_SPEC, hier="hier", wire_format_dcn="int8-block")
+HIER_ZERO1_FLAT = register_spec_strategy(
+    _HIER_SPEC, weight_update="zero1")
+HIER_ZERO1 = register_spec_strategy(
+    _HIER_SPEC, weight_update="zero1", hier="hier")
+HIER_ZERO1_INT8 = register_spec_strategy(
+    _HIER_SPEC, weight_update="zero1", hier="hier",
+    wire_format_dcn="int8-block")
+
 
 def _overlap_compile_opts(meta) -> dict | None:
     """A strategy that signs ``declared_overlapped`` owns its bucketing:
@@ -667,6 +727,8 @@ def audit_spec(spec_text: str, *, n_devices: int,
                seq_mode: str | None = None,
                grad_reduce: str | None = None,
                fusion_threshold: int | None = None,
+               hier: str | None = None,
+               wire_format_dcn: str | None = None,
                devices=None, name: str | None = None) -> StrategyAudit:
     """Audit an UNREGISTERED spec candidate — the ``tune plan`` seam.
 
@@ -682,7 +744,8 @@ def audit_spec(spec_text: str, *, n_devices: int,
     label = name or _spec_name(spec_text, weight_update=weight_update,
                                wire_format=wire_format, seq_mode=seq_mode,
                                grad_reduce=grad_reduce,
-                               fusion_threshold=fusion_threshold)
+                               fusion_threshold=fusion_threshold,
+                               hier=hier, wire_format_dcn=wire_format_dcn)
     try:
         if devices is None:
             _require_devices(n_devices)
@@ -690,6 +753,7 @@ def audit_spec(spec_text: str, *, n_devices: int,
             spec_text, n_devices, weight_update=weight_update,
             wire_format=wire_format, seq_mode=seq_mode,
             grad_reduce=grad_reduce, fusion_threshold=fusion_threshold,
+            hier=hier, wire_format_dcn=wire_format_dcn,
             declared_overlapped=fusion_threshold is not None,
             devices=devices)
         report, compiled = hlo_audit.audit_jitted(

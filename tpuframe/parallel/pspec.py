@@ -185,18 +185,21 @@ def resolve(explicit: str | None = None) -> tuple:
 def lower(spec: ParallelSpec, mesh, state=None, *,
           weight_update: str = "replicated", wire_format: str | None = None,
           fusion_threshold: int | None = None, tp_rules=None,
-          grad_reduce: str | None = None) -> dict:
+          grad_reduce: str | None = None, hier: str | None = None,
+          wire_format_dcn: str | None = None) -> dict:
     """Map a spec onto ``make_train_step`` kwargs.
 
     Three lowering classes exist, matching the step factory's own modes:
 
       * pure data-parallel (only ``dp``/``slices`` > 1) lowers to the
         shard_map path, where ``weight_update`` (zero1), ``wire_format``
-        (int8-block), ``fusion_threshold`` and ``grad_reduce``
-        (``"adasum"``) remain orthogonal modifiers — exactly the knobs
-        ``zero1.resolve`` / ``quantwire.resolve`` already feed.  adasum
+        (int8-block), ``fusion_threshold``, ``grad_reduce``
+        (``"adasum"``) and the two-level lowering (``hier`` +
+        ``wire_format_dcn``, :mod:`tpuframe.parallel.hier`) remain
+        orthogonal modifiers — exactly the knobs ``zero1.resolve`` /
+        ``quantwire.resolve`` / ``hier.resolve`` already feed.  adasum
         is its own wire pattern (the ppermute butterfly) and refuses the
-        other three modifiers, mirroring ``make_train_step``'s rules;
+        other modifiers, mirroring ``make_train_step``'s rules;
       * sequence-parallel specs (``sp`` > 1, weights replicated) stay on
         the shard_map path but partition the batch's sequence dim over
         the ``seq`` axis and widen the loss reduction to span it —
@@ -235,11 +238,14 @@ def lower(spec: ParallelSpec, mesh, state=None, *,
             f"GPipe harness")
     wire_format = wire_format or "fp"
     grad_reduce = grad_reduce or "mean"
+    hier = hier or "flat"
+    wire_format_dcn = wire_format_dcn or "fp"
     if grad_reduce not in ("mean", "adasum"):
         raise SpecError(f"grad_reduce={grad_reduce!r} — expected 'mean' "
                         f"or 'adasum'")
     modified = (weight_update != "replicated" or wire_format != "fp"
-                or fusion_threshold is not None)
+                or fusion_threshold is not None or hier != "flat"
+                or wire_format_dcn != "fp")
     if spec.fsdp > 1 or spec.tp > 1 or spec.ep > 1:
         if spec.sp > 1:
             raise SpecError(
@@ -249,8 +255,8 @@ def lower(spec: ParallelSpec, mesh, state=None, *,
         if modified or grad_reduce != "mean":
             raise SpecError(
                 f"spec '{spec.canonical()}': weight-sharded lowering is "
-                f"auto-SPMD — zero1/wire_format/fusion_threshold/adasum "
-                f"are shard_map modifiers and do not compose")
+                f"auto-SPMD — zero1/wire_format/fusion_threshold/adasum/"
+                f"hier are shard_map modifiers and do not compose")
         if (spec.tp > 1 or spec.ep > 1) and tp_rules is None:
             raise SpecError(
                 f"spec '{spec.canonical()}' shards weights over the "
@@ -274,8 +280,8 @@ def lower(spec: ParallelSpec, mesh, state=None, *,
         if modified or grad_reduce != "mean":
             raise SpecError(
                 f"spec '{spec.canonical()}': sp shards activations, not "
-                f"weights — zero1/wire_format/fusion_threshold/adasum "
-                f"assume batch-only sharding and do not compose")
+                f"weights — zero1/wire_format/fusion_threshold/adasum/"
+                f"hier assume batch-only sharding and do not compose")
         from jax.sharding import PartitionSpec as P
 
         axes = mesh_lib.batch_axes(mesh)
@@ -289,13 +295,20 @@ def lower(spec: ParallelSpec, mesh, state=None, *,
     if grad_reduce == "adasum" and modified:
         raise SpecError(
             f"spec '{spec.canonical()}': adasum's ppermute butterfly is "
-            f"its own wire pattern — zero1/wire_format/fusion_threshold "
-            f"do not compose")
+            f"its own wire pattern — zero1/wire_format/fusion_threshold/"
+            f"hier do not compose")
+    if wire_format_dcn != "fp" and hier != "hier":
+        raise SpecError(
+            f"spec '{spec.canonical()}': wire_format_dcn="
+            f"{wire_format_dcn!r} is the DCN leg of the two-level "
+            f"lowering — it needs hier='hier'")
     return {
         "weight_update": weight_update,
         "wire_format": wire_format,
         "fusion_threshold": fusion_threshold,
         "grad_reduce": grad_reduce,
+        "hier": hier,
+        "wire_format_dcn": wire_format_dcn,
         "reduce_axes": mesh_lib.batch_axes(mesh),
         "batch_partition": mesh_lib.batch_spec(mesh=mesh),
     }

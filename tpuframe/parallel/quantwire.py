@@ -47,6 +47,10 @@ PyTree = Any
 
 FORMATS = ("fp", "int8-block")
 ENV_VAR = "TPUFRAME_WIRE_FORMAT"
+#: the DCN leg of the two-level lowering (tpuframe.parallel.hier) gets
+#: its own wire — the fabric is ~32x slower, so PERF §20's "int8 loses
+#: at ICI speeds" verdict inverts there.
+ENV_VAR_DCN = "TPUFRAME_WIRE_FORMAT_DCN"
 
 # Elements per shared f32 scale: 4/256 = 1.6% wire overhead, small
 # enough that the budget ratio tests treat it as the documented slack.
@@ -82,26 +86,84 @@ def format_from_env(env=os.environ) -> str | None:
     return validate_format(raw) if raw else None
 
 
-def resolve(program: str | None = None, family: str | None = None,
-            default: str = "fp") -> tuple:
-    """``(format, source)`` for a step program: env override > tuning-DB
-    winner (generation-gated; family ``wire_format_*`` persisted by
-    ``python -m tpuframe.tune sweep --wire``) > ``default``.  ``source``
-    is ``env``/``tune_db``/``default`` — emitted in the ``wire_format``
-    run event so wire provenance is always on record."""
+def format_from_env_dcn(env=os.environ) -> str | None:
+    """The explicit ``TPUFRAME_WIRE_FORMAT_DCN`` override, or None."""
+    raw = env.get(ENV_VAR_DCN, "").strip()
+    return validate_format(raw) if raw else None
+
+
+def resolve_legs(program: str | None = None, family: str | None = None,
+                 family_dcn: str | None = None,
+                 default: str = "fp", default_dcn: str = "fp",
+                 ) -> tuple[tuple, tuple]:
+    """Per-fabric wire resolution: ``((ici_format, ici_source),
+    (dcn_format, dcn_source))`` for a step program.
+
+    Each leg resolves independently with the standard precedence — env
+    override (``TPUFRAME_WIRE_FORMAT`` / ``TPUFRAME_WIRE_FORMAT_DCN``) >
+    generation-gated tuning-DB winner (family ``wire_format_*`` from
+    ``tune sweep --wire`` for ICI; family ``hier_collectives`` from
+    ``tune sweep --hier`` for DCN) > default.  The ICI leg is the wire
+    every gradient-path collective takes on a flat program; the DCN leg
+    only exists under the two-level lowering
+    (:mod:`tpuframe.parallel.hier`), where it rides the cross-slice
+    exchange alone.  Both legs + sources are emitted in the typed
+    ``wire_format`` run event."""
     env_val = format_from_env()
     if env_val is not None:
-        return env_val, "env"
-    if program or family:
-        from tpuframe.tune import db as tune_db
+        ici = (env_val, "env")
+    else:
+        ici = None
+        if program or family:
+            from tpuframe.tune import db as tune_db
 
-        db_val = tune_db.resolve_wire_format(program or "", family=family)
-        if db_val is not None:
-            try:
-                return validate_format(str(db_val)), "tune_db"
-            except ValueError:
-                pass  # a stale DB row must never break a run
-    return validate_format(default), "default"
+            db_val = tune_db.resolve_wire_format(program or "",
+                                                 family=family)
+            if db_val is not None:
+                try:
+                    ici = (validate_format(str(db_val)), "tune_db")
+                except ValueError:
+                    pass  # a stale DB row must never break a run
+        if ici is None:
+            ici = (validate_format(default), "default")
+    env_dcn = format_from_env_dcn()
+    if env_dcn is not None:
+        dcn = (env_dcn, "env")
+    else:
+        dcn = None
+        if program or family_dcn:
+            from tpuframe.tune import db as tune_db
+
+            db_val = tune_db.resolve_wire_format_dcn(program or "",
+                                                     family=family_dcn)
+            if db_val is not None:
+                try:
+                    dcn = (validate_format(str(db_val)), "tune_db")
+                except ValueError:
+                    pass  # a stale DB row must never break a run
+        if dcn is None:
+            dcn = (validate_format(default_dcn), "default")
+    return ici, dcn
+
+
+_WARNED_SINGLE_RESOLVE = False
+
+
+def resolve(program: str | None = None, family: str | None = None,
+            default: str = "fp") -> tuple:
+    """Deprecated single-format spelling of :func:`resolve_legs` — the
+    wire is per-fabric now; this returns the ICI leg only (and is blind
+    to ``TPUFRAME_WIRE_FORMAT_DCN``).  Warns once per process."""
+    global _WARNED_SINGLE_RESOLVE
+    if not _WARNED_SINGLE_RESOLVE:
+        _WARNED_SINGLE_RESOLVE = True
+        import warnings
+
+        warnings.warn(
+            "quantwire.resolve() resolves one program-wide wire format; "
+            "the wire is per-fabric now — use quantwire.resolve_legs() "
+            "for the (ICI, DCN) pair", DeprecationWarning, stacklevel=2)
+    return resolve_legs(program, family=family, default=default)[0]
 
 
 # ---------------------------------------------------------------------------

@@ -84,6 +84,8 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
                grad_reduce: str,
                weight_update: str,
                wire_format: str,
+               hier: str,
+               wire_format_dcn: str,
                state: TrainState, batch: PyTree):
     """Shared body for both modes. ``axes`` bound ⇒ explicit collectives."""
     step_rng = jax.random.fold_in(state.rng, state.step)
@@ -95,7 +97,8 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     if accum_steps > 1:
         return _accum_grad_step(loss_fn, tx, axes, fusion_threshold,
                                 accum_steps, grad_reduce, weight_update,
-                                wire_format, state, batch, step_rng)
+                                wire_format, hier, wire_format_dcn,
+                                state, batch, step_rng)
 
     # The reference's raison d'être: synchronous gradient averaging.
     # Horovod: per-tensor async NCCL ring-allreduce with fusion buffer.
@@ -132,12 +135,19 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     # run.  The zero1 tail already takes local grads; its wire choice
     # lives inside sharded_update.
     wire_local = bool(axes) and wire_format != "fp" and not zero1
+    # The two-level (hierarchical) lowering restructures the gradient
+    # mean itself — rs over ICI → cross-slice mean over DCN → ag back
+    # (tpuframe.parallel.hier) — so it consumes LOCAL grads like every
+    # other explicit wire pattern.  The zero1 tail runs its own
+    # two-stage scatter/gather and already takes local grads.
+    hier_local = bool(axes) and hier == "hier" and not zero1
     # Legacy shard_map (check_rep=False) has no psum-transpose rewrite:
     # differentiating the pmean-ed loss there yields LOCAL grads with no
     # implicit reduction, so the reduction must be explicit.
     legacy_local = bool(axes) and _LEGACY_SHARD_MAP and not explicit
     diff_params = state.params
-    if (explicit or zero1 or wire_local) and not _LEGACY_SHARD_MAP:
+    if (explicit or zero1 or wire_local or hier_local) \
+            and not _LEGACY_SHARD_MAP:
         # Legacy shard_map needs no pcast (and has none): check_rep=False
         # already differentiates to LOCAL grads with no implicit psum.
         diff_params = jax.tree.map(
@@ -146,7 +156,7 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     def global_loss(params, model_state, batch, rng):
         loss, aux = loss_fn(params, model_state, batch, rng)
         if (axes and not explicit and not legacy_local and not zero1
-                and not wire_local):
+                and not wire_local and not hier_local):
             loss = lax.pmean(loss, axes)
         return loss, aux
 
@@ -154,14 +164,15 @@ def _grad_step(loss_fn: LossFn, tx: optax.GradientTransformation,
         global_loss, has_aux=True)(diff_params, state.model_state, batch, step_rng)
 
     return _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce,
-                             weight_update, wire_format, state,
+                             weight_update, wire_format, hier,
+                             wire_format_dcn, state,
                              grads, loss, metrics, model_state,
                              reduce_grads=(explicit or legacy_local or zero1
-                                           or wire_local))
+                                           or wire_local or hier_local))
 
 
 def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, weight_update,
-                      wire_format, state, grads,
+                      wire_format, hier, wire_format_dcn, state, grads,
                       loss, metrics, model_state, *, reduce_grads: bool):
     """Shared step tail: cross-replica reductions + optimizer update.
 
@@ -187,7 +198,8 @@ def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, weight_update,
                              state.params)
         params, opt_state, grad_norm = zero1_lib.sharded_update(
             tx, axes, state.params, state.opt_state, grads,
-            wire_format=wire_format, fusion_threshold=fusion_threshold)
+            wire_format=wire_format, fusion_threshold=fusion_threshold,
+            hier=(hier == "hier"), wire_format_dcn=wire_format_dcn)
         metrics = dict(metrics)
         metrics["loss"] = loss
         metrics["grad_norm"] = grad_norm
@@ -199,6 +211,20 @@ def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, weight_update,
             from tpuframe.parallel import collectives
 
             grads = collectives.adasum(grads, axes)
+        elif hier == "hier":
+            # Two-level cross-slice mean (tpuframe.parallel.hier): full
+            # bytes stay on ICI, only the 1/n_inner shard crosses DCN —
+            # in wire_format_dcn.  fusion_threshold buckets the
+            # lowerings (fp DCN leg only; validated at build time).
+            from tpuframe.parallel import hier as hier_lib
+
+            if fusion_threshold is not None:
+                grads = hier_lib.fused_hier_mean(
+                    grads, axes, threshold_bytes=fusion_threshold,
+                    wire_format_dcn=wire_format_dcn)
+            else:
+                grads = hier_lib.hier_mean(
+                    grads, axes, wire_format_dcn=wire_format_dcn)
         elif fusion_threshold is not None:
             from tpuframe.parallel import fusion
 
@@ -233,8 +259,8 @@ def _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce, weight_update,
 
 
 def _accum_grad_step(loss_fn, tx, axes, fusion_threshold, accum_steps,
-                     grad_reduce, weight_update, wire_format, state, batch,
-                     step_rng):
+                     grad_reduce, weight_update, wire_format, hier,
+                     wire_format_dcn, state, batch, step_rng):
     """Gradient accumulation — Horovod's ``backward_passes_per_step``
     (DistributedOptimizer option; the reference's recipe for batches that
     exceed device memory).  The local batch is split into ``accum_steps``
@@ -299,7 +325,8 @@ def _accum_grad_step(loss_fn, tx, axes, fusion_threshold, accum_steps,
     metrics = jax.tree.map(lambda m: m / accum_steps, metrics)
 
     return _reduce_and_apply(tx, axes, fusion_threshold, grad_reduce,
-                             weight_update, wire_format, state,
+                             weight_update, wire_format, hier,
+                             wire_format_dcn, state,
                              grads, loss, metrics, model_state,
                              reduce_grads=True)
 
@@ -321,6 +348,8 @@ def make_train_step(
     remat_policy: str | None = None,
     weight_update: str = "replicated",
     wire_format: str = "fp",
+    hier: str = "flat",
+    wire_format_dcn: str = "fp",
 ):
     """Build the compiled train step.
 
@@ -398,10 +427,61 @@ def make_train_step(
     compose with ``fusion_threshold``/``adasum`` (each is its own wire
     pattern).  Resolution (env ``TPUFRAME_WIRE_FORMAT`` > tuning DB >
     default) is the caller's job via ``quantwire.resolve``.
+
+    ``hier``: ``"flat"`` (default — cross-replica means are single
+    collectives whose groups may span slices) or ``"hier"``
+    (:mod:`tpuframe.parallel.hier`, arXiv:1909.09756): the gradient mean
+    lowers as in-slice reduce-scatter over ICI → cross-slice mean of the
+    1/n_inner shard over DCN → in-slice all-gather back, so only
+    1/n_inner of the gradient bytes touch the ~32x-slower fabric.  On a
+    single-slice mesh the lowering degenerates to flat.  shard_map mode
+    with a mesh only; composes with ``accum_steps``, ``weight_update=
+    'zero1'`` (the sharded update's scatter/gather go two-stage) and
+    ``fusion_threshold`` (bucketed lowerings, fp DCN leg only), but not
+    with ``adasum`` (its butterfly is its own wire pattern) or the
+    program-wide ``wire_format='int8-block'`` — PERF §20's verdict is
+    that int8 loses at ICI speeds; quantize the slow leg instead via
+    ``wire_format_dcn``.  Resolution (env ``TPUFRAME_HIER`` > tuning DB
+    > default) is the caller's job via ``hier.resolve``.
+
+    ``wire_format_dcn``: wire format of the cross-slice (DCN) leg of the
+    two-level lowering — ``"fp"`` (default) or ``"int8-block"`` (the
+    quantwire path riding the slow fabric alone, ~4x fewer DCN bytes on
+    top of hier's 1/n_inner).  Needs ``hier='hier'``; flat programs have
+    a single fabric-blind wire (use ``wire_format``).  Resolution (env
+    ``TPUFRAME_WIRE_FORMAT_DCN`` > tuning DB > fp) is the caller's job
+    via ``quantwire.resolve_legs``.
     """
+    from tpuframe.parallel import hier as hier_lib
     from tpuframe.parallel import quantwire
 
     wire_format = quantwire.validate_format(wire_format)
+    hier = hier_lib.validate_mode(hier)
+    wire_format_dcn = quantwire.validate_format(wire_format_dcn)
+    if hier == "hier":
+        if state_shardings is not None or mode != "shard_map":
+            raise ValueError("hier='hier' needs shard_map mode — auto-SPMD "
+                             "programs have no explicit collectives to "
+                             "restructure")
+        if grad_reduce == "adasum":
+            raise ValueError("hier='hier' does not compose with adasum — "
+                             "the butterfly is its own wire pattern")
+        if wire_format != "fp":
+            raise ValueError(f"hier='hier' does not compose with the "
+                             f"program-wide wire_format={wire_format!r}: "
+                             f"int8 on the ICI legs loses (PERF §20) — "
+                             f"quantize only the DCN leg via "
+                             f"wire_format_dcn")
+    if wire_format_dcn != "fp":
+        if hier != "hier":
+            raise ValueError(f"wire_format_dcn={wire_format_dcn!r} is the "
+                             f"DCN leg of the two-level lowering and needs "
+                             f"hier='hier'; a flat program has one "
+                             f"fabric-blind wire (wire_format)")
+        if fusion_threshold is not None:
+            raise ValueError(f"wire_format_dcn={wire_format_dcn!r} does not "
+                             f"compose with fusion_threshold — the fusion "
+                             f"buffers pack full-precision payloads")
     if wire_format != "fp":
         if state_shardings is not None or mode != "shard_map":
             raise ValueError(f"wire_format={wire_format!r} needs shard_map "
@@ -448,9 +528,10 @@ def make_train_step(
                          "pattern")
     if mesh is None:
         # World of 1: adasum degrades to identity like every collective,
-        # and there is no wire for a format to shrink.
+        # and there is no wire (or fabric split) for a format to shrink.
         body = functools.partial(_grad_step, loss_fn, tx, None, None,
-                                 accum_steps, "mean", "replicated", "fp")
+                                 accum_steps, "mean", "replicated", "fp",
+                                 "flat", "fp")
         return jax.jit(body, donate_argnums=(0,) if donate else (),
                        compiler_options=compiler_options)
 
@@ -478,7 +559,8 @@ def make_train_step(
                              "auto-SPMD has no per-replica grads to combine")
         # Auto-SPMD: annotate shardings, let the partitioner insert collectives.
         body = functools.partial(_grad_step, loss_fn, tx, None, None,
-                                 accum_steps, "mean", "replicated", "fp")
+                                 accum_steps, "mean", "replicated", "fp",
+                                 "flat", "fp")
         state_sh = repl if state_shardings is None else state_shardings
         return jax.jit(
             body,
@@ -493,7 +575,7 @@ def make_train_step(
 
     body = functools.partial(_grad_step, loss_fn, tx, axes, fusion_threshold,
                              accum_steps, grad_reduce, weight_update,
-                             wire_format)
+                             wire_format, hier, wire_format_dcn)
     if weight_update == "zero1":
         from tpuframe.parallel import zero1 as zero1_lib
 

@@ -345,6 +345,8 @@ def sharded_update(tx: optax.GradientTransformation, axes,
                    grads: PyTree, *,
                    wire_format: str = "fp",
                    fusion_threshold: int | None = None,
+                   hier: bool = False,
+                   wire_format_dcn: str = "fp",
                    ) -> tuple[PyTree, PyTree, jax.Array]:
     """reduce-scatter → 1/n optimizer update → all-gather.
 
@@ -377,7 +379,24 @@ def sharded_update(tx: optax.GradientTransformation, axes,
     out, every bucket's collective issued before any bucket is consumed.
     Wire bytes are EXACTLY the per-leaf path's pad-to-multiple totals
     (the zero1 budget holds unchanged); only the op count drops from
-    n_leaves to n_buckets."""
+    n_leaves to n_buckets.
+
+    ``hier=True`` on a multi-slice mesh (``axes`` includes the slice
+    axis) swaps both gradient-sized collectives for their two-stage
+    twins (:mod:`tpuframe.parallel.hier`, arXiv:1909.09756): the scatter
+    runs in-slice over ICI first then cross-slice over DCN on the
+    1/n_inner chunk, the gather inverts slice-first — so only 1/n_inner
+    of the bytes touch the slow fabric, at the SAME total padded bytes.
+    Chunk ownership becomes INNER-MAJOR (member (slice s, inner j) owns
+    chunk ``j*n_slice + s``): the on-disk order of a sharded opt-state
+    dump therefore permutes vs the flat lowering, but the flat
+    ``[padded]`` global layout — what elastic resize and checkpoints
+    address — is unchanged.  ``wire_format_dcn="int8-block"`` quantizes
+    the DCN legs alone (scatter payload + update-delta gather; the fp
+    master invariant above holds leg-wise), gated per leaf on the
+    CHUNK clearing ``quantwire.MIN_QUANT_ELEMS`` — the chunk is what
+    rides the wire.  Single-slice (or ``n_inner == 1``) meshes
+    degenerate to the flat lowering."""
     bound = collectives._bound_axes(axes)
     if not bound:
         # World of 1 (unmapped): the sharded path degenerates to the
@@ -388,18 +407,33 @@ def sharded_update(tx: optax.GradientTransformation, axes,
     n = 1
     for a in bound:
         n *= lax.axis_size(a)
-    idx = collectives._linear_index(bound)
+
+    from tpuframe.parallel import hier as hier_lib
+    from tpuframe.parallel import quantwire
+
+    inner, has_slice = hier_lib.split_axes(bound)
+    n_inner = quantwire._axis_prod(collectives._sized_axes(inner)) \
+        if inner else 1
+    # Two-stage only when both levels are real; otherwise the flat
+    # lowering IS the hierarchy (one level is trivial).
+    two_stage = bool(hier) and has_slice and n_inner > 1
+    idx = hier_lib.linear_index(inner) if two_stage \
+        else collectives._linear_index(bound)
 
     def flat_pad(t):
         flat = t.reshape(-1)
         pad = _padded(flat.size, n) - flat.size
         return jnp.pad(flat, (0, pad)) if pad else flat
 
-    from tpuframe.parallel import quantwire
-
     def quantized(g):
         return (wire_format == "int8-block"
                 and _padded(_size(g), n) >= quantwire.MIN_QUANT_ELEMS)
+
+    def dcn_quantized(g):
+        # Gate on the CHUNK — the payload the DCN legs actually carry.
+        return (two_stage and wire_format_dcn == "int8-block"
+                and _padded(_size(g), n) // n_inner
+                >= quantwire.MIN_QUANT_ELEMS)
 
     # Grads in: ONE reduce-scatter per leaf (operand = padded grad bytes
     # — the wire cost the dp-zero1 CommBudget declares), averaging over
@@ -408,12 +442,23 @@ def sharded_update(tx: optax.GradientTransformation, axes,
     # With ``fusion_threshold`` the leaves pack into shard-aligned
     # buckets first — one scatter per bucket, all issued before any
     # shard is unpacked.
+    def scatter_fp(flat):
+        if two_stage:
+            return hier_lib.scatter_mean(flat, inner)
+        return collectives.reduce_scatter(flat, bound, average=True)
+
     def scatter(g):
+        if two_stage:
+            return hier_lib.scatter_mean(
+                flat_pad(g), inner,
+                wire_format_dcn=("int8-block" if dcn_quantized(g)
+                                 else "fp"))
         if quantized(g):
             return quantwire.reduce_scatter_mean(flat_pad(g), bound)
         return collectives.reduce_scatter(flat_pad(g), bound, average=True)
 
-    fused = fusion_threshold is not None and wire_format == "fp"
+    fused = (fusion_threshold is not None and wire_format == "fp"
+             and wire_format_dcn == "fp")
     if fused:
         from tpuframe.parallel import fusion
 
@@ -423,12 +468,11 @@ def sharded_update(tx: optax.GradientTransformation, axes,
         issued = []
         for bucket in buckets:
             if len(bucket) == 1:
-                issued.append(collectives.reduce_scatter(
-                    g_flat[bucket[0]], bound, average=True))
+                issued.append(scatter_fp(g_flat[bucket[0]]))
             else:
-                issued.append(collectives.reduce_scatter(
-                    fusion.pack_for_scatter([g_flat[i] for i in bucket], n),
-                    bound, average=True))
+                issued.append(scatter_fp(
+                    fusion.pack_for_scatter([g_flat[i] for i in bucket],
+                                            n)))
         g_out = [None] * len(g_leaves)
         for shard, bucket in zip(issued, buckets):
             if len(bucket) == 1:
@@ -462,8 +506,19 @@ def sharded_update(tx: optax.GradientTransformation, axes,
     # un-pad and fold back to the original shapes.  On the int8 wire the
     # update DELTA is gathered quantized and added to the replicated old
     # params (see docstring — masters never lose precision).
+    def gather_fp(shard):
+        if two_stage:
+            return hier_lib.gather(shard, inner)
+        return _gather_full(shard, bound)
+
     def regather(old_shard, shard, like):
-        if quantized(like):
+        if two_stage and dcn_quantized(like):
+            # Two-stage delta gather: quantized over DCN, fp over ICI.
+            delta = hier_lib.gather_delta(shard - old_shard, inner)
+            full = flat_pad(like) + delta.astype(like.dtype)
+        elif two_stage:
+            full = hier_lib.gather(shard, inner)
+        elif quantized(like):
             delta = quantwire.all_gather(shard - old_shard, bound)
             full = flat_pad(like) + delta.astype(like.dtype)
         else:
@@ -479,10 +534,10 @@ def sharded_update(tx: optax.GradientTransformation, axes,
         gathered = []
         for bucket in buckets:
             if len(bucket) == 1:
-                gathered.append(_gather_full(s_leaves[bucket[0]], bound))
+                gathered.append(gather_fp(s_leaves[bucket[0]]))
             else:
-                gathered.append(_gather_full(
-                    jnp.concatenate([s_leaves[i] for i in bucket]), bound))
+                gathered.append(gather_fp(
+                    jnp.concatenate([s_leaves[i] for i in bucket])))
         p_out = [None] * len(p_leaves)
         for full, bucket in zip(gathered, buckets):
             if len(bucket) == 1:

@@ -154,6 +154,13 @@ class Harness:
     # (format, resolution source) from tpuframe.parallel.quantwire.resolve
     # — ("fp", "default") when nothing elected a quantized wire.
     wire_format: tuple = ("fp", "default")
+    # (format, resolution source) for the cross-slice DCN leg from
+    # tpuframe.parallel.quantwire.resolve_legs — ("fp", "default") when
+    # nothing elected a quantized DCN wire (needs hier="hier").
+    wire_format_dcn: tuple = ("fp", "default")
+    # (mode, resolution source) from tpuframe.parallel.hier.resolve —
+    # ("flat", "default") when nothing elected two-level collectives.
+    hier: tuple = ("flat", "default")
     # (bucket threshold bytes, resolution source) from
     # tpuframe.parallel.fusion.resolve — (None, "default") when nothing
     # elected bucketed gradient fusion (per-leaf collectives).
@@ -183,8 +190,8 @@ def _resolved_fusion(cfg: TrainConfig) -> tuple:
     threshold, source = fusion_lib.resolve(program=program,
                                            family="fusion_threshold")
     if threshold is not None and source != "env":
-        wf, wf_src = quantwire.resolve(program=program,
-                                       family=f"wire_format_{model_tag}")
+        (wf, wf_src), _ = quantwire.resolve_legs(
+            program=program, family=f"wire_format_{model_tag}")
         if wf != "fp" and wf_src == "env":
             # An explicit env-elected quantized wire owns the gradient
             # path; the advisory DB-elected bucket threshold yields.
@@ -380,13 +387,37 @@ def build_harness(cfg: TrainConfig) -> Harness:
     # gets make_train_step's specific error.
     from tpuframe.parallel import quantwire
 
-    wire_format, wf_source = quantwire.resolve(
-        program=f"train_{model_tag}_b{cfg.global_batch}",
-        family=f"wire_format_{model_tag}")
+    (wire_format, wf_source), (wire_format_dcn, wfd_source) = \
+        quantwire.resolve_legs(
+            program=f"train_{model_tag}_b{cfg.global_batch}",
+            family=f"wire_format_{model_tag}",
+            family_dcn="hier_collectives")
     if (wire_format != "fp" and wf_source != "env"
             and (use_pp or use_sharded_state or mesh is None
                  or cfg.grad_reduce == "adasum")):
         wire_format, wf_source = "fp", "default"
+
+    # Hierarchical two-level collectives: TPUFRAME_HIER env wins, else
+    # the DB's offline hier_collectives sweep winner (generation-gated),
+    # else flat.  Same fallback discipline: on configs the two-level
+    # lowering cannot serve (pp, auto-SPMD sharded state, no mesh,
+    # adasum, a program-wide quantized wire, sequence sharding) a
+    # DB-elected mode demotes silently while an explicit env ask gets
+    # make_train_step's specific error.  The DCN-leg wire format rides
+    # the lowering: without hier it demotes to fp the same way.
+    from tpuframe.parallel import hier as hier_lib
+
+    hier_mode, hier_source = hier_lib.resolve(
+        program=f"train_{model_tag}_b{cfg.global_batch}",
+        family=hier_lib.DB_FAMILY)
+    if (hier_mode != "flat" and hier_source != "env"
+            and (use_pp or use_sharded_state or mesh is None
+                 or cfg.grad_reduce == "adasum" or wire_format != "fp"
+                 or cfg.shard_seq)):
+        hier_mode, hier_source = "flat", "default"
+    if (wire_format_dcn != "fp" and wfd_source != "env"
+            and hier_mode != "hier"):
+        wire_format_dcn, wfd_source = "fp", "default"
 
     # GPipe pp takes no gradient-fusion modifier; the knob resolves (and
     # can be DB-elected) only on the shard_map branch below.
@@ -421,6 +452,10 @@ def build_harness(cfg: TrainConfig) -> Harness:
             raise ValueError("TPUFRAME_WIRE_FORMAT=int8-block is the "
                              "plain-DP shard_map path; the pipeline step "
                              "owns its own cross-stage communication")
+        if hier_mode != "flat":
+            raise ValueError("TPUFRAME_HIER=hier is the plain-DP "
+                             "shard_map path; the pipeline step owns its "
+                             "own cross-stage communication")
         from tpuframe.parallel import pp_lm
 
         factory, place_state, _ = pp_lm.make_pp_lm_step(
@@ -485,6 +520,12 @@ def build_harness(cfg: TrainConfig) -> Harness:
             # step; the quantized wire only serves the implicit/zero1
             # paths.  A DB-elected format demotes silently here too.
             wire_format, wf_source = "fp", "default"
+        if (wire_format_dcn != "fp" and wfd_source != "env"
+                and fusion_threshold):
+            # The quantized DCN leg rides the per-leaf hier lowering;
+            # bucketed fusion concatenates leaves past the block
+            # heuristics, so a DB-elected DCN format demotes silently.
+            wire_format_dcn, wfd_source = "fp", "default"
         train_step = step_lib.make_train_step(
             loss_fn, tx, mesh, batch_partition=step_part,
             reduce_axes=reduce_axes, state_shardings=state_shardings,
@@ -494,7 +535,9 @@ def build_harness(cfg: TrainConfig) -> Harness:
             compiler_options=xla_opts,
             remat_policy=step_policy,
             weight_update=weight_update,
-            wire_format=wire_format)
+            wire_format=wire_format,
+            hier=hier_mode,
+            wire_format_dcn=wire_format_dcn)
         eval_step = step_lib.make_eval_step(
             make_metric_fn(cfg, model), mesh, batch_partition=step_part,
             reduce_axes=reduce_axes, state_shardings=state_shardings)
@@ -529,6 +572,8 @@ def build_harness(cfg: TrainConfig) -> Harness:
                    remat_policy=(remat_policy, remat_source),
                    weight_update=(weight_update, wu_source),
                    wire_format=(wire_format, wf_source),
+                   wire_format_dcn=(wire_format_dcn, wfd_source),
+                   hier=(hier_mode, hier_source),
                    fusion_threshold=(fusion_threshold, ft_source),
                    pspec=(spec.canonical() if spec is not None else None,
                           spec_source),
@@ -1110,8 +1155,16 @@ def _train_impl(cfg: TrainConfig, *, trace_dir: str | None = None,
         # wire the run actually compiled with and who elected it — the
         # analyzer joins this with the roofline's comm model to check
         # the predicted byte drop landed.
+        # Both fabric legs ride the one record: ``format``/``source`` is
+        # the in-slice ICI leg (the historical single-fabric field pair),
+        # ``format_dcn``/``source_dcn`` the cross-slice DCN leg, and
+        # ``hier``/``hier_source`` says whether the two-level lowering
+        # that separates the legs was actually compiled in.
         events_lib.emit("wire_format", format=h.wire_format[0],
-                        source=h.wire_format[1])
+                        source=h.wire_format[1],
+                        format_dcn=h.wire_format_dcn[0],
+                        source_dcn=h.wire_format_dcn[1],
+                        hier=h.hier[0], hier_source=h.hier[1])
         # Gradient-fusion provenance, same contract: which bucket
         # threshold the step actually compiled with (None = per-leaf)
         # and who elected it — the analyzer joins this with the

@@ -7,6 +7,7 @@
     python -m tpuframe.tune sweep --zero1               # weight-update sharding
     python -m tpuframe.tune sweep --wire                # wire-format search
     python -m tpuframe.tune sweep --fusion              # fusion bucket grid
+    python -m tpuframe.tune sweep --hier                # two-level collectives
     python -m tpuframe.tune show                        # ranked DB contents
     python -m tpuframe.tune check                       # CI self-check
 
@@ -78,6 +79,11 @@ def _cmd_sweep(args) -> int:
                             batch=args.fusion_batch,
                             thresholds=tuple(args.fusion_thresholds))
         return 0
+    if args.hier:
+        search.hier_sweep(args.topology, slices=args.hier_slices,
+                          db_path=args.db, report_path=args.report,
+                          batch=args.hier_batch)
+        return 0
     search.sweep(args.topology, db_path=args.db, report_path=args.report,
                  seq=args.seq, head_dim=args.head_dim,
                  blocks=tuple(args.blocks),
@@ -94,6 +100,19 @@ def _cmd_fusion_probe(args) -> int:
                                    args.batch, args.threshold, args.floor)
     with open(args.out, "w") as f:
         json.dump(row, f)
+    return 0
+
+
+def _cmd_hier_probe(args) -> int:
+    import json
+
+    from tpuframe.tune import search
+
+    payload = search._hier_probe_row(args.topology, args.slices,
+                                     args.program, args.batch, args.mode,
+                                     args.hier, args.wire_format_dcn)
+    with open(args.out, "w") as f:
+        json.dump(payload, f)
     return 0
 
 
@@ -185,6 +204,15 @@ def main(argv=None) -> int:
                          "overlap score + compiled wire bytes "
                          "(fusion_threshold family)")
     sw.add_argument("--fusion-batch", type=int, default=512)
+    sw.add_argument("--hier", action="store_true",
+                    help="sweep two-level collectives on a compile-only "
+                         "MULTI-slice topology (flat vs hier x fp vs "
+                         "int8-block DCN leg), ranked on step + ICI + "
+                         "DCN ms (hier_collectives family)")
+    sw.add_argument("--hier-batch", type=int, default=512)
+    sw.add_argument("--hier-slices", type=int, default=2,
+                    help="slice count for the compile-only multi-slice "
+                         "topology (PJRT num_slices)")
     sw.add_argument("--fusion-thresholds", type=int, nargs="+",
                     default=[16384, 32768, 65536, 131072, 262144],
                     metavar="BYTES")
@@ -217,6 +245,21 @@ def main(argv=None) -> int:
     fp.add_argument("--threshold", type=int, default=None)
     fp.add_argument("--out", required=True)
     fp.set_defaults(fn=_cmd_fusion_probe)
+
+    # Hidden worker: one hier candidate per process — the compile-only
+    # multi-slice backend wedges nondeterministically, and the parent
+    # sweep must survive a timeout to retry/record it (hier_sweep
+    # spawns these; the parent holds the AOT lock, the probe doesn't).
+    hp = sub.add_parser("_hier-probe")
+    hp.add_argument("--topology", default="v5e:2x2")
+    hp.add_argument("--slices", type=int, default=2)
+    hp.add_argument("--program", default="lm")
+    hp.add_argument("--batch", type=int, default=512)
+    hp.add_argument("--mode", default="replicated")
+    hp.add_argument("--hier", default="flat")
+    hp.add_argument("--wire-format-dcn", default="fp")
+    hp.add_argument("--out", required=True)
+    hp.set_defaults(fn=_cmd_hier_probe)
 
     sh = sub.add_parser("show", help="print ranked DB contents")
     sh.add_argument("--db", default=None)

@@ -144,6 +144,10 @@ class Record:
             env["TPUFRAME_WEIGHT_UPDATE"] = str(cfg["weight_update"])
         if "wire_format" in cfg:
             env["TPUFRAME_WIRE_FORMAT"] = str(cfg["wire_format"])
+        if "wire_format_dcn" in cfg:
+            env["TPUFRAME_WIRE_FORMAT_DCN"] = str(cfg["wire_format_dcn"])
+        if "hier" in cfg:
+            env["TPUFRAME_HIER"] = str(cfg["hier"])
         if "fusion_threshold" in cfg:
             env["TPUFRAME_FUSION_THRESHOLD"] = str(cfg["fusion_threshold"])
         if "spec" in cfg:
@@ -436,6 +440,56 @@ def resolve_wire_format(program: str,
         return None
     fmt = rec.config.get("wire_format")
     return str(fmt) if fmt else None
+
+
+def resolve_wire_format_dcn(program: str,
+                            family: str | None = None) -> str | None:
+    """Wire format of the cross-slice (DCN) leg of the two-level
+    lowering for ``program``: None unless the DB has a swept
+    ``hier_collectives`` winner for the target generation.  Callers
+    apply ``TPUFRAME_WIRE_FORMAT_DCN`` themselves FIRST via
+    :func:`tpuframe.parallel.quantwire.resolve_legs` — when the env var
+    is set this returns None so the override is unambiguous."""
+    if os.environ.get("TPUFRAME_WIRE_FORMAT_DCN", "").strip():
+        return None
+    gen = target_generation()
+    if gen is None:
+        return None
+    db = _open_for_resolution()
+    if db is None:
+        return None
+    rec = db.best(program=program, generation=gen)
+    if (rec is None or "wire_format_dcn" not in rec.config) \
+            and family is not None:
+        rec = db.best(family=family, generation=gen)
+    if rec is None:
+        return None
+    fmt = rec.config.get("wire_format_dcn")
+    return str(fmt) if fmt else None
+
+
+def resolve_hier(program: str,
+                 family: str | None = None) -> str | None:
+    """Hierarchical-collective mode (flat/hier) for ``program``: None
+    unless the DB has a swept ``hier_collectives`` winner for the target
+    generation.  Callers apply ``TPUFRAME_HIER`` themselves FIRST via
+    :func:`tpuframe.parallel.hier.resolve` — when the env var is set
+    this returns None so the override is unambiguous."""
+    if os.environ.get("TPUFRAME_HIER", "").strip():
+        return None
+    gen = target_generation()
+    if gen is None:
+        return None
+    db = _open_for_resolution()
+    if db is None:
+        return None
+    rec = db.best(program=program, generation=gen)
+    if (rec is None or "hier" not in rec.config) and family is not None:
+        rec = db.best(family=family, generation=gen)
+    if rec is None:
+        return None
+    mode = rec.config.get("hier")
+    return str(mode) if mode else None
 
 
 def resolve_fusion_threshold(program: str,

@@ -123,6 +123,17 @@ def enumerate_candidates(n_devices: int, n_slices: int = 1) -> list:
         # The §23 composed acceptance spec: dp×fsdp inside each slice,
         # replicated over the DCN slice axis.
         cands.append({"spec": f"dp=2,fsdp=2;slices={n_slices}"})
+        # §28 two-level candidates: the hierarchical lowering (in-slice
+        # reduce-scatter → cross-slice exchange of 1/n_inner → in-slice
+        # all-gather) and its int8-block DCN leg, alone and composed
+        # with ZeRO-1.  Only meaningful with a slice axis to cross.
+        cands.append({"spec": "dp=*" + tail, "hier": "hier"})
+        cands.append({"spec": "dp=*" + tail, "hier": "hier",
+                      "wire_format_dcn": "int8-block"})
+        cands.append({"spec": "dp=*" + tail, "weight_update": "zero1",
+                      "hier": "hier"})
+        cands.append({"spec": "dp=*" + tail, "weight_update": "zero1",
+                      "hier": "hier", "wire_format_dcn": "int8-block"})
     return cands
 
 
@@ -149,7 +160,7 @@ def _row(rows: list, name: str) -> dict | None:
 
 
 def compute_verdicts(rows: list) -> dict:
-    """Re-derive the three pinned PERF verdicts from the candidate rows.
+    """Re-derive the four pinned PERF verdicts from the candidate rows.
 
     Pure arithmetic over the report — no jax, no recompile — so the
     gate can re-check them against the stored booleans forever.  Each
@@ -212,6 +223,32 @@ def compute_verdicts(rows: list) -> dict:
     else:
         v["holds"] = None
     verdicts["dcn_split"] = v
+
+    flat2 = _row(rows, "spec:dp=*;slices=2")
+    hier2 = _row(rows, "spec:dp=*;slices=2+hier")
+    hier_i8 = _row(rows, "spec:dp=*;slices=2+hier+dcn-int8")
+    v = {"perf_section": 28,
+         "claim": "the two-level lowering crushes the DCN term: +hier "
+                  "moves <= 1/n_inner of the flat cross-slice bytes "
+                  "over DCN (t_dcn follows), and the int8-block DCN "
+                  "leg cuts strictly deeper"}
+    if flat2 and hier2 and flat2.get("dcn_bytes"):
+        ratio = hier2["dcn_bytes"] / flat2["dcn_bytes"]
+        holds = ratio <= 0.5 and hier2["t_dcn_ms"] < flat2["t_dcn_ms"]
+        v.update(flat_dcn_bytes=flat2["dcn_bytes"],
+                 hier_dcn_bytes=hier2["dcn_bytes"],
+                 dcn_bytes_ratio=round(ratio, 4),
+                 flat_t_dcn_ms=flat2["t_dcn_ms"],
+                 hier_t_dcn_ms=hier2["t_dcn_ms"])
+        if hier_i8:
+            r8 = hier_i8["dcn_bytes"] / flat2["dcn_bytes"]
+            v.update(int8_dcn_bytes=hier_i8["dcn_bytes"],
+                     int8_dcn_bytes_ratio=round(r8, 4))
+            holds = holds and r8 < ratio
+        v["holds"] = holds
+    else:
+        v["holds"] = None
+    verdicts["hier_dcn"] = v
     return verdicts
 
 
@@ -253,7 +290,9 @@ def plan(topology: str = "v5e:2x2", *, slice_counts=(1, 2),
                 wire_format=cand.get("wire_format"),
                 seq_mode=cand.get("seq_mode"),
                 grad_reduce=cand.get("grad_reduce"),
-                fusion_threshold=cand.get("fusion_threshold"))
+                fusion_threshold=cand.get("fusion_threshold"),
+                hier=cand.get("hier"),
+                wire_format_dcn=cand.get("wire_format_dcn"))
             base = {"name": audit.name, "spec": cand["spec"],
                     "slices": n_slices, "n_devices": n,
                     "compile_topology": compile_topo,

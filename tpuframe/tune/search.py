@@ -442,11 +442,16 @@ def remat_sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
 
 def _zero1_step_compile(topo_devices, program: str, batch: int,
                         weight_update: str, wire_format: str = "fp",
-                        fusion_threshold: int | None = None):
+                        fusion_threshold: int | None = None,
+                        slices: int = 1, hier: str = "flat",
+                        wire_format_dcn: str = "fp"):
     """AOT-compile one donated train step over the FULL topology under one
     weight-update mode.  Unlike the remat sweep's single-chip rig, the
     collective swap is the whole point here — the reduce-scatter /
-    all-gather pair only exists with every chip in the mesh.  Returns
+    all-gather pair only exists with every chip in the mesh.  With
+    ``slices > 1`` the devices (from ``pspec.topology_devices``) are laid
+    out on a hierarchical slice×data mesh so the hier sweep's two-level
+    candidates lower their real cross-slice collectives.  Returns
     ``(compiled, desc, opt_state_bytes_per_chip, census)``."""
     import dataclasses
 
@@ -462,10 +467,13 @@ def _zero1_step_compile(topo_devices, program: str, batch: int,
     from tpuframe.parallel import zero1 as zero1_lib
 
     n = len(topo_devices)
-    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=n),
-                              devices=list(topo_devices))
+    if slices > 1 and n % slices:
+        raise ValueError(f"{n} devices do not tile {slices} slices")
+    mesh = mesh_lib.make_mesh(
+        mesh_lib.MeshSpec(data=n // max(slices, 1), slices=slices),
+        devices=list(topo_devices))
     repl = NamedSharding(mesh, P())
-    data = NamedSharding(mesh, mesh_lib.batch_spec())
+    data = NamedSharding(mesh, mesh_lib.batch_spec(mesh=mesh))
 
     if program == "resnet50":
         model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
@@ -509,6 +517,33 @@ def _zero1_step_compile(topo_devices, program: str, batch: int,
                                               sharding=data),
             "label": jax.ShapeDtypeStruct((batch,), jnp.int32,
                                           sharding=data)}
+    elif program == "lm":
+        # A mid-size TransformerLM (~3.8M params, ~15 MB of f32 grads on
+        # the wire) — big enough that every fabric column in the hier
+        # sweep carries honest megabytes, small enough that the
+        # compile-only multi-slice lowering stays in seconds where the
+        # conv stack costs ~4 min per candidate (resnet50) and BERT's
+        # 110M-param step takes longer still on this backend.
+        seq = 128
+        model = models.get_model(
+            "transformer-lm", tiny=True, vocab_size=2048, max_seq=seq,
+            hidden_size=256, num_layers=4, num_heads=8,
+            intermediate_size=1024)
+        tx = optax.adamw(1e-3)
+
+        def loss_fn(params, model_state, batch, step_rng):
+            logits = model.apply(
+                {"params": params}, batch["input_ids"], train=True,
+                rngs={"dropout": step_rng})
+            loss = losses.softmax_cross_entropy(logits, batch["labels"])
+            return loss, (model_state, {})
+
+        variables = jax.eval_shape(
+            lambda k: model.init(k, jnp.zeros((2, seq), jnp.int32)),
+            jax.random.key(0))
+        model_state = {}
+        ids = jax.ShapeDtypeStruct((batch, seq), jnp.int32, sharding=data)
+        batch_structs = {"input_ids": ids, "labels": ids}
     else:
         raise ValueError(f"unknown zero1 sweep program {program!r}")
 
@@ -541,7 +576,9 @@ def _zero1_step_compile(topo_devices, program: str, batch: int,
     step = step_lib.make_train_step(loss_fn, tx, mesh, donate=True,
                                     weight_update=weight_update,
                                     wire_format=wire_format,
-                                    fusion_threshold=fusion_threshold)
+                                    fusion_threshold=fusion_threshold,
+                                    hier=hier,
+                                    wire_format_dcn=wire_format_dcn)
     lowered = step.lower(state, batch_structs)
     if fusion_threshold is not None:
         # The staged pass owns bucketing: hand the XLA all-reduce
@@ -559,6 +596,13 @@ def _zero1_step_compile(topo_devices, program: str, batch: int,
             "weight_update": weight_update, "wire_format": wire_format}
     if fusion_threshold is not None:
         desc["fusion_threshold"] = int(fusion_threshold)
+    # Only stamp the hierarchical fields on multi-slice compiles so the
+    # single-slice sweeps' fingerprints stay byte-identical to the DB
+    # rows they already persisted.
+    if slices > 1:
+        desc["slices"] = int(slices)
+        desc["hier"] = hier
+        desc["wire_format_dcn"] = wire_format_dcn
     return compiled, desc, opt_bytes, census
 
 
@@ -776,6 +820,217 @@ def wire_sweep(topology: str = "v5e:2x2", *, db_path: str | None = None,
         f.write("\n")
     _log(f"report: {report_path}", log)
     return report
+
+
+def hier_sweep(topology: str = "v5e:2x2", *, slices: int = 2,
+               db_path: str | None = None, report_path: str | None = None,
+               batch: int = 512, zero1_batch: int = 256, log=None) -> dict:
+    """Offline two-level-collective search: AOT-compile the donated
+    TransformerLM train step (plain DP and ZeRO-1 arms — see the ``lm``
+    program note in ``_zero1_step_compile`` for why not the conv/BERT
+    pair the other sweeps use) on a compile-only MULTI-SLICE topology
+    (``pspec.topology_devices`` — PJRT ``num_slices``, no chip needed)
+    once per (hier, wire_format_dcn) candidate, attribute every
+    collective's wire bytes to its fabric
+    with shardflow's replica-group splitter, price the two columns with
+    ``roofline.comm_split_score`` (ICI over the device ring, DCN over
+    the slice ring — the ~32x bandwidth gap is the whole game), and
+    persist every candidate to the ``hier_collectives`` DB family.
+
+    Candidates: flat/fp (the baseline everything is ratioed against),
+    hier/fp (PERF §23's two-level lowering — DCN carries 1/n_inner of
+    the bytes), and hier/int8-block (EQuARX's quantized wire on the DCN
+    leg only — ICI stays fp).  flat/int8-block is structurally invalid
+    (the DCN wire format IS the cross-slice leg; pspec rejects it) and
+    is recorded as skipped rather than silently absent.
+
+    DB rows store the comm-aware total (step + ICI + DCN ms) as their
+    ``predicted_ms`` so ``db.best`` / ``resolve_hier`` elect the
+    candidate the split model actually favors — the raw roofline step
+    time ties across hier modes by construction (same compute), and a
+    tie would elect noise.
+
+    Each candidate compiles in its OWN worker subprocess
+    (``python -m tpuframe.tune _hier-probe``): the compile-only
+    multi-slice backend's compiles are nondeterministically slow — the
+    same candidate that compiles in seconds in one run can wedge libtpu
+    for tens of minutes in the next — and isolation plus a timeout
+    turns a wedged compile into a retried (then recorded) row instead
+    of hanging the whole sweep."""
+    import subprocess
+    import tempfile
+
+    import jax  # noqa: F401 — fail fast before holding the lock
+
+    hold_aot_lock()
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    gen = roofline.generation_from_topology(topology)
+    n = roofline.n_chips_from_topology(topology) * max(int(slices), 1)
+    candidates = (("flat", "fp"), ("hier", "fp"), ("hier", "int8-block"))
+    configs = (("lm", batch, "replicated"),
+               ("lm", zero1_batch, "zero1"))
+    _log(f"hier sweep on {topology} x{slices} slices ({n} chips): "
+         f"{[(p, m) for p, _, m in configs]} x {list(candidates)}", log)
+
+    db_path = db_path or tune_db.default_db_path()
+    db = tune_db.TuningDB.open(db_path) if os.path.exists(db_path) \
+        else tune_db.TuningDB(db_path)
+    report = {"topology": topology, "slices": slices, "generation": gen,
+              "n_chips": n,
+              "objective": "t_step_ms + t_ici_ms + t_dcn_ms "
+                           "(comm_split_score on shardflow's "
+                           "replica-group fabric attribution)",
+              "skipped": [{"hier": "flat", "wire_format_dcn": "int8-block",
+                           "reason": "structurally invalid — the DCN "
+                                     "wire format is the cross-slice "
+                                     "leg of the two-level lowering"}],
+              "hier": {"rows": [], "compile_errors": []}}
+
+    for program, b, mode in configs:
+        baseline = {}
+        for hier_mode, fmt in candidates:
+            payload, err, rc = None, None, 0
+            for attempt in (1, 2):
+                with tempfile.NamedTemporaryFile(suffix=".json",
+                                                 delete=False) as tf:
+                    out_path = tf.name
+                cmd = [sys.executable, "-m", "tpuframe.tune",
+                       "_hier-probe", "--topology", topology,
+                       "--slices", str(slices), "--program", program,
+                       "--batch", str(b), "--mode", mode,
+                       "--hier", hier_mode, "--wire-format-dcn", fmt,
+                       "--out", out_path]
+                try:
+                    proc = subprocess.run(cmd, capture_output=True,
+                                          text=True, timeout=480)
+                    rc, stderr = proc.returncode, proc.stderr
+                except subprocess.TimeoutExpired:
+                    rc, stderr = -1, "probe timed out after 480 s"
+                try:
+                    if rc == 0:
+                        with open(out_path) as f:
+                            payload = json.load(f)
+                        break
+                    err = _crash_reason(stderr, rc)
+                    if rc != -1:
+                        break  # deterministic failure — retry won't help
+                    _log(f"  {program}/{hier_mode}/{fmt}: wedged compile "
+                         f"(attempt {attempt}), "
+                         + ("retrying" if attempt == 1 else "giving up"),
+                         log)
+                finally:
+                    if os.path.exists(out_path):
+                        os.unlink(out_path)
+            if payload is None:
+                row = {"program": program, "hier": hier_mode,
+                       "wire_format_dcn": fmt, "weight_update": mode,
+                       "returncode": rc, "error": err}
+                report["hier"]["compile_errors"].append(row)
+                _log(f"  {program}/{hier_mode}/{fmt}: COMPILE ERROR "
+                     f"{(err or '')[:80]}", log)
+                continue
+            row, desc, pred = (payload["row"], payload["desc"],
+                               payload["pred"])
+            css = pred["comm_split"]
+            total_ms = row["predicted_total_ms"]
+            if hier_mode == "flat" and fmt == "fp":
+                baseline = {"dcn_bytes": css["dcn_bytes"],
+                            "t_dcn_ms": css["t_dcn_ms"],
+                            "total_ms": total_ms}
+            if baseline.get("dcn_bytes"):
+                row["dcn_bytes_ratio_vs_flat"] = round(
+                    css["dcn_bytes"] / baseline["dcn_bytes"], 4)
+            if baseline.get("t_dcn_ms"):
+                row["t_dcn_ratio_vs_flat"] = round(
+                    css["t_dcn_ms"] / baseline["t_dcn_ms"], 4)
+            db.add({"program": desc["program"],
+                    "family": "hier_collectives",
+                    "fingerprint": tune_db.fingerprint(desc),
+                    "topology": topology, "generation": gen,
+                    "config": {"hier": hier_mode, "wire_format_dcn": fmt,
+                               "batch": b, "weight_update": mode,
+                               "slices": slices},
+                    "predicted": pred})
+            report["hier"]["rows"].append(row)
+            _log(f"  {program}/{hier_mode}/{fmt}: "
+                 f"{row['predicted_total_ms']} ms total "
+                 f"({row['t_step_ms']} step + {row['t_ici_ms']} ICI + "
+                 f"{row['t_dcn_ms']} DCN), "
+                 f"{css['dcn_bytes'] / 1e6:.2f} MB on DCN", log)
+
+    rows = report["hier"]["rows"]
+    winners = {}
+    for program, _, mode in configs:
+        arm_rows = [r for r in rows if r["program"] == program
+                    and r["weight_update"] == mode]
+        arm_rows.sort(
+            key=lambda r: r.get("predicted_total_ms") or float("inf"))
+        if arm_rows:
+            winners[f"{program}/{mode}"] = arm_rows[0]
+    report["winners"] = winners
+    db.save()
+    _log(f"tuning DB: {db.path} ({len(db.data['records'])} records)", log)
+    if report_path is None:
+        tag = topology.replace(":", "_").replace("x", "")
+        report_path = os.path.join(tune_db.repo_root(), "perf", "results",
+                                   f"hier_report_{tag}.json")
+    os.makedirs(os.path.dirname(report_path), exist_ok=True)
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    _log(f"report: {report_path}", log)
+    return report
+
+
+def _hier_probe_row(topology: str, slices: int, program: str, batch: int,
+                    mode: str, hier: str, wire_format_dcn: str) -> dict:
+    """Compile + score ONE two-level-collective candidate; returns the
+    report row, its DB descriptor, and the comm-aware predicted dict as
+    one JSON payload.
+
+    Runs inside a worker subprocess spawned by ``hier_sweep`` (see its
+    docstring for why isolation).  The parent holds the AOT lock; this
+    helper must not re-take it."""
+    from tpuframe.analysis import collective_graph as cg
+    from tpuframe.analysis import hlo_audit, shardflow
+    from tpuframe.parallel import pspec
+
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    gen = roofline.generation_from_topology(topology)
+    devices = pspec.topology_devices(topology, slices=slices)
+    n = len(devices)
+    compiled, desc, _opt_bytes, _census = _zero1_step_compile(
+        devices, program, batch, mode, slices=slices,
+        hier=hier, wire_format_dcn=wire_format_dcn)
+    hlo = compiled.as_text()
+    pred = roofline.score_compiled(compiled, gen)
+    pred["source"] = "compiled"
+    coll = hlo_audit.parse_collectives(hlo)
+    split = shardflow.comm_split(
+        cg.parse_graph(hlo), coll.filter(1024),
+        mesh_shape={"slice": slices, "data": n // slices}, n_devices=n)
+    # The TPU backend routes the cross-slice hop through the MegaScale
+    # transport (host-transfer send/recv), not HLO collectives — fold
+    # those bytes into the DCN column or the sweep scores DCN as free.
+    for kind, nbytes in shardflow.megascale_split(hlo).items():
+        split["dcn"][kind] = split["dcn"].get(kind, 0) + int(nbytes)
+    css = roofline.comm_split_score(gen, split, n_devices=n,
+                                    n_slices=slices)
+    total_ms = round(pred["predicted_ms"] + css["t_ici_ms"]
+                     + css["t_dcn_ms"], 3)
+    pred["comm_split"] = css
+    pred["t_step_ms"] = pred["predicted_ms"]
+    pred["predicted_ms"] = total_ms  # comm-aware rank (see hier_sweep)
+    row = {"program": program, "hier": hier,
+           "wire_format_dcn": wire_format_dcn, "weight_update": mode,
+           "global_batch": batch,
+           "t_step_ms": pred["t_step_ms"],
+           "t_ici_ms": css["t_ici_ms"],
+           "t_dcn_ms": css["t_dcn_ms"],
+           "predicted_total_ms": total_ms,
+           "ici_bytes": css["ici_bytes"],
+           "dcn_bytes": css["dcn_bytes"], "bound": pred["bound"]}
+    return {"row": row, "desc": desc, "pred": pred}
 
 
 def _fusion_probe_row(topology: str, program: str, batch: int,
